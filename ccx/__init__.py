@@ -1,0 +1,17 @@
+"""ccx — a TPU-native cluster-rebalancing framework.
+
+A from-scratch re-design of the capabilities of jlei-nr/cruise-control
+(LinkedIn-style Kafka Cruise Control; see SURVEY.md): a goal-based cluster
+rebalancer whose analyzer runs natively on TPU via JAX/XLA — the ClusterModel
+is a pytree of broker x partition load tensors, every goal is a pure penalty
+kernel, and proposal search is batched simulated annealing under jit/vmap/
+pjit — surrounded by the monitor / executor / detector / REST layers the
+reference provides on the JVM (SURVEY.md section 2 inventory).
+
+Reference parity citations use the upstream layout, e.g.
+``cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/...`` — see
+SURVEY.md's provenance banner (the /root/reference mount was empty; class
+names from BASELINE.json + upstream structural knowledge).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from ccx.common.resources import Resource, NUM_RESOURCES  # noqa: F401

@@ -1,0 +1,50 @@
+"""Resource axes tracked per replica / broker.
+
+Parity: ``common/Resource.java`` in the reference (SURVEY.md C3) defines
+CPU, NW_IN, NW_OUT, DISK with per-resource balancability and host/broker
+scope. Here a resource is simply an axis index into the leading dimension of
+the load tensors (float32[NUM_RESOURCES, ...]) so every goal kernel can
+slice its resource without branching.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Resource(enum.IntEnum):
+    """Index into the resource axis of load/capacity tensors."""
+
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+
+    @property
+    def is_host_resource(self) -> bool:
+        # Reference: CPU and NW are host-level resources; DISK is broker-level.
+        return self in (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)
+
+
+NUM_RESOURCES = len(Resource)
+
+#: Default capacity-utilization thresholds, keyed by resource.
+#: Parity: AnalyzerConfig `cpu.capacity.threshold` (0.7),
+#: `disk.capacity.threshold` (0.8), `network.inbound/outbound.capacity.threshold`
+#: (0.8). (unverified against /root/reference — SURVEY.md provenance banner.)
+DEFAULT_CAPACITY_THRESHOLD = {
+    Resource.CPU: 0.7,
+    Resource.NW_IN: 0.8,
+    Resource.NW_OUT: 0.8,
+    Resource.DISK: 0.8,
+}
+
+#: Default balance thresholds for resource-usage distribution goals.
+#: Parity: AnalyzerConfig `*.balance.threshold` default 1.1 — a broker is
+#: balanced when its utilization lies within [avg*(2-t), avg*t].
+DEFAULT_BALANCE_THRESHOLD = {
+    Resource.CPU: 1.1,
+    Resource.NW_IN: 1.1,
+    Resource.NW_OUT: 1.1,
+    Resource.DISK: 1.1,
+}

@@ -1,0 +1,2 @@
+from ccx.model.tensor_model import TensorClusterModel  # noqa: F401
+from ccx.model.aggregates import BrokerAggregates, broker_aggregates  # noqa: F401

@@ -1,0 +1,90 @@
+"""Broker-level aggregates — the tensor equivalent of ClusterModelStats inputs.
+
+The reference walks the object tree to compute per-broker loads and counts
+(``model/ClusterModelStats.java``, SURVEY.md C4). Here one fused pass of
+segment-sums over the flattened (partition x slot) axis produces every
+aggregate the goal stack needs. Everything is pure and vmappable over a batch
+of candidate assignments, which is what makes batched annealing possible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ccx.common.resources import NUM_RESOURCES, Resource
+from ccx.model.tensor_model import TensorClusterModel
+
+
+@struct.dataclass
+class BrokerAggregates:
+    broker_load: jnp.ndarray        # float32[RES, B] role-resolved load
+    replica_count: jnp.ndarray      # int32[B]
+    leader_count: jnp.ndarray       # int32[B]
+    potential_nw_out: jnp.ndarray   # float32[B] if every hosted replica led
+    leader_bytes_in: jnp.ndarray    # float32[B] NW_IN of leader replicas only
+    topic_replica_count: jnp.ndarray  # int32[T, B]
+    topic_leader_count: jnp.ndarray   # int32[T, B]
+    disk_load: jnp.ndarray          # float32[B, D]
+
+
+def broker_aggregates(m: TensorClusterModel) -> BrokerAggregates:
+    B, T, D = m.B, m.num_topics, m.D
+    valid = m.replica_valid                      # [P, R]
+    is_leader = m.is_leader                      # [P, R]
+
+    # Segment ids: invalid slots overflow into bucket B (dropped on slice).
+    seg = jnp.where(valid, m.assignment, B).reshape(-1)          # [P*R]
+
+    def bsum(data_flat, num=B + 1):
+        return jax.ops.segment_sum(data_flat, seg, num_segments=num)[:B]
+
+    # Role-resolved per-slot loads [RES, P, R] -> broker_load [RES, B].
+    slot_load = m.replica_load
+    broker_load = jax.vmap(lambda d: bsum(d.reshape(-1)))(slot_load)
+
+    ones = valid.astype(jnp.int32).reshape(-1)
+    replica_count = bsum(ones)
+    leader_count = bsum(is_leader.astype(jnp.int32).reshape(-1))
+
+    # Potential NW_OUT: leader-role NW_OUT of every hosted replica
+    # (parity: ClusterModelStats potential nw-out used by PotentialNwOutGoal).
+    pot = jnp.where(valid, m.leader_load[Resource.NW_OUT][:, None], 0.0)
+    potential_nw_out = bsum(pot.reshape(-1))
+
+    lbi = jnp.where(is_leader, m.leader_load[Resource.NW_IN][:, None], 0.0)
+    leader_bytes_in = bsum(lbi.reshape(-1))
+
+    # (topic, broker) counts via combined segment ids.
+    tb = jnp.where(
+        valid, m.partition_topic[:, None] * B + m.assignment, T * B
+    ).reshape(-1)
+    topic_replica_count = jax.ops.segment_sum(
+        valid.astype(jnp.int32).reshape(-1), tb, num_segments=T * B + 1
+    )[: T * B].reshape(T, B)
+    topic_leader_count = jax.ops.segment_sum(
+        is_leader.astype(jnp.int32).reshape(-1), tb, num_segments=T * B + 1
+    )[: T * B].reshape(T, B)
+
+    # (broker, disk) DISK load for JBOD goals (role-resolved so it always
+    # column-sums to broker_load[DISK] even if a caller differentiates
+    # leader vs follower disk footprints).
+    bd = jnp.where(
+        valid & (m.replica_disk >= 0), m.assignment * D + m.replica_disk, B * D
+    ).reshape(-1)
+    disk_data = slot_load[Resource.DISK]
+    disk_load = jax.ops.segment_sum(
+        disk_data.reshape(-1), bd, num_segments=B * D + 1
+    )[: B * D].reshape(B, D)
+
+    return BrokerAggregates(
+        broker_load=broker_load,
+        replica_count=replica_count,
+        leader_count=leader_count,
+        potential_nw_out=potential_nw_out,
+        leader_bytes_in=leader_bytes_in,
+        topic_replica_count=topic_replica_count,
+        topic_leader_count=topic_leader_count,
+        disk_load=disk_load,
+    )

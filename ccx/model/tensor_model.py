@@ -1,0 +1,255 @@
+"""TensorClusterModel — the cluster as a frozen pytree of device arrays.
+
+This replaces the reference's mutable object tree ``model/ClusterModel.java``
+(racks -> hosts -> brokers -> disks -> replicas, SURVEY.md C1/C2): instead of
+objects with pointers, the cluster is a set of padded, statically-shaped
+arrays so the whole goal stack can be scored on TPU in one fused XLA program
+and thousands of candidate assignments can be vmapped.
+
+Layout (P = padded partitions, R = max replication factor, B = padded
+brokers, D = max disks/broker, T = topics; RES = NUM_RESOURCES):
+
+* ``assignment  : int32[P, R]``  broker index per replica slot, -1 = no slot.
+  Slot order is the *preferred* replica order (slot 0 = preferred leader,
+  mirroring Kafka's replica list order used by PreferredLeaderElectionGoal).
+* ``leader_slot : int32[P]``     which slot currently leads.
+* ``replica_disk: int32[P, R]``  disk index on the hosting broker (JBOD).
+* ``leader_load / follower_load : float32[RES, P]`` — the load a replica of
+  partition p exerts depending on role. Parity: the reference stores a
+  ``Load`` per replica and derives follower CPU/NW from the leader's via
+  ``model/ModelUtils.java`` (SURVEY.md C3/C6); we keep both role profiles so
+  leadership transfer re-weights loads without re-aggregation. NW_OUT of a
+  follower is 0 (only leaders serve consumers); follower NW_IN equals the
+  leader's NW_IN (replication traffic); DISK is role-independent.
+* broker-axis arrays: capacity, rack id, liveness, validity, new-broker and
+  exclusion masks; disk-axis capacity/liveness for JBOD.
+* ``partition_topic: int32[P]`` and topic-level masks (excluded topics,
+  min-leaders topics).
+
+Padding convention: invalid entries are masked (valid=False) and their loads
+are zero, so every kernel can reduce over full axes without branching.
+Pad sizes should be bucketed (powers of two) by the caller so XLA recompiles
+only per bucket, not per cluster size (SURVEY.md section 7.4 "shape dynamism").
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ccx.common.resources import NUM_RESOURCES, Resource
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket >= n (>= minimum)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@struct.dataclass
+class TensorClusterModel:
+    # --- partition / replica axis ---
+    assignment: jnp.ndarray        # int32[P, R]
+    leader_slot: jnp.ndarray       # int32[P]
+    replica_disk: jnp.ndarray      # int32[P, R]
+    partition_valid: jnp.ndarray   # bool[P]
+    partition_topic: jnp.ndarray   # int32[P]
+    partition_immovable: jnp.ndarray  # bool[P] (excluded-topics option)
+    leader_load: jnp.ndarray       # float32[RES, P]
+    follower_load: jnp.ndarray     # float32[RES, P]
+
+    # --- broker axis ---
+    broker_capacity: jnp.ndarray   # float32[RES, B]
+    broker_rack: jnp.ndarray       # int32[B]
+    broker_valid: jnp.ndarray      # bool[B]
+    broker_alive: jnp.ndarray      # bool[B]  (False => demoted-dead / failed)
+    broker_new: jnp.ndarray        # bool[B]  (added brokers, move-target pref)
+    broker_excl_replicas: jnp.ndarray    # bool[B] (may not *receive* replicas)
+    broker_excl_leadership: jnp.ndarray  # bool[B] (may not hold leadership)
+
+    # --- disk axis (JBOD) ---
+    disk_capacity: jnp.ndarray     # float32[B, D]
+    disk_alive: jnp.ndarray        # bool[B, D]
+
+    # --- topic axis ---
+    topic_min_leaders: jnp.ndarray  # bool[T] (MinTopicLeadersPerBrokerGoal set)
+
+    # --- static metadata (not traced) ---
+    num_topics: int = struct.field(pytree_node=False)
+    num_racks: int = struct.field(pytree_node=False)
+
+    # ----- shapes -----
+    @property
+    def P(self) -> int:
+        return self.assignment.shape[0]
+
+    @property
+    def R(self) -> int:
+        return self.assignment.shape[1]
+
+    @property
+    def B(self) -> int:
+        return self.broker_rack.shape[0]
+
+    @property
+    def D(self) -> int:
+        return self.disk_capacity.shape[1]
+
+    @property
+    def replica_valid(self) -> jnp.ndarray:
+        """bool[P, R] — slot holds a replica."""
+        return (self.assignment >= 0) & self.partition_valid[:, None]
+
+    @property
+    def is_leader(self) -> jnp.ndarray:
+        """bool[P, R] — slot is the current leader of its partition."""
+        slot_ids = jnp.arange(self.R, dtype=jnp.int32)[None, :]
+        return (slot_ids == self.leader_slot[:, None]) & self.replica_valid
+
+    @property
+    def replica_load(self) -> jnp.ndarray:
+        """float32[RES, P, R] — role-resolved load of each replica slot."""
+        lead = self.is_leader[None, :, :]
+        load = jnp.where(
+            lead, self.leader_load[:, :, None], self.follower_load[:, :, None]
+        )
+        return jnp.where(self.replica_valid[None, :, :], load, 0.0)
+
+    @property
+    def n_alive_brokers(self) -> jnp.ndarray:
+        return jnp.sum(self.broker_valid & self.broker_alive)
+
+    @property
+    def n_partitions(self) -> jnp.ndarray:
+        return jnp.sum(self.partition_valid)
+
+    @property
+    def n_replicas(self) -> jnp.ndarray:
+        return jnp.sum(self.replica_valid)
+
+
+def build_model(
+    *,
+    assignment: np.ndarray,
+    leader_load: np.ndarray,
+    follower_load: np.ndarray,
+    broker_capacity: np.ndarray,
+    broker_rack: np.ndarray,
+    partition_topic: np.ndarray | None = None,
+    leader_slot: np.ndarray | None = None,
+    replica_disk: np.ndarray | None = None,
+    broker_alive: np.ndarray | None = None,
+    broker_new: np.ndarray | None = None,
+    broker_excl_replicas: np.ndarray | None = None,
+    broker_excl_leadership: np.ndarray | None = None,
+    partition_immovable: np.ndarray | None = None,
+    disk_capacity: np.ndarray | None = None,
+    disk_alive: np.ndarray | None = None,
+    topic_min_leaders: np.ndarray | None = None,
+    num_racks: int | None = None,
+    pad: bool = True,
+) -> TensorClusterModel:
+    """Assemble + pad a TensorClusterModel from dense numpy inputs.
+
+    ``assignment`` is int[P, R] with -1 for absent slots; all other arrays are
+    unpadded and sized to the true P / B / D / T. With ``pad=True`` the P and
+    B axes are grown to power-of-two buckets so repeated builds of similar
+    clusters hit the jit cache.
+    """
+    assignment = np.asarray(assignment, np.int32)
+    P, R = assignment.shape
+    B = int(np.asarray(broker_rack).shape[0])
+    leader_load = np.asarray(leader_load, np.float32).reshape(NUM_RESOURCES, P)
+    follower_load = np.asarray(follower_load, np.float32).reshape(NUM_RESOURCES, P)
+    broker_capacity = np.asarray(broker_capacity, np.float32).reshape(NUM_RESOURCES, B)
+    broker_rack = np.asarray(broker_rack, np.int32)
+
+    if partition_topic is None:
+        partition_topic = np.zeros(P, np.int32)
+    partition_topic = np.asarray(partition_topic, np.int32)
+    T = int(partition_topic.max(initial=0)) + 1
+    if leader_slot is None:
+        leader_slot = np.zeros(P, np.int32)
+    if replica_disk is None:
+        replica_disk = np.where(assignment >= 0, 0, -1).astype(np.int32)
+    if disk_capacity is None:
+        # Single-disk brokers: the disk is the broker's DISK capacity.
+        disk_capacity = broker_capacity[Resource.DISK][:, None].copy()
+    disk_capacity = np.asarray(disk_capacity, np.float32)
+    D = disk_capacity.shape[1]
+    if disk_alive is None:
+        disk_alive = np.ones((B, D), bool)
+    if broker_alive is None:
+        broker_alive = np.ones(B, bool)
+    if broker_new is None:
+        broker_new = np.zeros(B, bool)
+    if broker_excl_replicas is None:
+        broker_excl_replicas = np.zeros(B, bool)
+    if broker_excl_leadership is None:
+        broker_excl_leadership = np.zeros(B, bool)
+    if partition_immovable is None:
+        partition_immovable = np.zeros(P, bool)
+    if topic_min_leaders is None:
+        topic_min_leaders = np.zeros(T, bool)
+    topic_min_leaders = np.asarray(topic_min_leaders, bool)
+    T = max(T, topic_min_leaders.shape[0])
+    if pad:
+        # Bucket T too — topic-count jitter otherwise changes the [T, B]
+        # aggregate shapes and defeats the jit cache.
+        T = bucket_size(T, 4)
+    topic_min_leaders = np.pad(topic_min_leaders, (0, T - topic_min_leaders.shape[0]))
+    if num_racks is None:
+        num_racks = int(broker_rack.max(initial=0)) + 1
+
+    if pad:
+        Pp, Bp = bucket_size(P, 64), bucket_size(B, 8)
+    else:
+        Pp, Bp = P, B
+
+    def pad_p(a: np.ndarray, fill: Any = 0) -> np.ndarray:
+        width = [(0, Pp - P)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, width, constant_values=fill)
+
+    def pad_b(a: np.ndarray, fill: Any = 0, axis: int = 0) -> np.ndarray:
+        width = [(0, 0)] * a.ndim
+        width[axis] = (0, Bp - B)
+        return np.pad(a, width, constant_values=fill)
+
+    partition_valid = pad_p(np.ones(P, bool))
+    broker_valid = pad_b(np.ones(B, bool))
+
+    return TensorClusterModel(
+        assignment=jnp.asarray(pad_p(assignment, -1)),
+        leader_slot=jnp.asarray(pad_p(np.asarray(leader_slot, np.int32))),
+        replica_disk=jnp.asarray(pad_p(np.asarray(replica_disk, np.int32), -1)),
+        partition_valid=jnp.asarray(partition_valid),
+        partition_topic=jnp.asarray(pad_p(partition_topic)),
+        partition_immovable=jnp.asarray(pad_p(np.asarray(partition_immovable, bool))),
+        leader_load=jnp.asarray(np.pad(leader_load, [(0, 0), (0, Pp - P)])),
+        follower_load=jnp.asarray(np.pad(follower_load, [(0, 0), (0, Pp - P)])),
+        broker_capacity=jnp.asarray(pad_b(broker_capacity, axis=1)),
+        broker_rack=jnp.asarray(pad_b(broker_rack)),
+        broker_valid=jnp.asarray(broker_valid),
+        broker_alive=jnp.asarray(pad_b(np.asarray(broker_alive, bool))),
+        broker_new=jnp.asarray(pad_b(np.asarray(broker_new, bool))),
+        broker_excl_replicas=jnp.asarray(
+            pad_b(np.asarray(broker_excl_replicas, bool))
+        ),
+        broker_excl_leadership=jnp.asarray(
+            pad_b(np.asarray(broker_excl_leadership, bool))
+        ),
+        disk_capacity=jnp.asarray(pad_b(disk_capacity)),
+        disk_alive=jnp.asarray(pad_b(np.asarray(disk_alive, bool))),
+        topic_min_leaders=jnp.asarray(topic_min_leaders),
+        num_topics=T,
+        num_racks=num_racks,
+    )
+
+
+def model_dims(m: TensorClusterModel) -> dict[str, int]:
+    return {"P": m.P, "R": m.R, "B": m.B, "D": m.D, "T": m.num_topics}

@@ -1,0 +1,24 @@
+"""Test harness: force CPU JAX with 8 virtual devices.
+
+Parity with the reference's test strategy (SURVEY.md section 4): upstream
+tests run against embedded in-process Kafka instead of a real cluster; here
+CPU-backend JAX with a virtual 8-device mesh plays that role so the full
+pjit/sharding path is exercised without TPU hardware.
+
+Note: the environment preloads jax via sitecustomize with the axon TPU
+platform, so env vars alone are too late — jax.config must be updated before
+the first backend initialization (which is lazy, so this works).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,78 @@
+import jax
+import numpy as np
+
+from ccx.common.resources import Resource
+from ccx.model.aggregates import broker_aggregates
+from ccx.model.fixtures import (
+    RandomClusterSpec,
+    random_cluster,
+    small_deterministic,
+)
+
+
+def test_small_deterministic_shapes():
+    m = small_deterministic()
+    assert m.P == 3 and m.R == 3 and m.B == 3
+    assert int(m.n_partitions) == 3
+    assert int(m.n_replicas) == 7
+    assert int(m.n_alive_brokers) == 3
+
+
+def test_aggregates_match_hand_computed():
+    m = small_deterministic()
+    agg = broker_aggregates(m)
+    # Leaders: A-0 -> broker 0, A-1 -> broker 1, B-0 -> broker 0.
+    np.testing.assert_array_equal(np.asarray(agg.leader_count), [2, 1, 0])
+    np.testing.assert_array_equal(np.asarray(agg.replica_count), [2, 3, 2])
+    # CPU: broker0 = 20 (A0 lead) + 5 (B0 lead) = 25
+    #      broker1 = 10 (A0 follow: 20*0.5) + 10 (A1 lead) + 2.5 (B0 follow)
+    #      broker2 = 5 (A1 follow) + 2.5 (B0 follow)
+    cpu = np.asarray(agg.broker_load[Resource.CPU])
+    np.testing.assert_allclose(cpu, [25.0, 22.5, 7.5], rtol=1e-6)
+    # NW_OUT only from leaders: b0 = 80 + 10, b1 = 40, b2 = 0.
+    nwo = np.asarray(agg.broker_load[Resource.NW_OUT])
+    np.testing.assert_allclose(nwo, [90.0, 40.0, 0.0], rtol=1e-6)
+    # Potential nw-out counts every hosted replica's leader NW_OUT.
+    pot = np.asarray(agg.potential_nw_out)
+    np.testing.assert_allclose(pot, [80 + 10, 80 + 40 + 10, 40 + 10], rtol=1e-6)
+    # Topic-replica counts: topic A spread 1/2/1, topic B 1/1/1.
+    np.testing.assert_array_equal(
+        np.asarray(agg.topic_replica_count), [[1, 2, 1], [1, 1, 1]]
+    )
+
+
+def test_aggregates_conserve_totals_random():
+    m = random_cluster(RandomClusterSpec(n_partitions=200, seed=7))
+    agg = broker_aggregates(m)
+    # Total broker load equals total role-resolved replica load.
+    total_from_brokers = np.asarray(agg.broker_load).sum(axis=1)
+    total_from_replicas = np.asarray(m.replica_load).sum(axis=(1, 2))
+    np.testing.assert_allclose(total_from_brokers, total_from_replicas, rtol=1e-5)
+    assert int(np.asarray(agg.leader_count).sum()) == int(m.n_partitions)
+    assert int(np.asarray(agg.replica_count).sum()) == int(m.n_replicas)
+    # Disk load column-sums to DISK broker load (single-disk default).
+    np.testing.assert_allclose(
+        np.asarray(agg.disk_load).sum(axis=1),
+        np.asarray(agg.broker_load[Resource.DISK]),
+        rtol=1e-5,
+    )
+
+
+def test_aggregates_jit_and_vmap():
+    m = random_cluster(RandomClusterSpec(n_partitions=100, seed=3))
+    jitted = jax.jit(broker_aggregates)
+    agg = jitted(m)
+    assert agg.broker_load.shape[1] == m.B
+    # vmap over a batch of candidate assignments (the SA batch axis).
+    batch_assign = jax.numpy.stack([m.assignment, m.assignment])
+
+    def with_assign(a):
+        return broker_aggregates(m.replace(assignment=a)).replica_count
+
+    counts = jax.vmap(with_assign)(batch_assign)
+    assert counts.shape == (2, m.B)
+    np.testing.assert_array_equal(np.asarray(counts[0]), np.asarray(counts[1]))
+
+
+def test_virtual_mesh_available():
+    assert jax.device_count() == 8
