@@ -1,0 +1,8 @@
+from ccx.goals.base import GoalConfig, GoalResult, GOAL_REGISTRY  # noqa: F401
+from ccx.goals.stack import (  # noqa: F401
+    DEFAULT_GOAL_ORDER,
+    DEFAULT_HARD_GOALS,
+    StackResult,
+    evaluate_stack,
+    scalar_cost,
+)

@@ -1,0 +1,98 @@
+"""Goal SPI — each reference Goal class becomes a pure penalty kernel.
+
+Parity: the reference's ``analyzer/goals/Goal.java`` SPI (SURVEY.md C15)
+exposes ``optimize(clusterModel, ...)`` + ``actionAcceptance(action, model)``
+and mutates the model greedily. The TPU-native re-design inverts this: a goal
+is a *pure function* ``(model, aggregates, config) -> GoalResult`` scoring a
+candidate state, vmappable over thousands of candidates; search (ccx.search)
+owns all mutation. Priority semantics (hard goals as feasibility, soft goals
+lexicographically tiered) are applied by ccx.goals.stack.
+
+Every goal registers under the reference class name (e.g. "RackAwareGoal")
+so configs, REST parameters, and parity tests use the same vocabulary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Protocol
+
+import jax.numpy as jnp
+from flax import struct
+
+from ccx.common.resources import (
+    DEFAULT_BALANCE_THRESHOLD,
+    DEFAULT_CAPACITY_THRESHOLD,
+    Resource,
+)
+
+_CAPACITY_DEFAULT = tuple(DEFAULT_CAPACITY_THRESHOLD[r] for r in Resource)
+_BALANCE_DEFAULT = tuple(DEFAULT_BALANCE_THRESHOLD[r] for r in Resource)
+
+
+@dataclasses.dataclass(frozen=True)
+class GoalConfig:
+    """Static analyzer thresholds (hashable => usable as a jit static arg).
+
+    Defaults mirror AnalyzerConfig keys (unverified against /root/reference;
+    SURVEY.md provenance banner):
+      cpu/disk/network capacity thresholds   `*.capacity.threshold`
+      resource balance thresholds            `*.balance.threshold` (1.1)
+      replica / leader count balance         `*.count.balance.threshold` (1.1)
+      topic replica balance                  `topic.replica.count.balance.threshold`
+      max replicas per broker                `max.replicas.per.broker`
+      min topic leaders per broker           `min.topic.leaders.per.broker`
+      low-utilization gate                   `*.low.utilization.threshold` (0.0)
+    """
+
+    capacity_threshold: tuple[float, float, float, float] = _CAPACITY_DEFAULT
+    balance_threshold: tuple[float, float, float, float] = _BALANCE_DEFAULT
+    low_utilization_threshold: tuple[float, float, float, float] = (0.0,) * 4
+    replica_balance_threshold: float = 1.1
+    leader_balance_threshold: float = 1.1
+    topic_replica_balance_threshold: float = 1.1
+    leader_bytes_in_balance_threshold: float = 1.1
+    max_replicas_per_broker: float = 10_000.0
+    min_topic_leaders_per_broker: int = 1
+    intra_disk_capacity_threshold: float = 0.8
+    intra_disk_balance_gap: float = 0.2  # |disk util - broker avg util| allowed
+
+
+@struct.dataclass
+class GoalResult:
+    """violations: discrete count (verification / reporting); cost: smooth
+    normalized penalty the annealer descends. Both 0 when satisfied."""
+
+    violations: jnp.ndarray  # f32 scalar
+    cost: jnp.ndarray       # f32 scalar
+
+
+class GoalFn(Protocol):
+    def __call__(self, m, agg, cfg: GoalConfig) -> GoalResult: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class GoalSpec:
+    name: str
+    fn: GoalFn
+    hard: bool
+    #: reference class this corresponds to (for parity bookkeeping)
+    ref_class: str = ""
+
+
+GOAL_REGISTRY: dict[str, GoalSpec] = {}
+
+
+def register_goal(name: str, *, hard: bool, ref_class: str = "") -> Callable[[GoalFn], GoalFn]:
+    def deco(fn: GoalFn) -> GoalFn:
+        GOAL_REGISTRY[name] = GoalSpec(name=name, fn=fn, hard=hard, ref_class=ref_class or name)
+        return fn
+
+    return deco
+
+
+def result(violations, cost) -> GoalResult:
+    return GoalResult(
+        violations=jnp.asarray(violations, jnp.float32),
+        cost=jnp.asarray(cost, jnp.float32),
+    )

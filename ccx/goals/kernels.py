@@ -1,0 +1,303 @@
+"""The built-in goal stack as penalty kernels.
+
+Each function mirrors one reference goal class from
+``analyzer/goals/`` (SURVEY.md C16-C18; class names in register_goal).
+Semantics reconstructed from upstream behavior — re-verify against the
+reference source when the mount is restored (SURVEY.md section 7.4
+"fidelity debt").
+
+Conventions:
+* Averages/bands are computed over *alive, valid* brokers — dead brokers
+  must end up empty, which the structural liveness term enforces.
+* ``violations`` counts discrete offenders (brokers, partitions or
+  replicas, matching what the reference's per-goal optimization would
+  still find unbalanced); ``cost`` is a smooth normalized hinge the
+  annealer can descend.
+* All kernels are pure, jit-safe, and vmappable over batched aggregates.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ccx.common.resources import Resource
+from ccx.goals.base import GoalConfig, GoalResult, register_goal, result
+from ccx.model.aggregates import BrokerAggregates
+from ccx.model.tensor_model import TensorClusterModel
+
+
+def _alive(m: TensorClusterModel) -> jnp.ndarray:
+    return m.broker_valid & m.broker_alive
+
+
+def _safe(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(x > 0, x, 1.0)
+
+
+def _n_alive(m: TensorClusterModel) -> jnp.ndarray:
+    return jnp.maximum(jnp.sum(_alive(m)), 1).astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Structural feasibility (implicit in every reference goal's requirements):
+# replicas must not sit on dead brokers / dead disks, leadership must not sit
+# on leadership-excluded brokers, and a partition must not have two replicas
+# on the same broker. The reference enforces these inside goal optimization
+# (e.g. self-healing moves off dead brokers first); here they are one
+# always-on top-priority hard term.
+# --------------------------------------------------------------------------
+@register_goal("StructuralFeasibility", hard=True, ref_class="ClusterModel invariants + self-healing requirements")
+def structural_feasibility(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    valid = m.replica_valid
+    B = m.B
+    safe_b = jnp.clip(m.assignment, 0, B - 1)
+
+    on_dead = valid & ~(m.broker_alive & m.broker_valid)[safe_b]
+    # dead disk: replica's disk offline (untracked placements, disk=-1, are
+    # not on any disk — mirror the aggregates.py masking)
+    D = m.D
+    safe_d = jnp.clip(m.replica_disk, 0, D - 1)
+    on_dead_disk = valid & (m.replica_disk >= 0) & ~m.disk_alive[safe_b, safe_d]
+
+    lead_b = jnp.take_along_axis(safe_b, jnp.clip(m.leader_slot, 0, m.R - 1)[:, None], axis=1)[:, 0]
+    lead_excl = m.partition_valid & m.broker_excl_leadership[lead_b]
+
+    # duplicate broker within a partition's replica set
+    a = jnp.where(valid, m.assignment, -jnp.arange(1, m.R + 1)[None, :])
+    dup = (a[:, :, None] == a[:, None, :]) & (jnp.arange(m.R)[:, None] < jnp.arange(m.R)[None, :])
+    dup_count = jnp.sum(dup & valid[:, :, None] & valid[:, None, :])
+
+    n = (
+        jnp.sum(on_dead)
+        + jnp.sum(on_dead_disk & ~on_dead)
+        + jnp.sum(lead_excl)
+        + dup_count
+    ).astype(jnp.float32)
+    return result(n, n)
+
+
+# --------------------------------------------------------------------------
+# Rack awareness
+# --------------------------------------------------------------------------
+def _rack_counts(m: TensorClusterModel) -> jnp.ndarray:
+    """int32[P, n_racks] — replicas of partition p in each rack."""
+    valid = m.replica_valid
+    safe_b = jnp.clip(m.assignment, 0, m.B - 1)
+    racks = m.broker_rack[safe_b]  # [P, R]
+    onehot = (racks[:, :, None] == jnp.arange(m.num_racks)[None, None, :]) & valid[:, :, None]
+    return jnp.sum(onehot.astype(jnp.int32), axis=1)
+
+
+@register_goal("RackAwareGoal", hard=True)
+def rack_aware(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    """Replicas of a partition live on distinct racks (ref: RackAwareGoal —
+    violation when two replicas share a rack, fixable while rf <= #racks)."""
+    counts = _rack_counts(m)
+    over = jnp.maximum(counts - 1, 0)
+    n = jnp.sum(over).astype(jnp.float32)
+    return result(n, n)
+
+
+@register_goal("RackAwareDistributionGoal", hard=True)
+def rack_aware_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    """Replicas of a partition spread evenly over racks: no rack holds more
+    than ceil(rf / #racks) (ref: RackAwareDistributionGoal, which relaxes
+    RackAwareGoal for rf > #racks)."""
+    counts = _rack_counts(m)
+    rf = jnp.sum(m.replica_valid, axis=1)
+    cap = jnp.ceil(rf / jnp.maximum(m.num_racks, 1)).astype(jnp.int32)
+    over = jnp.maximum(counts - cap[:, None], 0)
+    n = jnp.sum(over).astype(jnp.float32)
+    return result(n, n)
+
+
+# --------------------------------------------------------------------------
+# Capacity goals (hard)
+# --------------------------------------------------------------------------
+def _capacity_goal(res: Resource):
+    def fn(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+        alive = _alive(m)
+        cap = m.broker_capacity[res] * cfg.capacity_threshold[int(res)]
+        load = agg.broker_load[res]
+        excess = jnp.where(alive, jnp.maximum(load - cap, 0.0), 0.0)
+        n = jnp.sum(excess > 0).astype(jnp.float32)
+        return result(n, jnp.sum(excess / _safe(cap)))
+
+    return fn
+
+
+register_goal("CpuCapacityGoal", hard=True)(_capacity_goal(Resource.CPU))
+register_goal("NetworkInboundCapacityGoal", hard=True)(_capacity_goal(Resource.NW_IN))
+register_goal("NetworkOutboundCapacityGoal", hard=True)(_capacity_goal(Resource.NW_OUT))
+register_goal("DiskCapacityGoal", hard=True)(_capacity_goal(Resource.DISK))
+
+
+@register_goal("ReplicaCapacityGoal", hard=True)
+def replica_capacity(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    alive = _alive(m)
+    over = jnp.where(alive, jnp.maximum(agg.replica_count - cfg.max_replicas_per_broker, 0.0), 0.0)
+    n = jnp.sum(over > 0).astype(jnp.float32)
+    return result(n, jnp.sum(over) / cfg.max_replicas_per_broker)
+
+
+@register_goal("MinTopicLeadersPerBrokerGoal", hard=True)
+def min_topic_leaders(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    """Each alive broker hosts >= k leaders of each flagged topic (ref:
+    MinTopicLeadersPerBrokerGoal over `topics.with.min.leaders.per.broker`)."""
+    alive = _alive(m) & ~m.broker_excl_leadership
+    k = cfg.min_topic_leaders_per_broker
+    deficit = jnp.maximum(k - agg.topic_leader_count, 0)  # [T, B]
+    deficit = jnp.where(m.topic_min_leaders[:, None] & alive[None, :], deficit, 0)
+    n = jnp.sum(deficit).astype(jnp.float32)
+    return result(n, n)
+
+
+# --------------------------------------------------------------------------
+# Distribution (soft) goals
+# --------------------------------------------------------------------------
+def _band_penalty(values, alive, avg, threshold):
+    """Hinge penalty outside [avg*(2-t), avg*t], normalized by avg."""
+    upper = avg * threshold
+    lower = avg * (2.0 - threshold)
+    over = jnp.maximum(values - upper, 0.0)
+    under = jnp.maximum(lower - values, 0.0)
+    pen = jnp.where(alive, over + under, 0.0)
+    n = jnp.sum(pen > 0).astype(jnp.float32)
+    return n, jnp.sum(pen) / _safe(avg)
+
+
+def _usage_distribution_goal(res: Resource):
+    def fn(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+        """Broker utilization % within band around cluster-avg utilization %
+        (ref: ResourceDistributionGoal subclasses; low-utilization gate per
+        `*.low.utilization.threshold`)."""
+        alive = _alive(m)
+        cap = jnp.where(alive, m.broker_capacity[res], 0.0)
+        load = jnp.where(alive, agg.broker_load[res], 0.0)
+        avg_util = jnp.sum(load) / _safe(jnp.sum(cap))
+        util = load / _safe(m.broker_capacity[res])
+        t = cfg.balance_threshold[int(res)]
+        n, cost = _band_penalty(util, alive, avg_util, t)
+        gate = avg_util > cfg.low_utilization_threshold[int(res)]
+        return result(jnp.where(gate, n, 0.0), jnp.where(gate, cost, 0.0))
+
+    return fn
+
+
+register_goal("CpuUsageDistributionGoal", hard=False)(_usage_distribution_goal(Resource.CPU))
+register_goal("NetworkInboundUsageDistributionGoal", hard=False)(_usage_distribution_goal(Resource.NW_IN))
+register_goal("NetworkOutboundUsageDistributionGoal", hard=False)(_usage_distribution_goal(Resource.NW_OUT))
+register_goal("DiskUsageDistributionGoal", hard=False)(_usage_distribution_goal(Resource.DISK))
+
+
+@register_goal("ReplicaDistributionGoal", hard=False)
+def replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    alive = _alive(m)
+    avg = m.n_replicas.astype(jnp.float32) / _n_alive(m)
+    n, cost = _band_penalty(agg.replica_count.astype(jnp.float32), alive, avg, cfg.replica_balance_threshold)
+    return result(n, cost)
+
+
+@register_goal("LeaderReplicaDistributionGoal", hard=False)
+def leader_replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    alive = _alive(m) & ~m.broker_excl_leadership
+    avg = m.n_partitions.astype(jnp.float32) / jnp.maximum(jnp.sum(alive), 1)
+    n, cost = _band_penalty(agg.leader_count.astype(jnp.float32), alive, avg, cfg.leader_balance_threshold)
+    return result(n, cost)
+
+
+@register_goal("TopicReplicaDistributionGoal", hard=False)
+def topic_replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    alive = _alive(m)
+    n_alive = _n_alive(m)
+    totals = jnp.sum(jnp.where(alive[None, :], agg.topic_replica_count, 0), axis=1)  # [T]
+    avg = totals.astype(jnp.float32) / n_alive
+    t = cfg.topic_replica_balance_threshold
+    upper = jnp.ceil(avg * t)[:, None]
+    lower = jnp.floor(avg * (2.0 - t))[:, None]
+    counts = agg.topic_replica_count.astype(jnp.float32)
+    pen = jnp.maximum(counts - upper, 0.0) + jnp.maximum(lower - counts, 0.0)
+    pen = jnp.where(alive[None, :], pen, 0.0)
+    n = jnp.sum(pen > 0).astype(jnp.float32)
+    return result(n, jnp.sum(pen) / _safe(jnp.mean(jnp.maximum(avg, 1.0))))
+
+
+@register_goal("LeaderBytesInDistributionGoal", hard=False)
+def leader_bytes_in_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    alive = _alive(m) & ~m.broker_excl_leadership
+    lbi = jnp.where(alive, agg.leader_bytes_in, 0.0)
+    avg = jnp.sum(lbi) / jnp.maximum(jnp.sum(alive), 1)
+    n, cost = _band_penalty(lbi, alive, avg, cfg.leader_bytes_in_balance_threshold)
+    return result(n, cost)
+
+
+@register_goal("PotentialNwOutGoal", hard=False)
+def potential_nw_out(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    """Cap the *potential* outbound a broker would serve if it led every
+    hosted replica (ref: PotentialNwOutGoal)."""
+    alive = _alive(m)
+    cap = m.broker_capacity[Resource.NW_OUT] * cfg.capacity_threshold[int(Resource.NW_OUT)]
+    excess = jnp.where(alive, jnp.maximum(agg.potential_nw_out - cap, 0.0), 0.0)
+    n = jnp.sum(excess > 0).astype(jnp.float32)
+    return result(n, jnp.sum(excess / _safe(cap)))
+
+
+@register_goal("PreferredLeaderElectionGoal", hard=False)
+def preferred_leader_election(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    """Leadership on the preferred (slot-0) replica when it is eligible."""
+    safe_b0 = jnp.clip(m.assignment[:, 0], 0, m.B - 1)
+    eligible = (
+        m.partition_valid
+        & (m.assignment[:, 0] >= 0)
+        & (m.broker_alive & m.broker_valid & ~m.broker_excl_leadership)[safe_b0]
+    )
+    n = jnp.sum(eligible & (m.leader_slot != 0)).astype(jnp.float32)
+    return result(n, n / jnp.maximum(m.n_partitions.astype(jnp.float32), 1.0))
+
+
+# --------------------------------------------------------------------------
+# Intra-broker (JBOD) goals
+# --------------------------------------------------------------------------
+@register_goal("IntraBrokerDiskCapacityGoal", hard=True)
+def intra_disk_capacity(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    alive = (_alive(m)[:, None]) & m.disk_alive
+    cap = m.disk_capacity * cfg.intra_disk_capacity_threshold
+    excess = jnp.where(alive, jnp.maximum(agg.disk_load - cap, 0.0), 0.0)
+    n = jnp.sum(excess > 0).astype(jnp.float32)
+    return result(n, jnp.sum(excess / _safe(cap)))
+
+
+@register_goal("IntraBrokerDiskUsageDistributionGoal", hard=False)
+def intra_disk_usage_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    """Disk utilizations within a broker stay within `intra_disk_balance_gap`
+    of the broker's mean disk utilization (ref:
+    IntraBrokerDiskUsageDistributionGoal)."""
+    alive = (_alive(m)[:, None]) & m.disk_alive
+    util = jnp.where(alive, agg.disk_load / _safe(m.disk_capacity), 0.0)
+    n_disks = jnp.maximum(jnp.sum(alive, axis=1), 1)
+    broker_avg = jnp.sum(util, axis=1) / n_disks
+    dev = jnp.abs(util - broker_avg[:, None]) - cfg.intra_disk_balance_gap
+    pen = jnp.where(alive, jnp.maximum(dev, 0.0), 0.0)
+    n = jnp.sum(pen > 0).astype(jnp.float32)
+    return result(n, jnp.sum(pen))
+
+
+# --------------------------------------------------------------------------
+# KafkaAssigner compatibility mode (SURVEY.md C19)
+# --------------------------------------------------------------------------
+@register_goal("KafkaAssignerEvenRackAwareGoal", hard=True)
+def kafka_assigner_even_rack_aware(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
+    """KafkaAssigner mode: rack-distinct replicas AND leaders evenly spread
+    over brokers (ref: KafkaAssignerEvenRackAwareGoal)."""
+    ra = rack_aware(m, agg, cfg)
+    alive = _alive(m)
+    avg = m.n_partitions.astype(jnp.float32) / _n_alive(m)
+    upper = jnp.ceil(avg)
+    over = jnp.where(alive, jnp.maximum(agg.leader_count - upper, 0.0), 0.0)
+    n = ra.violations + jnp.sum(over > 0).astype(jnp.float32)
+    return result(n, ra.cost + jnp.sum(over) / _safe(avg))
+
+
+register_goal("KafkaAssignerDiskUsageDistributionGoal", hard=False)(
+    _usage_distribution_goal(Resource.DISK)
+)

@@ -1,0 +1,148 @@
+"""Goal-stack evaluation — lexicographic priority as tiered scalarization.
+
+Parity: ``analyzer/GoalOptimizer.java`` (SURVEY.md C14) runs goals
+sequentially in priority order, later goals forbidden from breaking earlier
+ones via ``actionAcceptance``. A single device-side scalar cannot reproduce
+that exactly (SURVEY.md section 7.4), so the rebuild uses:
+
+* hard goals -> one large-weight infeasibility term (search also masks
+  obviously-infeasible moves up front);
+* soft goals -> geometrically-tiered weights in priority order, so a
+  higher-priority improvement always dominates any lower-priority regression
+  the annealer could trade for it (within float32 resolution);
+* a final greedy repair/polish pass (ccx.search) re-establishes hard goals
+  exactly; the verifier (ccx.verify) checks the reference's post-conditions
+  rather than move-for-move parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from ccx.goals import kernels  # noqa: F401  (populates the registry)
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.model.aggregates import BrokerAggregates, broker_aggregates
+from ccx.model.tensor_model import TensorClusterModel
+
+#: Default priority order — AnalyzerConfig `goals` default (SURVEY.md
+#: section 2.3), with the structural-liveness term always first.
+#: RackAwareDistributionGoal is registered but not in the default stack
+#: (it is the configurable alternative to RackAwareGoal, as upstream).
+DEFAULT_GOAL_ORDER: tuple[str, ...] = (
+    "StructuralFeasibility",
+    "RackAwareGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+    "PreferredLeaderElectionGoal",
+)
+
+#: AnalyzerConfig `hard.goals` default set — derived from the registry's
+#: per-goal hard flags so there is a single source of truth.
+DEFAULT_HARD_GOALS: tuple[str, ...] = tuple(
+    n for n in DEFAULT_GOAL_ORDER if GOAL_REGISTRY[n].hard
+)
+
+#: Goal stack for the rebalance_disk endpoint (SURVEY.md C18).
+INTRA_BROKER_GOAL_ORDER: tuple[str, ...] = (
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
+)
+
+HARD_WEIGHT = 1e6
+SOFT_TIER_BASE = 4.0
+
+
+@struct.dataclass
+class StackResult:
+    names: tuple[str, ...] = struct.field(pytree_node=False)
+    hard_mask: tuple[bool, ...] = struct.field(pytree_node=False)
+    violations: jnp.ndarray  # f32[n_goals]
+    costs: jnp.ndarray       # f32[n_goals]
+
+    @property
+    def hard_violations(self) -> jnp.ndarray:
+        mask = jnp.asarray(self.hard_mask)
+        return jnp.sum(jnp.where(mask, self.violations, 0.0))
+
+    @property
+    def hard_cost(self) -> jnp.ndarray:
+        mask = jnp.asarray(self.hard_mask)
+        return jnp.sum(jnp.where(mask, self.costs, 0.0))
+
+    @property
+    def soft_scalar(self) -> jnp.ndarray:
+        """Tier-weighted soft cost only. Search compares (hard_cost,
+        soft_scalar) lexicographically — folding both into one float32
+        (see ``scalar``) would erase soft deltas below the ULP of the huge
+        hard term exactly while the annealer is repairing infeasibility."""
+        mask = jnp.asarray(self.hard_mask)
+        return jnp.sum(jnp.where(mask, 0.0, self.costs * soft_weights(self.hard_mask)))
+
+    @property
+    def scalar(self) -> jnp.ndarray:
+        """Single-number summary for reporting/telemetry only; do not use
+        for acceptance decisions (float32 plateau — see soft_scalar)."""
+        return scalar_cost(self.costs, self.hard_mask)
+
+    def by_name(self) -> dict[str, tuple[float, float]]:
+        v = [float(x) for x in self.violations]
+        c = [float(x) for x in self.costs]
+        return {n: (v[i], c[i]) for i, n in enumerate(self.names)}
+
+
+def soft_weights(hard_mask: tuple[bool, ...]) -> jnp.ndarray:
+    """Tiered weights: hard goals get HARD_WEIGHT; soft goals decay
+    geometrically in priority order, first soft goal at weight 1."""
+    w = []
+    soft_rank = 0
+    for h in hard_mask:
+        if h:
+            w.append(HARD_WEIGHT)
+        else:
+            w.append(SOFT_TIER_BASE ** (-soft_rank))
+            soft_rank += 1
+    return jnp.asarray(w, jnp.float32)
+
+
+def scalar_cost(costs: jnp.ndarray, hard_mask: tuple[bool, ...]) -> jnp.ndarray:
+    return jnp.sum(costs * soft_weights(hard_mask))
+
+
+def evaluate_stack(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    agg: BrokerAggregates | None = None,
+) -> StackResult:
+    """Score one model state against an ordered goal stack (jit-safe; the
+    goal list and config are static, so each (stack, cfg) pair compiles once
+    and is then vmappable over candidate batches)."""
+    if agg is None:
+        agg = broker_aggregates(m)
+    violations, costs, hard_mask = [], [], []
+    for name in goal_names:
+        spec = GOAL_REGISTRY[name]
+        r = spec.fn(m, agg, cfg)
+        violations.append(r.violations)
+        costs.append(r.cost)
+        hard_mask.append(spec.hard)
+    return StackResult(
+        names=tuple(goal_names),
+        hard_mask=tuple(hard_mask),
+        violations=jnp.stack(violations),
+        costs=jnp.stack(costs),
+    )

@@ -1,0 +1,194 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccx.goals import (
+    DEFAULT_GOAL_ORDER,
+    GOAL_REGISTRY,
+    GoalConfig,
+    evaluate_stack,
+)
+from ccx.model.aggregates import broker_aggregates
+from ccx.model.fixtures import small_deterministic
+from ccx.model.tensor_model import build_model
+
+CFG = GoalConfig()
+
+
+def goal(name, m, cfg=CFG):
+    return GOAL_REGISTRY[name].fn(m, broker_aggregates(m), cfg)
+
+
+def four_broker_model(**kw):
+    """2 racks x 2 brokers; 2 partitions RF=2, crafted for goal tests."""
+    defaults = dict(
+        assignment=np.array([[0, 1], [2, 3]], np.int32),
+        leader_load=np.array(
+            [[10.0, 10.0], [40.0, 40.0], [30.0, 30.0], [100.0, 100.0]], np.float32
+        ),
+        follower_load=np.array(
+            [[5.0, 5.0], [40.0, 40.0], [0.0, 0.0], [100.0, 100.0]], np.float32
+        ),
+        broker_capacity=np.tile(
+            np.array([[100.0], [1000.0], [1000.0], [1000.0]], np.float32), (1, 4)
+        ),
+        broker_rack=np.array([0, 0, 1, 1], np.int32),
+        partition_topic=np.array([0, 1], np.int32),
+        pad=False,
+    )
+    defaults.update(kw)
+    return build_model(**defaults)
+
+
+class TestRackAware:
+    def test_no_violation_on_distinct_racks(self):
+        m = small_deterministic()
+        assert float(goal("RackAwareGoal", m).violations) == 0
+
+    def test_same_rack_pairs_counted_per_partition(self):
+        # racks are [0,0,1,1]: partition 0 on brokers 0,1 (rack 0,0) and
+        # partition 1 on brokers 2,3 (rack 1,1) -> one duplicate each.
+        m = four_broker_model()
+        assert float(goal("RackAwareGoal", m).violations) == 2
+        # cross-rack placement clears it.
+        m2 = four_broker_model(
+            assignment=np.array([[0, 2], [1, 3]], np.int32)
+        )
+        assert float(goal("RackAwareGoal", m2).violations) == 0
+
+    def test_rack_aware_distribution_allows_even_overflow(self):
+        # RF=3 over 2 racks: ceil(3/2)=2 per rack allowed.
+        m = four_broker_model(
+            assignment=np.array([[0, 1, 2], [1, 2, 3]], np.int32),
+        )
+        assert float(goal("RackAwareDistributionGoal", m).violations) == 0
+        # RackAwareGoal (strict distinct) must flag both partitions once each.
+        assert float(goal("RackAwareGoal", m).violations) == 2
+
+
+class TestCapacity:
+    def test_cpu_capacity_violation(self):
+        m = four_broker_model(
+            broker_capacity=np.tile(
+                np.array([[10.0], [1000.0], [1000.0], [1000.0]], np.float32),
+                (1, 4),
+            )
+        )
+        # leader CPU 10 > 10*0.7: brokers 0 and 2 over; followers 5 < 7: ok.
+        r = goal("CpuCapacityGoal", m)
+        assert float(r.violations) == 2
+        assert float(r.cost) == pytest.approx((10 - 7) / 7 * 2, rel=1e-5)
+
+    def test_replica_capacity(self):
+        m = four_broker_model()
+        cfg = GoalConfig(max_replicas_per_broker=0.5)
+        r = goal("ReplicaCapacityGoal", m, cfg)
+        assert float(r.violations) == 4  # every broker holds 1 > 0.5
+
+
+class TestStructural:
+    def test_dead_broker_replicas_flagged(self):
+        m = four_broker_model(broker_alive=np.array([False, True, True, True]))
+        r = goal("StructuralFeasibility", m)
+        assert float(r.violations) == 1  # one replica on broker 0
+
+    def test_duplicate_broker_in_partition(self):
+        m = four_broker_model(assignment=np.array([[0, 0], [2, 3]], np.int32))
+        assert float(goal("StructuralFeasibility", m).violations) == 1
+
+    def test_leadership_on_excluded_broker(self):
+        m = four_broker_model(
+            broker_excl_leadership=np.array([True, False, False, False])
+        )
+        # partition 0's leader is slot 0 -> broker 0 -> excluded.
+        assert float(goal("StructuralFeasibility", m).violations) == 1
+
+
+class TestDistribution:
+    def test_replica_distribution_balanced(self):
+        m = four_broker_model()
+        assert float(goal("ReplicaDistributionGoal", m).violations) == 0
+
+    def test_replica_distribution_skewed(self):
+        # all 4 replicas on brokers 0,1: avg=1, upper=1.1 -> 0-replica brokers
+        # below lower bound 0.9 and 2-replica brokers above.
+        m = four_broker_model(
+            assignment=np.array([[0, 1], [0, 1]], np.int32)
+        )
+        r = goal("ReplicaDistributionGoal", m)
+        assert float(r.violations) == 4
+
+    def test_leader_distribution(self):
+        # both leaders on broker 0.
+        m = four_broker_model(assignment=np.array([[0, 1], [0, 3]], np.int32))
+        r = goal("LeaderReplicaDistributionGoal", m)
+        # avg = 0.5; broker0 has 2 > 0.55; brokers 1..3 have 0 < 0.45.
+        assert float(r.violations) == 4
+
+    def test_min_topic_leaders(self):
+        m = four_broker_model(topic_min_leaders=np.array([True, False]))
+        # topic 0 has 1 leader (broker 0); brokers 1-3 have none -> 3 deficits.
+        r = goal("MinTopicLeadersPerBrokerGoal", m)
+        assert float(r.violations) == 3
+
+    def test_preferred_leader(self):
+        m = four_broker_model(leader_slot=np.array([1, 0], np.int32))
+        assert float(goal("PreferredLeaderElectionGoal", m).violations) == 1
+
+    def test_usage_distribution_low_util_gate(self):
+        m = four_broker_model()
+        cfg = GoalConfig(low_utilization_threshold=(1.0, 1.0, 1.0, 1.0))
+        for g in (
+            "CpuUsageDistributionGoal",
+            "DiskUsageDistributionGoal",
+            "NetworkInboundUsageDistributionGoal",
+        ):
+            assert float(goal(g, m, cfg).violations) == 0
+
+
+class TestIntraBroker:
+    def test_disk_capacity_and_balance(self):
+        # broker 0 has 2 disks; all load on disk 0.
+        m = four_broker_model(
+            replica_disk=np.array([[0, 0], [0, 0]], np.int32),
+            disk_capacity=np.full((4, 2), 100.0, np.float32),
+        )
+        r = goal("IntraBrokerDiskCapacityGoal", m)
+        # disk loads: broker0/disk0=100 > 80 -> 1 violation (others =100 too on
+        # brokers 1,2,3 with follower DISK load 100).
+        assert float(r.violations) == 4
+        r2 = goal("IntraBrokerDiskUsageDistributionGoal", m)
+        # each broker: disk0 util 1.0, disk1 util 0.0, avg 0.5, gap 0.2 ->
+        # both disks deviate 0.5 > 0.2 -> 8 violations.
+        assert float(r2.violations) == 8
+
+
+class TestStack:
+    def test_stack_jit_and_shapes(self):
+        m = small_deterministic()
+        res = jax.jit(
+            lambda mm: evaluate_stack(mm, CFG), static_argnums=()
+        )(m)
+        assert res.violations.shape == (len(DEFAULT_GOAL_ORDER),)
+        assert float(res.hard_violations) == 0.0
+        assert np.isfinite(float(res.scalar))
+
+    def test_stack_vmap_over_assignments(self):
+        m = small_deterministic()
+        batch = jnp.stack([m.assignment, m.assignment])
+
+        def score(a):
+            return evaluate_stack(m.replace(assignment=a), CFG).scalar
+
+        s = jax.vmap(score)(batch)
+        assert s.shape == (2,)
+        assert float(s[0]) == pytest.approx(float(s[1]))
+
+    def test_every_registered_goal_runs(self):
+        m = four_broker_model()
+        agg = broker_aggregates(m)
+        for name, spec in GOAL_REGISTRY.items():
+            r = spec.fn(m, agg, CFG)
+            assert np.isfinite(float(r.violations)), name
+            assert np.isfinite(float(r.cost)), name
