@@ -78,14 +78,27 @@ class GoalSpec:
     hard: bool
     #: reference class this corresponds to (for parity bookkeeping)
     ref_class: str = ""
+    #: True when the kernel reads per-partition placement (m.assignment /
+    #: m.leader_slot) rather than only aggregates + static broker attributes.
+    #: Such goals can only be searched incrementally if ccx.search maintains
+    #: their contribution sums (ccx.goals.partition_terms.PARTITION_GOALS).
+    placement_dependent: bool = False
 
 
 GOAL_REGISTRY: dict[str, GoalSpec] = {}
 
 
-def register_goal(name: str, *, hard: bool, ref_class: str = "") -> Callable[[GoalFn], GoalFn]:
+def register_goal(
+    name: str, *, hard: bool, ref_class: str = "", placement_dependent: bool = False
+) -> Callable[[GoalFn], GoalFn]:
     def deco(fn: GoalFn) -> GoalFn:
-        GOAL_REGISTRY[name] = GoalSpec(name=name, fn=fn, hard=hard, ref_class=ref_class or name)
+        GOAL_REGISTRY[name] = GoalSpec(
+            name=name,
+            fn=fn,
+            hard=hard,
+            ref_class=ref_class or name,
+            placement_dependent=placement_dependent,
+        )
         return fn
 
     return deco

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from ccx.common.resources import Resource
 from ccx.goals.base import GoalConfig, GoalResult, register_goal, result
+from ccx.goals import partition_terms as pt
 from ccx.model.aggregates import BrokerAggregates
 from ccx.model.tensor_model import TensorClusterModel
 
@@ -46,68 +47,33 @@ def _n_alive(m: TensorClusterModel) -> jnp.ndarray:
 # (e.g. self-healing moves off dead brokers first); here they are one
 # always-on top-priority hard term.
 # --------------------------------------------------------------------------
-@register_goal("StructuralFeasibility", hard=True, ref_class="ClusterModel invariants + self-healing requirements")
+@register_goal("StructuralFeasibility", hard=True, ref_class="ClusterModel invariants + self-healing requirements", placement_dependent=True)
 def structural_feasibility(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
-    valid = m.replica_valid
-    B = m.B
-    safe_b = jnp.clip(m.assignment, 0, B - 1)
-
-    on_dead = valid & ~(m.broker_alive & m.broker_valid)[safe_b]
-    # dead disk: replica's disk offline (untracked placements, disk=-1, are
-    # not on any disk — mirror the aggregates.py masking)
-    D = m.D
-    safe_d = jnp.clip(m.replica_disk, 0, D - 1)
-    on_dead_disk = valid & (m.replica_disk >= 0) & ~m.disk_alive[safe_b, safe_d]
-
-    lead_b = jnp.take_along_axis(safe_b, jnp.clip(m.leader_slot, 0, m.R - 1)[:, None], axis=1)[:, 0]
-    lead_excl = m.partition_valid & m.broker_excl_leadership[lead_b]
-
-    # duplicate broker within a partition's replica set
-    a = jnp.where(valid, m.assignment, -jnp.arange(1, m.R + 1)[None, :])
-    dup = (a[:, :, None] == a[:, None, :]) & (jnp.arange(m.R)[:, None] < jnp.arange(m.R)[None, :])
-    dup_count = jnp.sum(dup & valid[:, :, None] & valid[:, None, :])
-
-    n = (
-        jnp.sum(on_dead)
-        + jnp.sum(on_dead_disk & ~on_dead)
-        + jnp.sum(lead_excl)
-        + dup_count
-    ).astype(jnp.float32)
+    n = jnp.sum(
+        pt.structural_rows(
+            m, m.assignment, m.leader_slot, m.replica_disk, m.partition_valid
+        )
+    )
     return result(n, n)
 
 
 # --------------------------------------------------------------------------
 # Rack awareness
 # --------------------------------------------------------------------------
-def _rack_counts(m: TensorClusterModel) -> jnp.ndarray:
-    """int32[P, n_racks] — replicas of partition p in each rack."""
-    valid = m.replica_valid
-    safe_b = jnp.clip(m.assignment, 0, m.B - 1)
-    racks = m.broker_rack[safe_b]  # [P, R]
-    onehot = (racks[:, :, None] == jnp.arange(m.num_racks)[None, None, :]) & valid[:, :, None]
-    return jnp.sum(onehot.astype(jnp.int32), axis=1)
-
-
-@register_goal("RackAwareGoal", hard=True)
+@register_goal("RackAwareGoal", hard=True, placement_dependent=True)
 def rack_aware(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
     """Replicas of a partition live on distinct racks (ref: RackAwareGoal —
     violation when two replicas share a rack, fixable while rf <= #racks)."""
-    counts = _rack_counts(m)
-    over = jnp.maximum(counts - 1, 0)
-    n = jnp.sum(over).astype(jnp.float32)
+    n = jnp.sum(pt.rack_aware_rows(m, m.assignment, m.partition_valid))
     return result(n, n)
 
 
-@register_goal("RackAwareDistributionGoal", hard=True)
+@register_goal("RackAwareDistributionGoal", hard=True, placement_dependent=True)
 def rack_aware_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
     """Replicas of a partition spread evenly over racks: no rack holds more
     than ceil(rf / #racks) (ref: RackAwareDistributionGoal, which relaxes
     RackAwareGoal for rf > #racks)."""
-    counts = _rack_counts(m)
-    rf = jnp.sum(m.replica_valid, axis=1)
-    cap = jnp.ceil(rf / jnp.maximum(m.num_racks, 1)).astype(jnp.int32)
-    over = jnp.maximum(counts - cap[:, None], 0)
-    n = jnp.sum(over).astype(jnp.float32)
+    n = jnp.sum(pt.rack_aware_distribution_rows(m, m.assignment, m.partition_valid))
     return result(n, n)
 
 
@@ -242,16 +208,12 @@ def potential_nw_out(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConf
     return result(n, jnp.sum(excess / _safe(cap)))
 
 
-@register_goal("PreferredLeaderElectionGoal", hard=False)
+@register_goal("PreferredLeaderElectionGoal", hard=False, placement_dependent=True)
 def preferred_leader_election(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
     """Leadership on the preferred (slot-0) replica when it is eligible."""
-    safe_b0 = jnp.clip(m.assignment[:, 0], 0, m.B - 1)
-    eligible = (
-        m.partition_valid
-        & (m.assignment[:, 0] >= 0)
-        & (m.broker_alive & m.broker_valid & ~m.broker_excl_leadership)[safe_b0]
+    n = jnp.sum(
+        pt.preferred_leader_rows(m, m.assignment, m.leader_slot, m.partition_valid)
     )
-    n = jnp.sum(eligible & (m.leader_slot != 0)).astype(jnp.float32)
     return result(n, n / jnp.maximum(m.n_partitions.astype(jnp.float32), 1.0))
 
 
@@ -285,7 +247,7 @@ def intra_disk_usage_distribution(m: TensorClusterModel, agg: BrokerAggregates, 
 # --------------------------------------------------------------------------
 # KafkaAssigner compatibility mode (SURVEY.md C19)
 # --------------------------------------------------------------------------
-@register_goal("KafkaAssignerEvenRackAwareGoal", hard=True)
+@register_goal("KafkaAssignerEvenRackAwareGoal", hard=True, placement_dependent=True)
 def kafka_assigner_even_rack_aware(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
     """KafkaAssigner mode: rack-distinct replicas AND leaders evenly spread
     over brokers (ref: KafkaAssignerEvenRackAwareGoal)."""

@@ -1,0 +1,136 @@
+"""Per-partition goal contributions, row-batched.
+
+Four goals in the stack depend on a partition's own replica row rather than
+on broker aggregates: StructuralFeasibility, RackAwareGoal,
+RackAwareDistributionGoal and PreferredLeaderElectionGoal (reference:
+``analyzer/goals/{RackAwareGoal,RackAwareDistributionGoal,
+PreferredLeaderElectionGoal}.java`` + ClusterModel invariants, SURVEY.md
+C16/C17). Factoring their math into row functions lets
+
+* the full kernels (ccx.goals.kernels) evaluate them over all P rows, and
+* the annealer (ccx.search) delta-update a single partition's contribution
+  in O(R) per move,
+
+from one implementation, so incremental sums can never drift from the full
+evaluation semantics.
+
+Every function takes row-batched arrays (leading axis n, n = P for full
+evaluation, n = 1 inside a search step) plus the static model for broker
+attributes, and returns a float32[n] violation contribution.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ccx.model.tensor_model import TensorClusterModel
+
+#: Order of the per-partition goal slots maintained incrementally by search.
+PARTITION_GOALS: tuple[str, ...] = (
+    "StructuralFeasibility",
+    "RackAwareGoal",
+    "RackAwareDistributionGoal",
+    "PreferredLeaderElectionGoal",
+)
+
+
+def _row_valid(assign: jnp.ndarray, pvalid: jnp.ndarray) -> jnp.ndarray:
+    return (assign >= 0) & pvalid[:, None]
+
+
+def structural_rows(
+    m: TensorClusterModel,
+    assign: jnp.ndarray,       # int32[n, R]
+    leader_slot: jnp.ndarray,  # int32[n]
+    replica_disk: jnp.ndarray,  # int32[n, R]
+    pvalid: jnp.ndarray,       # bool[n]
+) -> jnp.ndarray:
+    """Replicas on dead brokers/disks, leaders on leadership-excluded
+    brokers, duplicate brokers within a replica set."""
+    R = assign.shape[1]
+    valid = _row_valid(assign, pvalid)
+    safe_b = jnp.clip(assign, 0, m.B - 1)
+
+    on_dead = valid & ~(m.broker_alive & m.broker_valid)[safe_b]
+    safe_d = jnp.clip(replica_disk, 0, m.D - 1)
+    on_dead_disk = valid & (replica_disk >= 0) & ~m.disk_alive[safe_b, safe_d]
+
+    lead_b = jnp.take_along_axis(
+        safe_b, jnp.clip(leader_slot, 0, R - 1)[:, None], axis=1
+    )[:, 0]
+    lead_excl = pvalid & m.broker_excl_leadership[lead_b]
+
+    a = jnp.where(valid, assign, -jnp.arange(1, R + 1, dtype=jnp.int32)[None, :])
+    pair = (a[:, :, None] == a[:, None, :]) & (
+        jnp.arange(R)[:, None] < jnp.arange(R)[None, :]
+    )
+    dup = jnp.sum(pair & valid[:, :, None] & valid[:, None, :], axis=(1, 2))
+
+    return (
+        jnp.sum(on_dead, axis=1)
+        + jnp.sum(on_dead_disk & ~on_dead, axis=1)
+        + lead_excl
+        + dup
+    ).astype(jnp.float32)
+
+
+def _rack_counts_rows(
+    m: TensorClusterModel, assign: jnp.ndarray, pvalid: jnp.ndarray
+) -> jnp.ndarray:
+    """int32[n, num_racks] — replicas per rack for each row."""
+    valid = _row_valid(assign, pvalid)
+    racks = m.broker_rack[jnp.clip(assign, 0, m.B - 1)]
+    onehot = (racks[:, :, None] == jnp.arange(m.num_racks)[None, None, :]) & valid[
+        :, :, None
+    ]
+    return jnp.sum(onehot.astype(jnp.int32), axis=1)
+
+
+def rack_aware_rows(
+    m: TensorClusterModel, assign: jnp.ndarray, pvalid: jnp.ndarray
+) -> jnp.ndarray:
+    counts = _rack_counts_rows(m, assign, pvalid)
+    return jnp.sum(jnp.maximum(counts - 1, 0), axis=1).astype(jnp.float32)
+
+
+def rack_aware_distribution_rows(
+    m: TensorClusterModel, assign: jnp.ndarray, pvalid: jnp.ndarray
+) -> jnp.ndarray:
+    counts = _rack_counts_rows(m, assign, pvalid)
+    rf = jnp.sum(_row_valid(assign, pvalid), axis=1)
+    cap = jnp.ceil(rf / jnp.maximum(m.num_racks, 1)).astype(jnp.int32)
+    return jnp.sum(jnp.maximum(counts - cap[:, None], 0), axis=1).astype(jnp.float32)
+
+
+def preferred_leader_rows(
+    m: TensorClusterModel,
+    assign: jnp.ndarray,
+    leader_slot: jnp.ndarray,
+    pvalid: jnp.ndarray,
+) -> jnp.ndarray:
+    safe_b0 = jnp.clip(assign[:, 0], 0, m.B - 1)
+    eligible = (
+        pvalid
+        & (assign[:, 0] >= 0)
+        & (m.broker_alive & m.broker_valid & ~m.broker_excl_leadership)[safe_b0]
+    )
+    return (eligible & (leader_slot != 0)).astype(jnp.float32)
+
+
+def partition_sums(
+    m: TensorClusterModel,
+    assign: jnp.ndarray,
+    leader_slot: jnp.ndarray,
+    replica_disk: jnp.ndarray,
+    pvalid: jnp.ndarray,
+) -> jnp.ndarray:
+    """float32[len(PARTITION_GOALS)] — summed contributions in
+    PARTITION_GOALS order, over the given rows."""
+    return jnp.stack(
+        [
+            jnp.sum(structural_rows(m, assign, leader_slot, replica_disk, pvalid)),
+            jnp.sum(rack_aware_rows(m, assign, pvalid)),
+            jnp.sum(rack_aware_distribution_rows(m, assign, pvalid)),
+            jnp.sum(preferred_leader_rows(m, assign, leader_slot, pvalid)),
+        ]
+    )

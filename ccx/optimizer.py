@@ -1,0 +1,150 @@
+"""The optimizer façade — GoalOptimizer, TPU-native.
+
+Parity: ``analyzer/GoalOptimizer.optimizations(clusterModel, goalsByPriority,
+progress)`` (SURVEY.md C14) is the reference's entry point; it returns an
+``OptimizerResult`` carrying execution proposals, per-goal stats deltas and a
+violation summary. This module is that entry point for the tensor model:
+
+    1. batched simulated annealing over the full goal stack (ccx.search),
+    2. a greedy lexicographic polish pass that repairs residual hard
+       violations and low-tier regressions without breaking higher goals
+       (the analogue of the reference's sequential per-goal optimization),
+    3. diff into ExecutionProposals + verification + result summary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import (
+    DEFAULT_GOAL_ORDER,
+    INTRA_BROKER_GOAL_ORDER,
+    StackResult,
+)
+from ccx.model.tensor_model import TensorClusterModel
+from ccx.proposals import ExecutionProposal, diff
+from ccx.search.annealer import AnnealOptions, anneal
+from ccx.search.greedy import GreedyOptions, greedy_optimize
+from ccx.verify import Verification, verify_optimization
+
+
+@dataclasses.dataclass
+class OptimizerResult:
+    """Parity: ``analyzer/OptimizerResult.java`` (SURVEY.md C20)."""
+
+    proposals: list[ExecutionProposal]
+    stack_before: StackResult
+    stack_after: StackResult
+    verification: Verification
+    model: TensorClusterModel
+    wall_seconds: float
+    n_sa_accepted: int
+    n_polish_moves: int
+
+    @property
+    def num_replica_movements(self) -> int:
+        return sum(p.data_to_move for p in self.proposals)
+
+    @property
+    def num_leadership_movements(self) -> int:
+        return sum(
+            1 for p in self.proposals if p.old_leader != p.new_leader
+        )
+
+    def violation_summary(self) -> dict[str, float]:
+        return {n: v for n, (v, _) in self.stack_after.by_name().items() if v > 0}
+
+    def to_json(self) -> dict:
+        before = self.stack_before.by_name()
+        after = self.stack_after.by_name()
+        return {
+            "proposals": [p.to_json() for p in self.proposals],
+            "numReplicaMovements": self.num_replica_movements,
+            "numLeadershipMovements": self.num_leadership_movements,
+            "goalSummary": [
+                {
+                    "goal": n,
+                    "hard": GOAL_REGISTRY[n].hard,
+                    "violationsBefore": before[n][0],
+                    "violationsAfter": after[n][0],
+                    "costBefore": before[n][1],
+                    "costAfter": after[n][1],
+                }
+                for n in self.stack_after.names
+            ],
+            "verified": self.verification.ok,
+            "verificationFailures": self.verification.failures,
+            "wallSeconds": self.wall_seconds,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizeOptions:
+    anneal: AnnealOptions = AnnealOptions()
+    polish: GreedyOptions = GreedyOptions(n_candidates=256, max_iters=400)
+    run_polish: bool = True
+    require_hard_zero: bool = True
+    #: disable for disk-only stacks — intra-broker moves cannot evacuate
+    #: a dead broker
+    check_evacuation: bool = True
+
+
+def optimize(
+    m: TensorClusterModel,
+    cfg: GoalConfig = GoalConfig(),
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    opts: OptimizeOptions = OptimizeOptions(),
+) -> OptimizerResult:
+    """Full-stack proposal computation (reference call stack 3.2, L3a part)."""
+    t0 = time.monotonic()
+    sa = anneal(m, cfg, goal_names, opts.anneal)
+    model = sa.model
+    stack_after = sa.stack_after
+    n_polish = 0
+    if opts.run_polish:
+        polish = greedy_optimize(model, cfg, goal_names, opts.polish)
+        model = polish.model
+        stack_after = polish.stack_after
+        n_polish = polish.n_moves
+    proposals = diff(m, model)
+    verification = verify_optimization(
+        m,
+        model,
+        cfg,
+        goal_names,
+        proposals=proposals,
+        require_hard_zero=opts.require_hard_zero,
+        check_evacuation=opts.check_evacuation,
+        stack_before=sa.stack_before,
+        stack_after=stack_after,
+    )
+    return OptimizerResult(
+        proposals=proposals,
+        stack_before=sa.stack_before,
+        stack_after=stack_after,
+        verification=verification,
+        model=model,
+        wall_seconds=time.monotonic() - t0,
+        n_sa_accepted=sa.n_accepted,
+        n_polish_moves=n_polish,
+    )
+
+
+def rebalance_disk(
+    m: TensorClusterModel,
+    cfg: GoalConfig = GoalConfig(),
+    opts: OptimizeOptions | None = None,
+) -> OptimizerResult:
+    """Intra-broker JBOD disk rebalance (ref: rebalance?rebalance_disk,
+    SURVEY.md C18). Only INTRA_BROKER_REPLICA_MOVEMENT actions are proposed."""
+    if opts is None:
+        opts = OptimizeOptions(
+            anneal=AnnealOptions(p_disk=1.0, p_leadership=0.0, p_biased_dest=0.0),
+            polish=GreedyOptions(
+                p_disk=1.0, p_leadership=0.0, n_candidates=256, max_iters=400
+            ),
+            check_evacuation=False,
+        )
+    return optimize(m, cfg, INTRA_BROKER_GOAL_ORDER, opts)

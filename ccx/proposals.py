@@ -1,0 +1,106 @@
+"""Execution proposals — diffing pre/post placements.
+
+Parity: ``analyzer/AnalyzerUtils.getDiff`` turns the optimizer's mutated
+ClusterModel into a set of ``executor/ExecutionProposal`` records (old/new
+replica lists + leaders) that the Executor converts into AdminClient
+reassignment calls (SURVEY.md C20/C24, call stack 3.2->3.3). Here the diff
+is a vectorized numpy comparison of the placement arrays of two
+TensorClusterModels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+from ccx.model.tensor_model import TensorClusterModel
+
+
+class ActionType(enum.Enum):
+    """Parity: ``analyzer/ActionType.java`` (SURVEY.md C20)."""
+
+    INTER_BROKER_REPLICA_MOVEMENT = "inter_broker_replica_movement"
+    LEADERSHIP_MOVEMENT = "leadership_movement"
+    INTRA_BROKER_REPLICA_MOVEMENT = "intra_broker_replica_movement"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionProposal:
+    """One partition's reassignment (ref: executor/ExecutionProposal.java)."""
+
+    partition: int
+    topic: int
+    old_replicas: tuple[int, ...]
+    new_replicas: tuple[int, ...]
+    old_leader: int
+    new_leader: int
+    old_disks: tuple[int, ...] = ()
+    new_disks: tuple[int, ...] = ()
+
+    @property
+    def actions(self) -> tuple[ActionType, ...]:
+        acts = []
+        if set(self.old_replicas) != set(self.new_replicas):
+            acts.append(ActionType.INTER_BROKER_REPLICA_MOVEMENT)
+        if self.old_leader != self.new_leader:
+            acts.append(ActionType.LEADERSHIP_MOVEMENT)
+        moved = {
+            (b, d)
+            for b, d in zip(self.new_replicas, self.new_disks)
+            if (b, d) not in set(zip(self.old_replicas, self.old_disks))
+        }
+        if self.old_disks and any(
+            b in self.old_replicas for b, _ in moved
+        ) and set(self.old_replicas) == set(self.new_replicas):
+            acts.append(ActionType.INTRA_BROKER_REPLICA_MOVEMENT)
+        return tuple(acts)
+
+    @property
+    def data_to_move(self) -> int:
+        """Count of replicas that change broker (executor concurrency caps
+        are per-movement; per-byte accounting is layered on by the planner)."""
+        return len(set(self.new_replicas) - set(self.old_replicas))
+
+    def to_json(self) -> dict:
+        return {
+            "topicPartition": {"topic": int(self.topic), "partition": int(self.partition)},
+            "oldLeader": int(self.old_leader),
+            "newLeader": int(self.new_leader),
+            "oldReplicas": [int(b) for b in self.old_replicas],
+            "newReplicas": [int(b) for b in self.new_replicas],
+        }
+
+
+def diff(before: TensorClusterModel, after: TensorClusterModel) -> list[ExecutionProposal]:
+    """All partitions whose placement changed, as ExecutionProposals."""
+    a0 = np.asarray(before.assignment)
+    a1 = np.asarray(after.assignment)
+    l0 = np.asarray(before.leader_slot)
+    l1 = np.asarray(after.leader_slot)
+    d0 = np.asarray(before.replica_disk)
+    d1 = np.asarray(after.replica_disk)
+    pvalid = np.asarray(before.partition_valid)
+    topics = np.asarray(before.partition_topic)
+
+    changed = pvalid & (
+        np.any(a0 != a1, axis=1) | (l0 != l1) | np.any(d0 != d1, axis=1)
+    )
+    out: list[ExecutionProposal] = []
+    for p in np.nonzero(changed)[0]:
+        old_r = tuple(int(b) for b in a0[p] if b >= 0)
+        new_r = tuple(int(b) for b in a1[p] if b >= 0)
+        out.append(
+            ExecutionProposal(
+                partition=int(p),
+                topic=int(topics[p]),
+                old_replicas=old_r,
+                new_replicas=new_r,
+                old_leader=int(a0[p, l0[p]]) if old_r else -1,
+                new_leader=int(a1[p, l1[p]]) if new_r else -1,
+                old_disks=tuple(int(d) for d, b in zip(d0[p], a0[p]) if b >= 0),
+                new_disks=tuple(int(d) for d, b in zip(d1[p], a1[p]) if b >= 0),
+            )
+        )
+    return out

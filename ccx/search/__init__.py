@@ -1,0 +1,26 @@
+"""ccx.search — proposal search engines over the tensor cluster model.
+
+The reference's ``analyzer/GoalOptimizer.java`` walks goals sequentially and
+greedily mutates the ClusterModel (SURVEY.md C14/C15, call stack 3.2). The
+TPU-native replacement is batched simulated annealing: thousands of
+independent chains propose replica/leadership/disk moves, score the full goal
+stack from incrementally-maintained broker aggregates, and Metropolis-accept
+on a (hard, soft) lexicographic cost — all inside one jit-compiled
+``lax.scan`` vmapped over chains (north star, BASELINE.json).
+
+Modules:
+  state     — per-chain search state + O(R) incremental aggregate updates
+  annealer  — the batched SA engine
+  greedy    — slow, faithful lexicographic hill-climbing oracle (tests/parity)
+"""
+
+from ccx.search.annealer import AnnealOptions, AnnealResult, anneal
+from ccx.search.state import SearchState, init_search_state
+
+__all__ = [
+    "AnnealOptions",
+    "AnnealResult",
+    "anneal",
+    "SearchState",
+    "init_search_state",
+]

@@ -1,0 +1,400 @@
+"""Batched simulated annealing over candidate placements.
+
+This is the TPU-native replacement for ``GoalOptimizer``'s greedy walk
+(SURVEY.md C14, call stack 3.2 hot loop #1): instead of one thread mutating
+one ClusterModel via per-goal ``rebalanceForBroker`` loops, K independent
+chains each propose one move per step — the reference's ``ActionType``
+vocabulary (SURVEY.md C20): INTER_BROKER_REPLICA_MOVEMENT,
+LEADERSHIP_MOVEMENT, INTRA_BROKER_REPLICA_MOVEMENT — score the full goal
+stack from incrementally-updated aggregates, and accept by Metropolis on the
+lexicographic (hard, soft) cost. The whole search is one ``lax.scan`` of a
+vmapped step: chains are the embarrassingly-parallel batch axis
+(the descendant of `num.proposal.precompute.threads`, SURVEY.md section 2.5).
+
+Acceptance semantics mirror the reference's hard/soft split: a move that
+raises hard-goal cost is never accepted (`actionAcceptance` veto); within
+equal hard cost, soft cost follows Metropolis with a geometric temperature
+schedule; hard-goal *reductions* are always accepted (self-healing: replicas
+evacuate dead brokers because those moves strictly drop hard cost).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
+from ccx.model.tensor_model import TensorClusterModel
+from ccx.search.state import (
+    SearchState,
+    init_search_state,
+    make_cost_fn,
+    partition_row_sums,
+    scatter_partition,
+    with_placement,
+)
+
+# Move kinds (ref ActionType, SURVEY.md C20).
+MOVE_REPLICA = 0      # INTER_BROKER_REPLICA_MOVEMENT
+MOVE_LEADERSHIP = 1   # LEADERSHIP_MOVEMENT
+MOVE_DISK = 2         # INTRA_BROKER_REPLICA_MOVEMENT (JBOD)
+
+
+@dataclasses.dataclass(frozen=True)
+class AnnealOptions:
+    n_chains: int = 64
+    n_steps: int = 3000
+    t0: float = 0.3          # initial temperature (soft-cost units)
+    t1: float = 1e-4         # final temperature
+    p_leadership: float = 0.15
+    p_disk: float = 0.0      # raise for JBOD / rebalance_disk stacks
+    #: probability the destination broker is drawn headroom-weighted rather
+    #: than uniformly (mirrors the greedy's overloaded->underloaded bias,
+    #: SURVEY.md section 7.4 "proposal distributions").
+    p_biased_dest: float = 0.5
+    #: probability of targeting the self-healing evacuation set (replicas on
+    #: dead brokers/disks) when it is non-empty.
+    p_evac: float = 0.3
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class AnnealResult:
+    model: TensorClusterModel
+    stack_before: StackResult
+    stack_after: StackResult
+    n_accepted: int
+    n_chains: int
+    n_steps: int
+    best_chain: int
+
+    @property
+    def improved(self) -> bool:
+        before = float(self.stack_before.hard_cost), float(self.stack_before.soft_scalar)
+        after = float(self.stack_after.hard_cost), float(self.stack_after.soft_scalar)
+        return after <= before
+
+
+@dataclasses.dataclass(frozen=True)
+class ProposalParams:
+    """Static knobs for move proposal (shared by annealer + greedy)."""
+
+    p_real: int
+    b_real: int
+    p_leadership: float = 0.15
+    p_disk: float = 0.0
+    p_biased_dest: float = 0.5
+    #: probability of drawing the partition from the evacuation list (replicas
+    #: initially on dead brokers/disks — the self-healing hot set, SURVEY.md
+    #: section 5.3). Only applied when the list is non-empty.
+    p_evac: float = 0.3
+
+
+def evacuation_list(m: TensorClusterModel) -> tuple[np.ndarray, int]:
+    """Partitions with a replica on a dead broker or dead disk, padded to a
+    power-of-two length (stable jit cache across similar clusters)."""
+    a = np.asarray(m.assignment)
+    ok_broker = np.asarray(m.broker_alive & m.broker_valid)
+    disk_alive = np.asarray(m.disk_alive)
+    rd = np.asarray(m.replica_disk)
+    valid = (a >= 0) & np.asarray(m.partition_valid)[:, None]
+    safe_b = np.clip(a, 0, m.B - 1)
+    safe_d = np.clip(rd, 0, m.D - 1)
+    bad = valid & (
+        ~ok_broker[safe_b] | ((rd >= 0) & ~disk_alive[safe_b, safe_d])
+    )
+    idx = np.nonzero(bad.any(axis=1))[0].astype(np.int32)
+    n = len(idx)
+    pad = 1
+    while pad < max(n, 1):
+        pad *= 2
+    return np.pad(idx, (0, pad - n)), n
+
+
+def propose_move(
+    key: jnp.ndarray,
+    state: SearchState,
+    m: TensorClusterModel,
+    pp: ProposalParams,
+    evac: jnp.ndarray | None = None,
+    n_evac: jnp.ndarray | None = None,
+):
+    """Draw one candidate move: returns (p, old rows, new rows, feasible).
+
+    Feasibility masking mirrors the reference's per-goal requirements checks
+    (never *create* structural violations): destination must be alive, valid,
+    not replica-excluded, not already hosting the partition; leadership may
+    only land on alive, non-leadership-excluded brokers; excluded
+    (immovable) partitions are untouchable (OptimizationOptions,
+    SURVEY.md C20)."""
+    R, B, D = m.R, m.B, m.D
+    k_kind, k_p, k_r, k_dst, k_dstu, k_disk, k_bias, k_ev, k_evi = (
+        jax.random.split(key, 9)
+    )
+
+    kind = jax.random.choice(
+        k_kind,
+        jnp.asarray([MOVE_REPLICA, MOVE_LEADERSHIP, MOVE_DISK]),
+        p=jnp.asarray(
+            [1.0 - pp.p_leadership - pp.p_disk, pp.p_leadership, pp.p_disk]
+        ),
+    )
+    p = jax.random.randint(k_p, (), 0, pp.p_real)
+    use_evac = jnp.asarray(False)
+    if evac is not None and n_evac is not None:
+        use_evac = (jax.random.uniform(k_ev) < pp.p_evac) & (n_evac > 0)
+        ei = jax.random.randint(k_evi, (), 0, jnp.maximum(n_evac, 1))
+        p = jnp.where(use_evac, evac[ei], p)
+    r = jax.random.randint(k_r, (), 0, R)
+
+    old_assign = state.assignment[p]          # [R]
+    old_leader = state.leader_slot[p]
+    old_disk = state.replica_disk[p]          # [R]
+
+    # On an evacuation draw, target the offending slot. A replica on a dead
+    # *broker* can only be healed by relocation; a replica on a dead *disk*
+    # of a live broker is healed by an intra-broker disk move (keeps the
+    # rebalance_disk contract intra-broker-only when p_disk=1).
+    ok_b = m.broker_alive & m.broker_valid
+    safe_row = jnp.clip(old_assign, 0, B - 1)
+    safe_dk = jnp.clip(old_disk, 0, D - 1)
+    dead_broker_slot = (old_assign >= 0) & ~ok_b[safe_row]
+    dead_disk_slot = (
+        (old_assign >= 0)
+        & ok_b[safe_row]
+        & (old_disk >= 0)
+        & ~m.disk_alive[safe_row, safe_dk]
+    )
+    bad_slot = dead_broker_slot | dead_disk_slot
+    has_bad = jnp.any(bad_slot)
+    bad_r = jnp.argmax(bad_slot)
+    r = jnp.where(use_evac & has_bad, bad_r, r).astype(jnp.int32)
+    evac_kind = jnp.where(dead_broker_slot[bad_r], MOVE_REPLICA, MOVE_DISK)
+    kind = jnp.where(use_evac & has_bad, evac_kind, kind)
+
+    src = old_assign[r]
+    slot_valid = src >= 0
+    movable = m.partition_valid[p] & ~m.partition_immovable[p]
+
+    # --- destination broker: headroom-weighted or uniform ------------------
+    alive_ok = m.broker_valid & m.broker_alive & ~m.broker_excl_replicas
+    cap = m.broker_capacity  # [RES, B]
+    util = state.agg.broker_load / jnp.where(cap > 0, cap, 1.0)
+    headroom = 1.0 - jnp.max(util, axis=0)                      # [B]
+    w = jnp.where(alive_ok, jnp.maximum(headroom, 0.0) + 0.05, 0.0)
+    g = -jnp.log(-jnp.log(jax.random.uniform(k_dst, (B,), minval=1e-12, maxval=1.0)))
+    dst_biased = jnp.argmax(jnp.where(w > 0, jnp.log(w) + g, -jnp.inf))
+    dst_uniform = jax.random.randint(k_dstu, (), 0, pp.b_real)
+    use_bias = jax.random.uniform(k_bias) < pp.p_biased_dest
+    dst = jnp.where(use_bias, dst_biased, dst_uniform).astype(jnp.int32)
+
+    # --- feasibility masks (never *create* hard structural violations) -----
+    dst_ok = alive_ok[dst] & (dst != src)
+    no_dup = ~jnp.any(old_assign == dst)
+    is_leader_slot = r == old_leader
+    dst_lead_ok = ~(is_leader_slot & m.broker_excl_leadership[dst])
+    move_ok = (
+        (kind == MOVE_REPLICA) & slot_valid & movable & dst_ok & no_dup & dst_lead_ok
+    )
+
+    # destination disk on dst: random among its alive disks
+    gd = -jnp.log(
+        -jnp.log(jax.random.uniform(k_disk, (D,), minval=1e-12, maxval=1.0))
+    )
+    dst_disk = jnp.argmax(jnp.where(m.disk_alive[dst], gd, -jnp.inf)).astype(jnp.int32)
+
+    # --- leadership transfer ----------------------------------------------
+    tgt_b = jnp.clip(old_assign[r], 0, B - 1)
+    lead_ok = (
+        (kind == MOVE_LEADERSHIP)
+        & slot_valid
+        & movable
+        & (r != old_leader)
+        & (m.broker_valid & m.broker_alive & ~m.broker_excl_leadership)[tgt_b]
+    )
+
+    # --- intra-broker disk move -------------------------------------------
+    src_b = jnp.clip(src, 0, B - 1)
+    disk_new = jnp.argmax(jnp.where(m.disk_alive[src_b], gd, -jnp.inf)).astype(
+        jnp.int32
+    )
+    disk_ok = (
+        (kind == MOVE_DISK)
+        & slot_valid
+        & movable
+        & (disk_new != old_disk[r])
+        & (D > 1)
+    )
+
+    feasible = move_ok | lead_ok | disk_ok
+
+    # --- build candidate rows ---------------------------------------------
+    new_assign = jnp.where(move_ok, old_assign.at[r].set(dst), old_assign)
+    new_leader = jnp.where(lead_ok, r, old_leader).astype(jnp.int32)
+    new_disk = jnp.where(
+        move_ok,
+        old_disk.at[r].set(jnp.where(D > 1, dst_disk, 0)),
+        jnp.where(disk_ok, old_disk.at[r].set(disk_new), old_disk),
+    )
+    return p, (old_assign, old_leader, old_disk), (new_assign, new_leader, new_disk), feasible
+
+
+def _anneal_step(
+    state: SearchState,
+    temperature: jnp.ndarray,
+    step_idx: jnp.ndarray,
+    evac: jnp.ndarray,
+    n_evac: jnp.ndarray,
+    *,
+    m: TensorClusterModel,
+    cost_fn,
+    pp: ProposalParams,
+) -> SearchState:
+    """One proposed move on one chain (vmapped over chains by the caller)."""
+    key = jax.random.fold_in(state.key, step_idx)
+    k_prop, k_acc = jax.random.split(key)
+    p, old, new, feasible = propose_move(k_prop, state, m, pp, evac, n_evac)
+    (old_assign, old_leader, old_disk) = old
+    (new_assign, new_leader, new_disk) = new
+
+    # --- incremental aggregates + per-partition sums -----------------------
+    one_f, one_i = jnp.float32(1.0), jnp.int32(1)
+    agg1 = scatter_partition(
+        state.agg, m, p, old_assign, old_leader, old_disk, -one_f, -one_i
+    )
+    agg2 = scatter_partition(
+        agg1, m, p, new_assign, new_leader, new_disk, one_f, one_i
+    )
+    old_rows = partition_row_sums(m, p, old_assign, old_leader, old_disk)
+    new_rows = partition_row_sums(m, p, new_assign, new_leader, new_disk)
+    part_new = state.part_sums - old_rows + new_rows
+
+    hard_new, soft_new = cost_fn(agg2, part_new)
+
+    # --- lexicographic Metropolis acceptance -------------------------------
+    d_hard = hard_new - state.hard_cost
+    d_soft = soft_new - state.soft_cost
+    # relative tolerance: incremental float drift on large hard costs must not
+    # read as a hard-goal regression and stall soft optimization
+    tol = 1e-5 * (1.0 + jnp.abs(state.hard_cost))
+    u = jax.random.uniform(k_acc, minval=1e-12, maxval=1.0)
+    metropolis = jnp.log(u) < (-d_soft / jnp.maximum(temperature, 1e-30))
+    accept = feasible & (
+        (d_hard < -tol) | ((jnp.abs(d_hard) <= tol) & ((d_soft <= 0.0) | metropolis))
+    )
+
+    af, ai = accept.astype(jnp.float32), accept.astype(jnp.int32)
+    rf, ri = 1.0 - af, 1 - ai
+    # revert the scatter if rejected (sparse — avoids a full-array select)
+    agg3 = scatter_partition(agg2, m, p, new_assign, new_leader, new_disk, -rf, -ri)
+    agg4 = scatter_partition(agg3, m, p, old_assign, old_leader, old_disk, rf, ri)
+
+    sel_assign = jnp.where(accept, new_assign, old_assign)
+    sel_leader = jnp.where(accept, new_leader, old_leader)
+    sel_disk = jnp.where(accept, new_disk, old_disk)
+
+    return SearchState(
+        assignment=state.assignment.at[p].set(sel_assign),
+        leader_slot=state.leader_slot.at[p].set(sel_leader),
+        replica_disk=state.replica_disk.at[p].set(sel_disk),
+        agg=agg4,
+        part_sums=jnp.where(accept, part_new, state.part_sums),
+        hard_cost=jnp.where(accept, hard_new, state.hard_cost),
+        soft_cost=jnp.where(accept, soft_new, state.soft_cost),
+        key=state.key,
+        n_accepted=state.n_accepted + ai,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("goal_names", "cfg", "opts", "p_real", "b_real")
+)
+def _run_chains(
+    m: TensorClusterModel,
+    keys: jnp.ndarray,
+    evac: jnp.ndarray,
+    n_evac: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    opts: AnnealOptions,
+    p_real: int,
+    b_real: int,
+) -> SearchState:
+    cost_fn = make_cost_fn(m, goal_names, cfg)
+    state0 = init_search_state(m, cfg, goal_names, keys[0])
+    states = jax.vmap(lambda k: state0.replace(key=k))(keys)
+
+    n = max(opts.n_steps, 1)
+    decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
+
+    pp = ProposalParams(
+        p_real=p_real,
+        b_real=b_real,
+        p_leadership=opts.p_leadership,
+        p_disk=opts.p_disk,
+        p_biased_dest=opts.p_biased_dest,
+        p_evac=opts.p_evac,
+    )
+    step = functools.partial(_anneal_step, m=m, cost_fn=cost_fn, pp=pp)
+
+    def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
+        temp = opts.t0 * decay**t
+        ss = jax.vmap(step, in_axes=(0, None, None, None, None))(
+            ss, temp, t, evac, n_evac
+        )
+        return ss, None
+
+    states, _ = jax.lax.scan(body, states, jnp.arange(n))
+    return states
+
+
+def anneal(
+    m: TensorClusterModel,
+    cfg: GoalConfig = GoalConfig(),
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    opts: AnnealOptions = AnnealOptions(),
+) -> AnnealResult:
+    """Run batched SA and return the best chain's placement as a new model.
+
+    Chains only ever accept hard-cost-non-increasing moves, and the
+    temperature schedule ends near zero, so each chain's final state is its
+    best reachable local optimum; the winner is the lexicographic argmin
+    across chains. The returned model's stack scores are re-evaluated from
+    scratch (incremental float drift cannot leak into reported results).
+    """
+    stack_before = evaluate_stack(m, cfg, goal_names)
+    p_real = int(np.asarray(m.n_partitions))
+    b_real = int(np.asarray(jnp.max(jnp.where(m.broker_valid, jnp.arange(m.B), -1)))) + 1
+    evac, n_evac = evacuation_list(m)
+
+    keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
+    states = _run_chains(
+        m, keys, jnp.asarray(evac), jnp.asarray(n_evac, jnp.int32),
+        goal_names=goal_names, cfg=cfg, opts=opts,
+        p_real=p_real, b_real=b_real,
+    )
+
+    hard = np.asarray(states.hard_cost)
+    soft = np.asarray(states.soft_cost)
+    cand = hard <= hard.min() + 1e-6
+    best = int(np.argmin(np.where(cand, soft, np.inf)))
+
+    pick = jax.tree.map(lambda a: a[best], states)
+    result_model = with_placement(m, pick)
+    stack_after = evaluate_stack(result_model, cfg, goal_names)
+
+    return AnnealResult(
+        model=result_model,
+        stack_before=stack_before,
+        stack_after=stack_after,
+        n_accepted=int(np.asarray(pick.n_accepted)),
+        n_chains=opts.n_chains,
+        n_steps=opts.n_steps,
+        best_chain=best,
+    )

@@ -1,0 +1,206 @@
+"""Greedy lexicographic hill-climbing — the faithful-semantics oracle.
+
+Parity: the reference's ``GoalOptimizer.optimizations`` walks goals in
+priority order, and a move is only taken when every already-optimized goal
+accepts it (``actionAcceptance``, SURVEY.md call stack 3.2 hot loop #1).
+That is exactly lexicographic ordering on the per-goal cost vector: a move
+is an improvement iff it strictly reduces some goal's cost without raising
+any higher-priority goal's. This module implements that acceptance rule
+directly — batched candidate scoring on device (vmapped incremental
+evaluation), lexicographic selection on host — and serves as
+
+* the correctness oracle the annealer's results are score-compared against
+  (SURVEY.md section 4 "score-parity vs a slow Python greedy oracle"), and
+* the post-SA repair/polish pass: started from an annealed placement it
+  fixes residual hard violations and low-tier regressions (e.g. preferred
+  leadership) without breaking higher-priority goals, mirroring the
+  reference's sequential re-optimization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
+from ccx.model.tensor_model import TensorClusterModel
+from ccx.search.annealer import ProposalParams, evacuation_list, propose_move
+from ccx.search.state import (
+    SearchState,
+    init_search_state,
+    make_goal_vector_fn,
+    partition_row_sums,
+    scatter_partition,
+    with_placement,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GreedyOptions:
+    #: candidate moves scored per iteration (vmapped on device)
+    n_candidates: int = 512
+    max_iters: int = 2000
+    #: stop after this many consecutive iterations with no improving candidate
+    patience: int = 8
+    p_leadership: float = 0.25
+    p_disk: float = 0.0
+    p_biased_dest: float = 0.5
+    p_evac: float = 0.3
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class GreedyResult:
+    model: TensorClusterModel
+    stack_before: StackResult
+    stack_after: StackResult
+    n_moves: int
+    n_iters: int
+
+
+@functools.partial(jax.jit, static_argnames=("goal_names", "cfg", "pp"))
+def _score_candidates(
+    state: SearchState,
+    key: jnp.ndarray,
+    m: TensorClusterModel,
+    evac: jnp.ndarray,
+    n_evac: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    pp: ProposalParams,
+):
+    """Score n_candidates random moves; return per-candidate goal-cost
+    vectors plus the move payloads (rows are applied host-side)."""
+    vector_fn = make_goal_vector_fn(m, goal_names, cfg)
+
+    def one(k):
+        p, old, new, feasible = propose_move(k, state, m, pp, evac, n_evac)
+        agg1 = scatter_partition(state.agg, m, p, *old, jnp.float32(-1), jnp.int32(-1))
+        agg2 = scatter_partition(agg1, m, p, *new, jnp.float32(1), jnp.int32(1))
+        part = state.part_sums - partition_row_sums(m, p, *old) + partition_row_sums(
+            m, p, *new
+        )
+        costs = vector_fn(agg2, part)
+        return p, new, feasible, costs, part
+
+    return jax.vmap(one)(key)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _apply_move(
+    state: SearchState,
+    m: TensorClusterModel,
+    p: jnp.ndarray,
+    new_assign: jnp.ndarray,
+    new_leader: jnp.ndarray,
+    new_disk: jnp.ndarray,
+    part_sums: jnp.ndarray,
+) -> SearchState:
+    old = (state.assignment[p], state.leader_slot[p], state.replica_disk[p])
+    agg1 = scatter_partition(state.agg, m, p, *old, jnp.float32(-1), jnp.int32(-1))
+    agg2 = scatter_partition(
+        agg1, m, p, new_assign, new_leader, new_disk, jnp.float32(1), jnp.int32(1)
+    )
+    return state.replace(
+        assignment=state.assignment.at[p].set(new_assign),
+        leader_slot=state.leader_slot.at[p].set(new_leader),
+        replica_disk=state.replica_disk.at[p].set(new_disk),
+        agg=agg2,
+        part_sums=part_sums,
+        n_accepted=state.n_accepted + 1,
+    )
+
+
+def _lex_better(cand: np.ndarray, cur: np.ndarray, tol: float = 1e-6) -> bool:
+    """cand < cur lexicographically (with tolerance)."""
+    for i in range(cur.shape[0]):
+        if cand[i] < cur[i] - tol:
+            return True
+        if cand[i] > cur[i] + tol:
+            return False
+    return False
+
+
+def greedy_optimize(
+    m: TensorClusterModel,
+    cfg: GoalConfig = GoalConfig(),
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    opts: GreedyOptions = GreedyOptions(),
+) -> GreedyResult:
+    """Hill-climb the lexicographic goal-cost vector to a local optimum."""
+    stack_before = evaluate_stack(m, cfg, goal_names)
+    p_real = int(np.asarray(m.n_partitions))
+    b_real = (
+        int(np.asarray(jnp.max(jnp.where(m.broker_valid, jnp.arange(m.B), -1)))) + 1
+    )
+    pp = ProposalParams(
+        p_real=p_real,
+        b_real=b_real,
+        p_leadership=opts.p_leadership,
+        p_disk=opts.p_disk,
+        p_biased_dest=opts.p_biased_dest,
+        p_evac=opts.p_evac,
+    )
+
+    evac_np, n_evac_i = evacuation_list(m)
+    evac = jnp.asarray(evac_np)
+    n_evac = jnp.asarray(n_evac_i, jnp.int32)
+
+    state = init_search_state(m, cfg, goal_names, jax.random.PRNGKey(opts.seed))
+    vector_fn = jax.jit(make_goal_vector_fn(m, goal_names, cfg))
+    cur = np.asarray(vector_fn(state.agg, state.part_sums))
+
+    key = jax.random.PRNGKey(opts.seed + 1)
+    n_moves = 0
+    stale = 0
+    it = 0
+    for it in range(opts.max_iters):
+        key, sub = jax.random.split(key)
+        ks = jax.random.split(sub, opts.n_candidates)
+        ps, news, feas, costs, parts = _score_candidates(
+            state, ks, m, evac, n_evac, goal_names=goal_names, cfg=cfg, pp=pp
+        )
+        costs_np = np.asarray(costs)
+        feas_np = np.asarray(feas)
+
+        # lexicographic argmin among feasible strict improvements
+        best_i, best_v = -1, cur
+        for i in range(opts.n_candidates):
+            if not feas_np[i]:
+                continue
+            if _lex_better(costs_np[i], best_v):
+                best_i, best_v = i, costs_np[i]
+
+        if best_i < 0:
+            stale += 1
+            if stale >= opts.patience:
+                break
+            continue
+        stale = 0
+        state = _apply_move(
+            state,
+            m,
+            ps[best_i],
+            news[0][best_i],
+            news[1][best_i],
+            news[2][best_i],
+            parts[best_i],
+        )
+        cur = best_v
+        n_moves += 1
+
+    result_model = with_placement(m, state)
+    stack_after = evaluate_stack(result_model, cfg, goal_names)
+    return GreedyResult(
+        model=result_model,
+        stack_before=stack_before,
+        stack_after=stack_after,
+        n_moves=n_moves,
+        n_iters=it + 1,
+    )

@@ -1,0 +1,201 @@
+"""Per-chain search state with O(R) incremental aggregate maintenance.
+
+The expensive part of scoring a candidate move is the broker aggregates
+(``ccx.model.aggregates``: one full pass is O(P*R)). A move only changes one
+partition's contribution, so search maintains the aggregates incrementally:
+*un-scatter* the partition's old contribution, *scatter* its new one — O(R)
+scatter-adds — then score the goal stack from the updated aggregates
+(O(B*RES + T*B)). This is the TPU-native analogue of the reference's
+``ClusterModel.relocateReplica``/``transferLeadership`` in-place load
+bookkeeping (SURVEY.md C1).
+
+The four per-partition goals (ccx.goals.partition_terms.PARTITION_GOALS) are
+maintained as running sums the same way: subtract the old row's contribution,
+add the new row's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ccx.common.resources import Resource
+from ccx.goals import partition_terms as pt
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import soft_weights
+from ccx.model.aggregates import BrokerAggregates, broker_aggregates
+from ccx.model.tensor_model import TensorClusterModel
+
+
+@struct.dataclass
+class SearchState:
+    """Dynamic per-chain state. The static cluster attributes (loads,
+    capacities, racks, masks) live in the TensorClusterModel the search was
+    started from; only placement changes during search."""
+
+    assignment: jnp.ndarray    # int32[P, R]
+    leader_slot: jnp.ndarray   # int32[P]
+    replica_disk: jnp.ndarray  # int32[P, R]
+    agg: BrokerAggregates
+    part_sums: jnp.ndarray     # float32[len(PARTITION_GOALS)]
+    hard_cost: jnp.ndarray     # f32 scalar
+    soft_cost: jnp.ndarray     # f32 scalar
+    key: jnp.ndarray           # PRNG key
+    n_accepted: jnp.ndarray    # int32 scalar
+
+
+def scatter_partition(
+    agg: BrokerAggregates,
+    m: TensorClusterModel,
+    p: jnp.ndarray,            # int32 scalar — partition index
+    assign_row: jnp.ndarray,   # int32[R]
+    leader_slot_p: jnp.ndarray,  # int32 scalar
+    disk_row: jnp.ndarray,     # int32[R]
+    w_f: jnp.ndarray,          # f32 scalar weight (+1 add, -1 remove, 0 no-op)
+    w_i: jnp.ndarray,          # int32 scalar weight
+) -> BrokerAggregates:
+    """Scatter-add one partition's contribution (times weight) into agg."""
+    R = assign_row.shape[0]
+    valid = (assign_row >= 0) & m.partition_valid[p]
+    b = jnp.clip(assign_row, 0, m.B - 1)
+    is_lead = (jnp.arange(R) == leader_slot_p) & valid
+
+    lead_load = jax.lax.dynamic_slice_in_dim(m.leader_load, p, 1, axis=1)[:, 0]
+    foll_load = jax.lax.dynamic_slice_in_dim(m.follower_load, p, 1, axis=1)[:, 0]
+    # [RES, R] role-resolved slot loads, zeroed for invalid slots
+    slot_load = jnp.where(is_lead[None, :], lead_load[:, None], foll_load[:, None])
+    slot_load = jnp.where(valid[None, :], slot_load, 0.0)
+
+    vf = valid.astype(jnp.float32)
+    vi = valid.astype(jnp.int32)
+    li = is_lead.astype(jnp.int32)
+    lf = is_lead.astype(jnp.float32)
+
+    t = m.partition_topic[p]
+    d = jnp.clip(disk_row, 0, m.D - 1)
+    disk_ok = valid & (disk_row >= 0)
+
+    return BrokerAggregates(
+        broker_load=agg.broker_load.at[:, b].add(w_f * slot_load),
+        replica_count=agg.replica_count.at[b].add(w_i * vi),
+        leader_count=agg.leader_count.at[b].add(w_i * li),
+        potential_nw_out=agg.potential_nw_out.at[b].add(
+            w_f * lead_load[Resource.NW_OUT] * vf
+        ),
+        leader_bytes_in=agg.leader_bytes_in.at[b].add(
+            w_f * lead_load[Resource.NW_IN] * lf
+        ),
+        topic_replica_count=agg.topic_replica_count.at[t, b].add(w_i * vi),
+        topic_leader_count=agg.topic_leader_count.at[t, b].add(w_i * li),
+        disk_load=agg.disk_load.at[b, d].add(
+            w_f * slot_load[Resource.DISK] * disk_ok.astype(jnp.float32)
+        ),
+    )
+
+
+def partition_row_sums(
+    m: TensorClusterModel,
+    p: jnp.ndarray,
+    assign_row: jnp.ndarray,
+    leader_slot_p: jnp.ndarray,
+    disk_row: jnp.ndarray,
+) -> jnp.ndarray:
+    """float32[4] — one partition's contribution to PARTITION_GOALS sums."""
+    return pt.partition_sums(
+        m,
+        assign_row[None, :],
+        leader_slot_p[None],
+        disk_row[None, :],
+        m.partition_valid[p][None],
+    )
+
+
+def make_goal_vector_fn(
+    m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig
+):
+    """Build ``(agg, part_sums) -> costs f32[G]`` in goal-priority order.
+
+    Aggregate-based goals are the registered kernels evaluated against the
+    *static* model attributes + the live aggregates; per-partition goals read
+    the incrementally-maintained sums.
+    """
+    part_idx = {n: i for i, n in enumerate(pt.PARTITION_GOALS)}
+    for name in goal_names:
+        if GOAL_REGISTRY[name].placement_dependent and name not in part_idx:
+            raise ValueError(
+                f"goal {name} reads per-partition placement but has no "
+                "incrementally-maintained sum; it cannot be searched "
+                "(add it to partition_terms.PARTITION_GOALS or evaluate "
+                "it via evaluate_stack only)"
+            )
+    # PreferredLeaderElectionGoal's kernel cost is violations / n_partitions.
+    inv_np = 1.0 / jnp.maximum(jnp.sum(m.partition_valid).astype(jnp.float32), 1.0)
+
+    def vector_fn(agg: BrokerAggregates, part_sums: jnp.ndarray) -> jnp.ndarray:
+        costs = []
+        for name in goal_names:
+            if name in part_idx:
+                c = part_sums[part_idx[name]]
+                if name == "PreferredLeaderElectionGoal":
+                    c = c * inv_np
+            else:
+                c = GOAL_REGISTRY[name].fn(m, agg, cfg).cost
+            costs.append(c)
+        return jnp.stack(costs)
+
+    return vector_fn
+
+
+def make_cost_fn(m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig):
+    """Build ``(agg, part_sums) -> (hard_cost, soft_cost)`` for a goal stack.
+
+    Priority semantics follow ccx.goals.stack: hard goals sum into hard_cost,
+    soft goals are tier-weighted into soft_cost (SURVEY.md section 7.4).
+    """
+    hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
+    weights = soft_weights(hard_mask)
+    vector_fn = make_goal_vector_fn(m, goal_names, cfg)
+
+    def cost_fn(agg: BrokerAggregates, part_sums: jnp.ndarray):
+        cv = vector_fn(agg, part_sums)
+        hmask = jnp.asarray(hard_mask)
+        hard = jnp.sum(jnp.where(hmask, cv, 0.0))
+        soft = jnp.sum(jnp.where(hmask, 0.0, cv * weights))
+        return hard, soft
+
+    return cost_fn
+
+
+def init_search_state(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...],
+    key: jnp.ndarray,
+) -> SearchState:
+    """Full (non-incremental) evaluation of the starting state."""
+    agg = broker_aggregates(m)
+    part_sums = pt.partition_sums(
+        m, m.assignment, m.leader_slot, m.replica_disk, m.partition_valid
+    )
+    hard, soft = make_cost_fn(m, goal_names, cfg)(agg, part_sums)
+    return SearchState(
+        assignment=m.assignment,
+        leader_slot=m.leader_slot,
+        replica_disk=m.replica_disk,
+        agg=agg,
+        part_sums=part_sums,
+        hard_cost=hard,
+        soft_cost=soft,
+        key=key,
+        n_accepted=jnp.asarray(0, jnp.int32),
+    )
+
+
+def with_placement(m: TensorClusterModel, s: SearchState) -> TensorClusterModel:
+    """Rebuild a TensorClusterModel carrying a search state's placement."""
+    return m.replace(
+        assignment=s.assignment,
+        leader_slot=s.leader_slot,
+        replica_disk=s.replica_disk,
+    )

@@ -1,0 +1,166 @@
+"""OptimizationVerifier equivalent — post-condition checks on optimizations.
+
+Parity: the reference's analyzer tests never assert move-for-move golden
+outputs; ``analyzer/OptimizationVerifier.java`` asserts *post-conditions*
+after a goal run (hard goals satisfied, stats improved, proposals
+self-consistent, dead brokers evacuated — SURVEY.md section 4). This module
+is that verifier for the tensor model, used by the test suite, the optimizer
+service (sanity gate before returning proposals), and the benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
+from ccx.model.tensor_model import TensorClusterModel
+from ccx.proposals import ExecutionProposal
+
+
+@dataclasses.dataclass
+class Verification:
+    ok: bool
+    failures: list[str]
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+
+def verify_model_consistency(m: TensorClusterModel) -> list[str]:
+    """Structural invariants any placement must satisfy (ClusterModel
+    invariants, SURVEY.md C1)."""
+    failures: list[str] = []
+    a = np.asarray(m.assignment)
+    pvalid = np.asarray(m.partition_valid)
+    bvalid = np.asarray(m.broker_valid)
+    leader = np.asarray(m.leader_slot)
+
+    if np.any(a[pvalid] >= m.B):
+        failures.append("replica assigned to out-of-range broker index")
+    placed = a[pvalid]
+    placed_valid = placed >= 0
+    refs = placed[placed_valid]
+    if refs.size and not bvalid[refs].all():
+        failures.append("replica assigned to an invalid (padding) broker")
+
+    # distinct brokers within each replica set
+    for p in np.nonzero(pvalid)[0]:
+        row = a[p][a[p] >= 0]
+        if len(set(row.tolist())) != len(row):
+            failures.append(f"partition {p}: duplicate broker in replica set")
+            break
+
+    # leader slot points at a live replica slot
+    lp = leader[pvalid]
+    rows = a[pvalid]
+    lead_b = rows[np.arange(rows.shape[0]), np.clip(lp, 0, m.R - 1)]
+    if np.any((lp < 0) | (lp >= m.R)) or np.any(lead_b < 0):
+        failures.append("leader slot does not hold a replica")
+    return failures
+
+
+def verify_optimization(
+    before: TensorClusterModel,
+    after: TensorClusterModel,
+    cfg: GoalConfig = GoalConfig(),
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    proposals: list[ExecutionProposal] | None = None,
+    require_hard_zero: bool = True,
+    check_evacuation: bool = True,
+    stack_before: "StackResult | None" = None,
+    stack_after: "StackResult | None" = None,
+) -> Verification:
+    """The reference verifier's post-conditions, tensor-model edition:
+
+    1. structural consistency of the optimized placement;
+    2. replication factor preserved per partition;
+    3. excluded (immovable) partitions untouched;
+    4. dead brokers fully evacuated (self-healing, SURVEY.md section 5.3);
+    5. hard goals satisfied (or at least not worsened);
+    6. soft stats not worsened (tiered scalar);
+    7. proposals consistent with the before/after placements.
+    """
+    failures = verify_model_consistency(after)
+
+    a0 = np.asarray(before.assignment)
+    a1 = np.asarray(after.assignment)
+    pvalid = np.asarray(before.partition_valid)
+
+    rf0 = (a0 >= 0).sum(axis=1)
+    rf1 = (a1 >= 0).sum(axis=1)
+    if np.any(rf0[pvalid] != rf1[pvalid]):
+        failures.append("replication factor changed by optimization")
+
+    immovable = np.asarray(before.partition_immovable) & pvalid
+    if np.any(a0[immovable] != a1[immovable]):
+        failures.append("excluded/immovable partition was moved")
+    l0 = np.asarray(before.leader_slot)
+    l1 = np.asarray(after.leader_slot)
+    if np.any(l0[immovable] != l1[immovable]):
+        failures.append("excluded/immovable partition's leadership was moved")
+
+    if check_evacuation:
+        # disk-only stacks (rebalance_disk) cannot evacuate brokers; callers
+        # disable this check there
+        dead = ~(np.asarray(after.broker_alive) & np.asarray(after.broker_valid))
+        placed = a1[pvalid]
+        on_dead = placed[(placed >= 0)]
+        if on_dead.size and dead[on_dead].any():
+            failures.append("dead broker not evacuated")
+
+    s0 = stack_before if stack_before is not None else evaluate_stack(before, cfg, goal_names)
+    s1 = stack_after if stack_after is not None else evaluate_stack(after, cfg, goal_names)
+    hard_names = [n for n in goal_names if GOAL_REGISTRY[n].hard]
+    v1 = s1.by_name()
+    v0 = s0.by_name()
+    for n in hard_names:
+        if require_hard_zero:
+            if v1[n][0] > 0:
+                failures.append(f"hard goal {n}: {v1[n][0]:.0f} violations remain")
+        elif v1[n][0] > v0[n][0]:
+            failures.append(f"hard goal {n}: violations increased")
+
+    soft0 = float(s0.soft_scalar)
+    soft1 = float(s1.soft_scalar)
+    if soft1 > soft0 * (1.0 + 1e-4) + 1e-6:
+        failures.append(f"soft cost worsened: {soft0:.4f} -> {soft1:.4f}")
+
+    if proposals is not None:
+        failures.extend(_verify_proposals(before, after, proposals))
+
+    return Verification(ok=not failures, failures=failures)
+
+
+def _verify_proposals(
+    before: TensorClusterModel,
+    after: TensorClusterModel,
+    proposals: list[ExecutionProposal],
+) -> list[str]:
+    failures = []
+    a0 = np.asarray(before.assignment)
+    a1 = np.asarray(after.assignment)
+    l0 = np.asarray(before.leader_slot)
+    l1 = np.asarray(after.leader_slot)
+    d0 = np.asarray(before.replica_disk)
+    d1 = np.asarray(after.replica_disk)
+    by_p = {pr.partition: pr for pr in proposals}
+    for pr in proposals:
+        p = pr.partition
+        if tuple(b for b in a0[p] if b >= 0) != pr.old_replicas:
+            failures.append(f"proposal {p}: old replicas mismatch")
+        if tuple(b for b in a1[p] if b >= 0) != pr.new_replicas:
+            failures.append(f"proposal {p}: new replicas mismatch")
+
+    # every changed partition must be covered by a proposal
+    pvalid = np.asarray(before.partition_valid)
+    changed = pvalid & (
+        np.any(a0 != a1, axis=1) | (l0 != l1) | np.any(d0 != d1, axis=1)
+    )
+    for p in np.nonzero(changed)[0]:
+        if int(p) not in by_p:
+            failures.append(f"changed partition {p} missing from proposals")
+            break
+    return failures

@@ -1,0 +1,210 @@
+"""Search-engine tests.
+
+Mirrors the reference's analyzer test strategy (SURVEY.md section 4):
+synthetic clusters + post-condition verification, not golden outputs.
+RandomClusterTest / RandomSelfHealingTest -> the anneal tests here;
+OptimizationVerifier -> ccx.verify assertions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, evaluate_stack
+from ccx.goals import partition_terms as pt
+from ccx.model.aggregates import broker_aggregates
+from ccx.model.fixtures import RandomClusterSpec, random_cluster, small_deterministic
+from ccx.optimizer import OptimizeOptions, optimize
+from ccx.proposals import ActionType, diff
+from ccx.search import AnnealOptions, anneal, init_search_state
+from ccx.search.annealer import ProposalParams, _run_chains
+from ccx.search.greedy import GreedyOptions, greedy_optimize
+from ccx.verify import verify_model_consistency, verify_optimization
+
+CFG = GoalConfig()
+
+#: One compiled configuration reused across tests (compile dominates CPU time).
+SMALL_SPEC = RandomClusterSpec(n_brokers=8, n_racks=4, n_topics=6, n_partitions=96, seed=11)
+SMALL_OPTS = AnnealOptions(n_chains=8, n_steps=1500, seed=3)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    return random_cluster(SMALL_SPEC)
+
+
+@pytest.fixture(scope="module")
+def annealed(small_model):
+    return anneal(small_model, CFG, DEFAULT_GOAL_ORDER, SMALL_OPTS)
+
+
+def test_init_state_matches_full_eval(small_model):
+    m = small_model
+    s = init_search_state(m, CFG, DEFAULT_GOAL_ORDER, jax.random.PRNGKey(0))
+    agg = broker_aggregates(m)
+    np.testing.assert_allclose(
+        np.asarray(s.agg.broker_load), np.asarray(agg.broker_load), rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.agg.replica_count), np.asarray(agg.replica_count)
+    )
+    sums = pt.partition_sums(
+        m, m.assignment, m.leader_slot, m.replica_disk, m.partition_valid
+    )
+    np.testing.assert_allclose(np.asarray(s.part_sums), np.asarray(sums))
+
+    stack = evaluate_stack(m, CFG, DEFAULT_GOAL_ORDER)
+    # hard cost of the incremental state == stack hard cost
+    np.testing.assert_allclose(
+        float(s.hard_cost), float(stack.hard_cost), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(s.soft_cost), float(stack.soft_scalar), rtol=1e-4
+    )
+
+
+def test_incremental_aggregates_match_full_recompute(small_model):
+    """After annealing, the incrementally-maintained aggregates must match a
+    from-scratch recompute of the final placement (drift bound)."""
+    m = small_model
+    keys = jax.random.split(jax.random.PRNGKey(0), SMALL_OPTS.n_chains)
+    p_real = int(np.asarray(m.n_partitions))
+    states = _run_chains(
+        m, keys, jnp.zeros(1, jnp.int32), jnp.asarray(0, jnp.int32),
+        goal_names=DEFAULT_GOAL_ORDER, cfg=CFG, opts=SMALL_OPTS,
+        p_real=p_real, b_real=8,
+    )
+    pick = jax.tree.map(lambda a: a[0], states)
+    m2 = m.replace(
+        assignment=pick.assignment,
+        leader_slot=pick.leader_slot,
+        replica_disk=pick.replica_disk,
+    )
+    fresh = broker_aggregates(m2)
+    np.testing.assert_array_equal(
+        np.asarray(pick.agg.replica_count), np.asarray(fresh.replica_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pick.agg.leader_count), np.asarray(fresh.leader_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pick.agg.topic_replica_count),
+        np.asarray(fresh.topic_replica_count),
+    )
+    np.testing.assert_allclose(
+        np.asarray(pick.agg.broker_load),
+        np.asarray(fresh.broker_load),
+        rtol=1e-3, atol=1e-2,
+    )
+    # the float aggregates most exposed to scatter sign/role-mask errors
+    np.testing.assert_allclose(
+        np.asarray(pick.agg.potential_nw_out),
+        np.asarray(fresh.potential_nw_out),
+        rtol=1e-3, atol=1e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pick.agg.leader_bytes_in),
+        np.asarray(fresh.leader_bytes_in),
+        rtol=1e-3, atol=1e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pick.agg.disk_load),
+        np.asarray(fresh.disk_load),
+        rtol=1e-3, atol=1e-2,
+    )
+    fresh_sums = pt.partition_sums(
+        m2, m2.assignment, m2.leader_slot, m2.replica_disk, m2.partition_valid
+    )
+    np.testing.assert_allclose(np.asarray(pick.part_sums), np.asarray(fresh_sums))
+
+
+def test_anneal_improves_and_is_consistent(annealed, small_model):
+    res = annealed
+    assert float(res.stack_after.hard_cost) <= float(res.stack_before.hard_cost)
+    assert float(res.stack_after.soft_scalar) < float(res.stack_before.soft_scalar)
+    assert res.n_accepted > 0
+    assert not verify_model_consistency(res.model)
+
+
+def test_anneal_reaches_hard_feasibility(annealed):
+    hard = float(annealed.stack_after.hard_cost)
+    offenders = {
+        k: v for k, v in annealed.stack_after.by_name().items() if v[0] > 0
+    }
+    assert hard == 0.0, f"hard violations remain: {offenders}"
+
+
+def test_proposals_diff_roundtrip(annealed, small_model):
+    props = diff(small_model, annealed.model)
+    assert props, "annealing should have moved something"
+    v = verify_optimization(
+        small_model, annealed.model, CFG, DEFAULT_GOAL_ORDER,
+        proposals=props, require_hard_zero=False,
+    )
+    assert v.ok, v.failures
+    kinds = {a for p in props for a in p.actions}
+    assert ActionType.INTER_BROKER_REPLICA_MOVEMENT in kinds
+
+
+def test_greedy_oracle_improves(small_model):
+    res = greedy_optimize(
+        small_model, CFG, DEFAULT_GOAL_ORDER,
+        GreedyOptions(n_candidates=128, max_iters=60, patience=4, seed=5),
+    )
+    # lexicographic: first position that changed must have improved
+    before = [c for _, c in res.stack_before.by_name().values()]
+    after = [c for _, c in res.stack_after.by_name().values()]
+    changed = [(b, a) for b, a in zip(before, after) if abs(b - a) > 1e-6]
+    assert res.n_moves > 0
+    assert changed and changed[0][1] < changed[0][0]
+    # greedy must never worsen the hard tier
+    assert float(res.stack_after.hard_cost) <= float(res.stack_before.hard_cost) + 1e-4
+
+
+def test_dead_broker_evacuation():
+    """Self-healing scenario (ref RandomSelfHealingTest / B3): all replicas
+    must leave dead brokers, and the result must stay structurally sound."""
+    spec = RandomClusterSpec(
+        n_brokers=8, n_racks=4, n_topics=6, n_partitions=96,
+        n_dead_brokers=2, seed=13,
+    )
+    m = random_cluster(spec)
+    dead = ~np.asarray(m.broker_alive) & np.asarray(m.broker_valid)
+    a0 = np.asarray(m.assignment)
+    assert dead[a0[a0 >= 0]].any(), "fixture should start with replicas on dead brokers"
+
+    res = anneal(m, CFG, DEFAULT_GOAL_ORDER, SMALL_OPTS)
+    a1 = np.asarray(res.model.assignment)
+    assert not dead[a1[a1 >= 0]].any(), "dead brokers must be fully evacuated"
+    assert not verify_model_consistency(res.model)
+
+
+def test_immovable_partitions_respected(small_model):
+    m = small_model
+    immovable = np.zeros(m.P, bool)
+    immovable[:10] = True
+    m2 = m.replace(partition_immovable=jnp.asarray(immovable))
+    res = anneal(m2, CFG, DEFAULT_GOAL_ORDER, SMALL_OPTS)
+    np.testing.assert_array_equal(
+        np.asarray(res.model.assignment)[:10], np.asarray(m.assignment)[:10]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.model.leader_slot)[:10], np.asarray(m.leader_slot)[:10]
+    )
+
+
+def test_optimize_end_to_end(small_model):
+    res = optimize(
+        small_model, CFG, DEFAULT_GOAL_ORDER,
+        OptimizeOptions(
+            anneal=SMALL_OPTS,
+            polish=GreedyOptions(n_candidates=128, max_iters=40, patience=4),
+        ),
+    )
+    assert res.verification.ok, res.verification.failures
+    assert res.proposals
+    j = res.to_json()
+    assert j["numReplicaMovements"] > 0
+    assert all("goal" in g for g in j["goalSummary"])
