@@ -1,0 +1,143 @@
+"""Hard-goal infeasibility proofs — OptimizationFailureException parity.
+
+The reference raises ``OptimizationFailureException`` when a hard goal is
+violated and *unfixable* (SURVEY.md C16: "violation => Optimization-
+FailureException if unfixable"). The tensor rebuild separates the two
+concerns: search reduces violations; this module supplies *conservative
+lower-bound proofs* that no placement could satisfy a hard goal, so the
+verifier (ccx.verify) and service can distinguish "the input is impossible"
+from "the search under-converged". A goal reported here is provably
+infeasible; absence of a report does NOT prove feasibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ccx.common.resources import Resource
+from ccx.goals.base import GoalConfig
+from ccx.model.tensor_model import TensorClusterModel
+
+_CAPACITY_GOAL_RESOURCE = {
+    "CpuCapacityGoal": Resource.CPU,
+    "NetworkInboundCapacityGoal": Resource.NW_IN,
+    "NetworkOutboundCapacityGoal": Resource.NW_OUT,
+    "DiskCapacityGoal": Resource.DISK,
+}
+
+
+@dataclasses.dataclass
+class FeasibilityReport:
+    """goal name -> human-readable proof of infeasibility."""
+
+    infeasible: dict[str, str]
+
+    def __contains__(self, goal: str) -> bool:
+        return goal in self.infeasible
+
+    def to_json(self) -> dict:
+        return dict(self.infeasible)
+
+
+def feasibility_report(
+    m: TensorClusterModel, cfg: GoalConfig = GoalConfig()
+) -> FeasibilityReport:
+    out: dict[str, str] = {}
+    pvalid = np.asarray(m.partition_valid)
+    alive = np.asarray(m.broker_alive & m.broker_valid)
+    n_alive = int(alive.sum())
+    a = np.asarray(m.assignment)
+    rf = ((a >= 0) & pvalid[:, None]).sum(axis=1)
+    lead = np.asarray(m.leader_load)[:, : m.P]
+    foll = np.asarray(m.follower_load)[:, : m.P]
+
+    if n_alive == 0:
+        return FeasibilityReport({"StructuralFeasibility": "no alive brokers"})
+
+    # --- capacity goals ----------------------------------------------------
+    cap = np.asarray(m.broker_capacity)
+    for goal, res in _CAPACITY_GOAL_RESOURCE.items():
+        th = cfg.capacity_threshold[int(res)]
+        allowed = np.where(alive, cap[res] * th, 0.0)
+        max_allowed = float(allowed.max(initial=0.0))
+        # (a) some partition's leader alone exceeds every broker's allowance
+        # (every partition must lead somewhere; follower load <= leader load
+        # for all resources, so the leader bound is the tight one).
+        worst = float(np.where(pvalid, lead[res], 0.0).max(initial=0.0))
+        if worst > max_allowed * (1 + 1e-6):
+            out[goal] = (
+                f"partition leader load {worst:.3f} exceeds max broker "
+                f"allowance {max_allowed:.3f} ({res.name})"
+            )
+            continue
+        # (b) total minimal load exceeds total allowance
+        total = float(
+            np.sum(np.where(pvalid, lead[res] + foll[res] * np.maximum(rf - 1, 0), 0.0))
+        )
+        if total > float(allowed.sum()) * (1 + 1e-6):
+            out[goal] = (
+                f"total load {total:.3f} exceeds cluster allowance "
+                f"{float(allowed.sum()):.3f} ({res.name})"
+            )
+
+    # --- replica count capacity -------------------------------------------
+    total_replicas = int(rf.sum())
+    if total_replicas > cfg.max_replicas_per_broker * n_alive:
+        out["ReplicaCapacityGoal"] = (
+            f"{total_replicas} replicas > {cfg.max_replicas_per_broker:.0f} "
+            f"per broker x {n_alive} alive brokers"
+        )
+
+    # --- rack awareness ----------------------------------------------------
+    racks = np.asarray(m.broker_rack)
+    n_alive_racks = len(set(racks[alive].tolist()))
+    max_rf = int(rf.max(initial=0))
+    if max_rf > n_alive_racks:
+        out["RackAwareGoal"] = (
+            f"replication factor {max_rf} > {n_alive_racks} racks with alive brokers"
+        )
+    # RackAwareDistribution allows ceil(rf/#racks) per rack — always
+    # satisfiable when enough alive brokers exist per rack; conservative:
+    # only flag when some partition's rf exceeds the total alive brokers.
+    if max_rf > n_alive:
+        out["RackAwareDistributionGoal"] = (
+            f"replication factor {max_rf} > {n_alive} alive brokers"
+        )
+        out.setdefault(
+            "StructuralFeasibility",
+            f"replication factor {max_rf} > {n_alive} alive brokers",
+        )
+
+    # --- min topic leaders -------------------------------------------------
+    tml = np.asarray(m.topic_min_leaders)
+    if tml.any():
+        # only brokers that may hold leadership need min leaders
+        eligible = alive & ~np.asarray(m.broker_excl_leadership)
+        n_eligible = int(eligible.sum())
+        topics = np.asarray(m.partition_topic)
+        for t in np.nonzero(tml)[0]:
+            n_parts = int(np.sum(pvalid & (topics == t)))
+            need = cfg.min_topic_leaders_per_broker * n_eligible
+            if 0 < n_parts < need:
+                out["MinTopicLeadersPerBrokerGoal"] = (
+                    f"topic {t}: {n_parts} partitions < "
+                    f"{cfg.min_topic_leaders_per_broker} leaders x "
+                    f"{n_eligible} eligible brokers"
+                )
+                break
+
+    # --- JBOD disk capacity ------------------------------------------------
+    disk_alive = np.asarray(m.disk_alive) & alive[:, None]
+    if disk_alive.any():
+        dcap = np.asarray(m.disk_capacity)
+        allowance = np.where(disk_alive, dcap * cfg.intra_disk_capacity_threshold, 0.0)
+        worst_disk_load = float(np.where(pvalid, lead[Resource.DISK], 0.0).max(initial=0.0))
+        if worst_disk_load > float(allowance.max(initial=0.0)) * (1 + 1e-6):
+            out["IntraBrokerDiskCapacityGoal"] = (
+                f"partition disk load {worst_disk_load:.3f} exceeds max disk "
+                f"allowance {float(allowance.max(initial=0.0)):.3f}"
+            )
+
+    return FeasibilityReport(out)

@@ -159,7 +159,9 @@ register_goal("DiskUsageDistributionGoal", hard=False)(_usage_distribution_goal(
 @register_goal("ReplicaDistributionGoal", hard=False)
 def replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
     alive = _alive(m)
-    avg = m.n_replicas.astype(jnp.float32) / _n_alive(m)
+    # Replica total from the aggregates (== m.n_replicas, but stays correct
+    # when the partition axis is sharded and agg has been psum'd — ccx.parallel).
+    avg = jnp.sum(agg.replica_count).astype(jnp.float32) / _n_alive(m)
     n, cost = _band_penalty(agg.replica_count.astype(jnp.float32), alive, avg, cfg.replica_balance_threshold)
     return result(n, cost)
 
@@ -167,7 +169,9 @@ def replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: Goal
 @register_goal("LeaderReplicaDistributionGoal", hard=False)
 def leader_replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
     alive = _alive(m) & ~m.broker_excl_leadership
-    avg = m.n_partitions.astype(jnp.float32) / jnp.maximum(jnp.sum(alive), 1)
+    # Leader total == valid-partition count; derived from agg for shard-safety.
+    n_parts = jnp.sum(agg.leader_count).astype(jnp.float32)
+    avg = n_parts / jnp.maximum(jnp.sum(alive), 1)
     n, cost = _band_penalty(agg.leader_count.astype(jnp.float32), alive, avg, cfg.leader_balance_threshold)
     return result(n, cost)
 
@@ -214,7 +218,7 @@ def preferred_leader_election(m: TensorClusterModel, agg: BrokerAggregates, cfg:
     n = jnp.sum(
         pt.preferred_leader_rows(m, m.assignment, m.leader_slot, m.partition_valid)
     )
-    return result(n, n / jnp.maximum(m.n_partitions.astype(jnp.float32), 1.0))
+    return result(n, n / jnp.maximum(jnp.sum(agg.leader_count).astype(jnp.float32), 1.0))
 
 
 # --------------------------------------------------------------------------
@@ -253,7 +257,7 @@ def kafka_assigner_even_rack_aware(m: TensorClusterModel, agg: BrokerAggregates,
     over brokers (ref: KafkaAssignerEvenRackAwareGoal)."""
     ra = rack_aware(m, agg, cfg)
     alive = _alive(m)
-    avg = m.n_partitions.astype(jnp.float32) / _n_alive(m)
+    avg = jnp.sum(agg.leader_count).astype(jnp.float32) / _n_alive(m)
     upper = jnp.ceil(avg)
     over = jnp.where(alive, jnp.maximum(agg.leader_count - upper, 0.0), 0.0)
     n = ra.violations + jnp.sum(over > 0).astype(jnp.float32)

@@ -76,6 +76,7 @@ class OptimizerResult:
             ],
             "verified": self.verification.ok,
             "verificationFailures": self.verification.failures,
+            "optimizationFailures": self.verification.infeasible,
             "wallSeconds": self.wall_seconds,
         }
 
@@ -85,6 +86,10 @@ class OptimizeOptions:
     anneal: AnnealOptions = AnnealOptions()
     polish: GreedyOptions = GreedyOptions(n_candidates=256, max_iters=400)
     run_polish: bool = True
+    #: extra polish rounds while hard violations remain — each round rebuilds
+    #: the hot-partition list from the current placement so the remaining
+    #: offenders are targeted (SURVEY.md section 7.4 repair passes)
+    max_repair_rounds: int = 3
     require_hard_zero: bool = True
     #: disable for disk-only stacks — intra-broker moves cannot evacuate
     #: a dead broker
@@ -108,6 +113,15 @@ def optimize(
         model = polish.model
         stack_after = polish.stack_after
         n_polish = polish.n_moves
+        for _ in range(max(opts.max_repair_rounds - 1, 0)):
+            if float(stack_after.hard_violations) <= 0:
+                break
+            polish = greedy_optimize(model, cfg, goal_names, opts.polish)
+            if polish.n_moves == 0:
+                break
+            model = polish.model
+            stack_after = polish.stack_after
+            n_polish += polish.n_moves
     proposals = diff(m, model)
     verification = verify_optimization(
         m,

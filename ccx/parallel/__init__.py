@@ -1,0 +1,15 @@
+from ccx.parallel.sharding import (
+    make_mesh,
+    model_pspecs,
+    replicate,
+    shard_model,
+    sharded_stack_eval,
+)
+
+__all__ = [
+    "make_mesh",
+    "model_pspecs",
+    "replicate",
+    "shard_model",
+    "sharded_stack_eval",
+]

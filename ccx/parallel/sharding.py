@@ -1,0 +1,169 @@
+"""Device-mesh sharding of the cluster model and search.
+
+The reference scales by cluster size (brokers x partitions) inside one JVM
+heap (SURVEY.md section 5.7 "the reference's long-sequence axis is cluster
+size"); its concurrency axes are thread pools (section 2.5). The TPU-native
+scale-out story replaces both with a 2-axis ``jax.sharding.Mesh``:
+
+* ``chains`` — data parallelism over independent SA chains (the descendant of
+  ``num.proposal.precompute.threads``): each device runs its own chains; the
+  only cross-device step is the final lexicographic argmin.
+* ``parts`` — sequence-parallel-style sharding of the *partition axis* of the
+  model tensors: broker aggregates are segment-sums over partitions, so each
+  device reduces its shard and a ``psum`` over ICI produces the global
+  aggregates (the XLA-collective equivalent of the reference's single-heap
+  O(P) walks).
+
+Everything here composes with jit: ``shard_map`` bodies contain the explicit
+collectives; XLA lays the psums on ICI when the mesh spans real chips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ccx.goals import partition_terms as pt
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult
+from ccx.model.aggregates import broker_aggregates
+from ccx.model.tensor_model import TensorClusterModel
+
+CHAINS_AXIS = "chains"
+PARTS_AXIS = "parts"
+
+
+def make_mesh(
+    devices: list | None = None, parts: int | None = None
+) -> Mesh:
+    """A (chains x parts) mesh over the given (default: all) devices.
+
+    By default the device count is split with a small ``parts`` factor —
+    partition-axis sharding only pays off for very large clusters, while
+    chain parallelism is embarrassingly parallel — callers with 100k+
+    partition models should raise ``parts``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if parts is None:
+        parts = 2 if n % 2 == 0 and n > 1 else 1
+    chains = n // parts
+    if chains * parts != n:
+        raise ValueError(f"{n} devices not divisible into parts={parts}")
+    return Mesh(
+        np.asarray(devices[: chains * parts]).reshape(chains, parts),
+        (CHAINS_AXIS, PARTS_AXIS),
+    )
+
+
+def model_pspecs(m: TensorClusterModel) -> TensorClusterModel:
+    """PartitionSpec pytree for a TensorClusterModel: partition-axis arrays
+    sharded over ``parts``; broker/disk/topic arrays replicated (they are
+    O(B) and every device needs them to score aggregates)."""
+    return TensorClusterModel(
+        assignment=P(PARTS_AXIS, None),
+        leader_slot=P(PARTS_AXIS),
+        replica_disk=P(PARTS_AXIS, None),
+        partition_valid=P(PARTS_AXIS),
+        partition_topic=P(PARTS_AXIS),
+        partition_immovable=P(PARTS_AXIS),
+        leader_load=P(None, PARTS_AXIS),
+        follower_load=P(None, PARTS_AXIS),
+        broker_capacity=P(),
+        broker_rack=P(),
+        broker_valid=P(),
+        broker_alive=P(),
+        broker_new=P(),
+        broker_excl_replicas=P(),
+        broker_excl_leadership=P(),
+        disk_capacity=P(),
+        disk_alive=P(),
+        topic_min_leaders=P(),
+        num_topics=m.num_topics,
+        num_racks=m.num_racks,
+    )
+
+
+def shard_model(m: TensorClusterModel, mesh: Mesh) -> TensorClusterModel:
+    """Place the model on the mesh with the partition axis sharded."""
+    specs = model_pspecs(m)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), m, specs
+    )
+
+
+def replicate(x, mesh: Mesh):
+    """Fully replicate a pytree across the mesh."""
+    return jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P())), x
+    )
+
+
+def sharded_stack_eval(
+    m: TensorClusterModel,
+    cfg: GoalConfig = GoalConfig(),
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    mesh: Mesh | None = None,
+) -> StackResult:
+    """evaluate_stack with the partition axis sharded over ``parts``.
+
+    Each device segment-sums its partition shard into partial broker
+    aggregates and per-partition goal sums; one ``psum`` over the ``parts``
+    axis yields globals; goal kernels then score the (replicated) broker-axis
+    state. Numerically identical to ``ccx.goals.stack.evaluate_stack`` up to
+    float reduction order.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    specs = model_pspecs(m)
+    hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
+    part_idx = {n: i for i, n in enumerate(pt.PARTITION_GOALS)}
+    for name in goal_names:
+        if GOAL_REGISTRY[name].placement_dependent and name not in part_idx:
+            raise ValueError(
+                f"goal {name} reads per-partition placement and has no "
+                "partition_terms row function; it cannot be shard-evaluated"
+            )
+
+    def body(m_local: TensorClusterModel):
+        agg = jax.tree.map(
+            lambda x: jax.lax.psum(x, PARTS_AXIS), broker_aggregates(m_local)
+        )
+        psums = jax.lax.psum(
+            pt.partition_sums(
+                m_local,
+                m_local.assignment,
+                m_local.leader_slot,
+                m_local.replica_disk,
+                m_local.partition_valid,
+            ),
+            PARTS_AXIS,
+        )
+        inv_np = 1.0 / jnp.maximum(
+            jnp.sum(agg.leader_count).astype(jnp.float32), 1.0
+        )
+        vio, cost = [], []
+        for name in goal_names:
+            if name in part_idx:
+                v = psums[part_idx[name]]
+                c = v * inv_np if name == "PreferredLeaderElectionGoal" else v
+            else:
+                r = GOAL_REGISTRY[name].fn(m_local, agg, cfg)
+                v, c = r.violations, r.cost
+            vio.append(v)
+            cost.append(c)
+        return jnp.stack(vio), jnp.stack(cost)
+
+    fn = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(specs,), out_specs=(P(), P()))
+    )
+    violations, costs = fn(m)
+    return StackResult(
+        names=tuple(goal_names),
+        hard_mask=hard_mask,
+        violations=violations,
+        costs=costs,
+    )

@@ -46,14 +46,14 @@ class ExecutionProposal:
             acts.append(ActionType.INTER_BROKER_REPLICA_MOVEMENT)
         if self.old_leader != self.new_leader:
             acts.append(ActionType.LEADERSHIP_MOVEMENT)
-        moved = {
-            (b, d)
-            for b, d in zip(self.new_replicas, self.new_disks)
-            if (b, d) not in set(zip(self.old_replicas, self.old_disks))
-        }
+        # A broker present before and after whose replica changed disks is an
+        # intra-broker move — independent of any inter-broker change on the
+        # partition's *other* replicas.
+        old_disk_of = dict(zip(self.old_replicas, self.old_disks))
         if self.old_disks and any(
-            b in self.old_replicas for b, _ in moved
-        ) and set(self.old_replicas) == set(self.new_replicas):
+            b in old_disk_of and old_disk_of[b] != d
+            for b, d in zip(self.new_replicas, self.new_disks)
+        ):
             acts.append(ActionType.INTRA_BROKER_REPLICA_MOVEMENT)
         return tuple(acts)
 
@@ -64,13 +64,17 @@ class ExecutionProposal:
         return len(set(self.new_replicas) - set(self.old_replicas))
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "topicPartition": {"topic": int(self.topic), "partition": int(self.partition)},
             "oldLeader": int(self.old_leader),
             "newLeader": int(self.new_leader),
             "oldReplicas": [int(b) for b in self.old_replicas],
             "newReplicas": [int(b) for b in self.new_replicas],
         }
+        if self.old_disks or self.new_disks:
+            out["oldDisks"] = [int(d) for d in self.old_disks]
+            out["newDisks"] = [int(d) for d in self.new_disks]
+        return out
 
 
 def diff(before: TensorClusterModel, after: TensorClusterModel) -> list[ExecutionProposal]:

@@ -89,31 +89,82 @@ class ProposalParams:
     p_leadership: float = 0.15
     p_disk: float = 0.0
     p_biased_dest: float = 0.5
-    #: probability of drawing the partition from the evacuation list (replicas
-    #: initially on dead brokers/disks — the self-healing hot set, SURVEY.md
-    #: section 5.3). Only applied when the list is non-empty.
+    #: probability of drawing the partition from the hot list (replicas on
+    #: dead brokers/disks — the self-healing set, SURVEY.md section 5.3 —
+    #: plus rack-uniqueness offenders when the stack has a rack goal).
+    #: Only applied when the list is non-empty.
     p_evac: float = 0.3
+    #: also target duplicate-rack replica slots on hot draws (set when the
+    #: goal stack contains a rack goal; must stay False for intra-broker
+    #: disk-only stacks, whose moves may not change brokers).
+    target_rack: bool = False
+    #: False for intra-broker-only stacks: hot draws never force an
+    #: inter-broker evacuation move.
+    allow_inter: bool = True
 
 
-def evacuation_list(m: TensorClusterModel) -> tuple[np.ndarray, int]:
-    """Partitions with a replica on a dead broker or dead disk, padded to a
-    power-of-two length (stable jit cache across similar clusters)."""
-    a = np.asarray(m.assignment)
-    ok_broker = np.asarray(m.broker_alive & m.broker_valid)
-    disk_alive = np.asarray(m.disk_alive)
-    rd = np.asarray(m.replica_disk)
-    valid = (a >= 0) & np.asarray(m.partition_valid)[:, None]
-    safe_b = np.clip(a, 0, m.B - 1)
-    safe_d = np.clip(rd, 0, m.D - 1)
-    bad = valid & (
-        ~ok_broker[safe_b] | ((rd >= 0) & ~disk_alive[safe_b, safe_d])
-    )
-    idx = np.nonzero(bad.any(axis=1))[0].astype(np.int32)
+RACK_TARGET_GOALS = frozenset(
+    {"RackAwareGoal", "RackAwareDistributionGoal", "KafkaAssignerEvenRackAwareGoal"}
+)
+
+#: Goals whose stacks move replicas only *within* a broker (rebalance_disk);
+#: such searches must never propose inter-broker moves, including dead-broker
+#: evacuation (SURVEY.md C18).
+INTRA_ONLY_GOALS = frozenset(
+    {"IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal"}
+)
+
+
+def allows_inter_broker(goal_names: tuple[str, ...]) -> bool:
+    return not set(goal_names) <= INTRA_ONLY_GOALS
+
+
+def _pad_pow2(idx: np.ndarray) -> tuple[np.ndarray, int]:
     n = len(idx)
     pad = 1
     while pad < max(n, 1):
         pad *= 2
     return np.pad(idx, (0, pad - n)), n
+
+
+def hot_partition_list(
+    m: TensorClusterModel, goal_names: tuple[str, ...] = ()
+) -> tuple[np.ndarray, int]:
+    """Partitions violating *targetable* hard constraints: structural
+    (dead broker/disk, the self-healing set) plus — when the stack contains a
+    rack goal — rack-uniqueness offenders. Search draws from this list with
+    probability ``p_evac`` so the few offenders in a huge cluster are hit
+    often enough to be repaired (SURVEY.md section 7.4 "proposal
+    distributions"). Intra-broker-only stacks exclude dead-*broker*
+    partitions (unfixable without inter-broker moves)."""
+    hot: set[int] = set()
+    a = np.asarray(m.assignment)
+    pvalid = np.asarray(m.partition_valid)
+    valid = (a >= 0) & pvalid[:, None]
+    if allows_inter_broker(goal_names):
+        on_dead = (
+            valid
+            & ~np.asarray(m.broker_alive & m.broker_valid)[np.clip(a, 0, m.B - 1)]
+        )
+        hot.update(np.unique(np.nonzero(on_dead)[0]).tolist())
+    rd = np.asarray(m.replica_disk)
+    dead_disk = (
+        valid
+        & (rd >= 0)
+        & ~np.asarray(m.disk_alive)[np.clip(a, 0, m.B - 1), np.clip(rd, 0, m.D - 1)]
+    )
+    hot.update(np.unique(np.nonzero(dead_disk)[0]).tolist())
+
+    if RACK_TARGET_GOALS & set(goal_names):
+        racks = np.asarray(m.broker_rack)[np.clip(a, 0, m.B - 1)]
+        racks = np.where(valid, racks, -1 - np.arange(m.R)[None, :])
+        dup = (racks[:, :, None] == racks[:, None, :]) & (
+            np.arange(m.R)[:, None] < np.arange(m.R)[None, :]
+        )
+        hot.update(np.unique(np.nonzero(dup.any(axis=(1, 2)) & pvalid)[0]).tolist())
+
+    idx = np.asarray(sorted(hot), np.int32)
+    return _pad_pow2(idx)
 
 
 def propose_move(
@@ -156,26 +207,44 @@ def propose_move(
     old_leader = state.leader_slot[p]
     old_disk = state.replica_disk[p]          # [R]
 
-    # On an evacuation draw, target the offending slot. A replica on a dead
+    # On a hot-list draw, target the offending slot. A replica on a dead
     # *broker* can only be healed by relocation; a replica on a dead *disk*
     # of a live broker is healed by an intra-broker disk move (keeps the
-    # rebalance_disk contract intra-broker-only when p_disk=1).
+    # rebalance_disk contract intra-broker-only when p_disk=1); a replica
+    # sharing its rack with an earlier slot is healed by relocation to an
+    # unused rack (pp.target_rack).
     ok_b = m.broker_alive & m.broker_valid
     safe_row = jnp.clip(old_assign, 0, B - 1)
     safe_dk = jnp.clip(old_disk, 0, D - 1)
-    dead_broker_slot = (old_assign >= 0) & ~ok_b[safe_row]
+    slot_ok = old_assign >= 0
+    if pp.allow_inter:
+        dead_broker_slot = slot_ok & ~ok_b[safe_row]
+    else:
+        dead_broker_slot = jnp.zeros_like(slot_ok)
     dead_disk_slot = (
-        (old_assign >= 0)
+        slot_ok
         & ok_b[safe_row]
         & (old_disk >= 0)
         & ~m.disk_alive[safe_row, safe_dk]
     )
-    bad_slot = dead_broker_slot | dead_disk_slot
+    row_racks = jnp.where(
+        slot_ok, m.broker_rack[safe_row], -1 - jnp.arange(R, dtype=jnp.int32)
+    )
+    if pp.target_rack:
+        rack_dup_slot = slot_ok & jnp.any(
+            (row_racks[None, :] == row_racks[:, None])
+            & (jnp.arange(R)[None, :] < jnp.arange(R)[:, None]),
+            axis=1,
+        )
+    else:
+        rack_dup_slot = jnp.zeros_like(slot_ok)
+    bad_slot = dead_broker_slot | dead_disk_slot | rack_dup_slot
     has_bad = jnp.any(bad_slot)
     bad_r = jnp.argmax(bad_slot)
     r = jnp.where(use_evac & has_bad, bad_r, r).astype(jnp.int32)
-    evac_kind = jnp.where(dead_broker_slot[bad_r], MOVE_REPLICA, MOVE_DISK)
+    evac_kind = jnp.where(dead_disk_slot[bad_r], MOVE_DISK, MOVE_REPLICA)
     kind = jnp.where(use_evac & has_bad, evac_kind, kind)
+    repair_rack = use_evac & has_bad & rack_dup_slot[bad_r] & ~dead_disk_slot[bad_r]
 
     src = old_assign[r]
     slot_valid = src >= 0
@@ -192,6 +261,17 @@ def propose_move(
     dst_uniform = jax.random.randint(k_dstu, (), 0, pp.b_real)
     use_bias = jax.random.uniform(k_bias) < pp.p_biased_dest
     dst = jnp.where(use_bias, dst_biased, dst_uniform).astype(jnp.int32)
+    if pp.target_rack:
+        # Rack-repair draws relocate onto a rack the partition doesn't use
+        # (when one with headroom exists — otherwise fall through).
+        rack_used = jnp.any(
+            m.broker_rack[None, :] == jnp.where(slot_ok, row_racks, -1)[:, None],
+            axis=0,
+        )  # [B]
+        w_rack = jnp.where(rack_used, 0.0, w)
+        any_free = jnp.any(w_rack > 0)
+        dst_rack = jnp.argmax(jnp.where(w_rack > 0, jnp.log(w_rack) + g, -jnp.inf))
+        dst = jnp.where(repair_rack & any_free, dst_rack, dst).astype(jnp.int32)
 
     # --- feasibility masks (never *create* hard structural violations) -----
     dst_ok = alive_ok[dst] & (dst != src)
@@ -340,6 +420,8 @@ def _run_chains(
         p_disk=opts.p_disk,
         p_biased_dest=opts.p_biased_dest,
         p_evac=opts.p_evac,
+        target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
+        allow_inter=allows_inter_broker(goal_names),
     )
     step = functools.partial(_anneal_step, m=m, cost_fn=cost_fn, pp=pp)
 
@@ -359,6 +441,7 @@ def anneal(
     cfg: GoalConfig = GoalConfig(),
     goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
     opts: AnnealOptions = AnnealOptions(),
+    mesh=None,
 ) -> AnnealResult:
     """Run batched SA and return the best chain's placement as a new model.
 
@@ -367,13 +450,31 @@ def anneal(
     best reachable local optimum; the winner is the lexicographic argmin
     across chains. The returned model's stack scores are re-evaluated from
     scratch (incremental float drift cannot leak into reported results).
+
+    With ``mesh`` (a jax.sharding.Mesh), chains are sharded across every mesh
+    device — pure data parallelism over the batch axis (ccx.parallel); the
+    model and evacuation list are replicated. ``opts.n_chains`` must divide
+    evenly by the mesh size.
     """
     stack_before = evaluate_stack(m, cfg, goal_names)
     p_real = int(np.asarray(m.n_partitions))
     b_real = int(np.asarray(jnp.max(jnp.where(m.broker_valid, jnp.arange(m.B), -1)))) + 1
-    evac, n_evac = evacuation_list(m)
+    evac, n_evac = hot_partition_list(m, goal_names)
 
     keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if opts.n_chains % mesh.size:
+            raise ValueError(
+                f"n_chains={opts.n_chains} not divisible by mesh size {mesh.size}"
+            )
+        keys = jax.device_put(
+            keys, NamedSharding(mesh, PartitionSpec(mesh.axis_names))
+        )
+        m = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, PartitionSpec())), m
+        )
     states = _run_chains(
         m, keys, jnp.asarray(evac), jnp.asarray(n_evac, jnp.int32),
         goal_names=goal_names, cfg=cfg, opts=opts,

@@ -29,7 +29,13 @@ import numpy as np
 from ccx.goals.base import GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
 from ccx.model.tensor_model import TensorClusterModel
-from ccx.search.annealer import ProposalParams, evacuation_list, propose_move
+from ccx.search.annealer import (
+    RACK_TARGET_GOALS,
+    ProposalParams,
+    allows_inter_broker,
+    hot_partition_list,
+    propose_move,
+)
 from ccx.search.state import (
     SearchState,
     init_search_state,
@@ -92,6 +98,13 @@ def _score_candidates(
     return jax.vmap(one)(key)
 
 
+@functools.partial(jax.jit, static_argnames=("goal_names", "cfg"))
+def _eval_vector(agg, part_sums, m, *, goal_names, cfg):
+    """Goal-cost vector of the current state (module-level jit so repeated
+    greedy_optimize calls share the compile cache)."""
+    return make_goal_vector_fn(m, goal_names, cfg)(agg, part_sums)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def _apply_move(
     state: SearchState,
@@ -146,15 +159,18 @@ def greedy_optimize(
         p_disk=opts.p_disk,
         p_biased_dest=opts.p_biased_dest,
         p_evac=opts.p_evac,
+        target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
+        allow_inter=allows_inter_broker(goal_names),
     )
 
-    evac_np, n_evac_i = evacuation_list(m)
+    evac_np, n_evac_i = hot_partition_list(m, goal_names)
     evac = jnp.asarray(evac_np)
     n_evac = jnp.asarray(n_evac_i, jnp.int32)
 
     state = init_search_state(m, cfg, goal_names, jax.random.PRNGKey(opts.seed))
-    vector_fn = jax.jit(make_goal_vector_fn(m, goal_names, cfg))
-    cur = np.asarray(vector_fn(state.agg, state.part_sums))
+    cur = np.asarray(
+        _eval_vector(state.agg, state.part_sums, m, goal_names=goal_names, cfg=cfg)
+    )
 
     key = jax.random.PRNGKey(opts.seed + 1)
     n_moves = 0

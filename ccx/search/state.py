@@ -129,10 +129,13 @@ def make_goal_vector_fn(
                 "(add it to partition_terms.PARTITION_GOALS or evaluate "
                 "it via evaluate_stack only)"
             )
-    # PreferredLeaderElectionGoal's kernel cost is violations / n_partitions.
-    inv_np = 1.0 / jnp.maximum(jnp.sum(m.partition_valid).astype(jnp.float32), 1.0)
-
     def vector_fn(agg: BrokerAggregates, part_sums: jnp.ndarray) -> jnp.ndarray:
+        # PreferredLeaderElectionGoal's kernel cost is violations/n_partitions;
+        # the leader total from agg equals the valid-partition count and stays
+        # correct under partition-axis sharding (psum'd agg, ccx.parallel).
+        inv_np = 1.0 / jnp.maximum(
+            jnp.sum(agg.leader_count).astype(jnp.float32), 1.0
+        )
         costs = []
         for name in goal_names:
             if name in part_idx:
