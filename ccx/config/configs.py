@@ -1,0 +1,419 @@
+"""CruiseControlConfig — the merged per-subsystem key table.
+
+Parity: ``config/{KafkaCruiseControlConfig,MonitorConfig,AnalyzerConfig,
+ExecutorConfig,AnomalyDetectorConfig,WebServerConfig,UserTaskManagerConfig}
+.java`` (SURVEY.md C35). Key names keep the reference's dotted spelling so an
+operator's ``cruisecontrol.properties`` carries over; ccx-specific keys (the
+TPU optimizer backend knobs, north star ``goal.optimizer.backend=tpu``,
+BASELINE.json:5) live under the ``optimizer.*`` prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ccx.config.definition import (
+    NO_DEFAULT,
+    ConfigDef,
+    ConfigException,
+    Importance,
+    Type,
+    at_least,
+    between,
+    load_properties,
+    non_empty,
+    one_of,
+)
+
+# Default goal list — AnalyzerConfig `goals` default order (SURVEY.md §2.3).
+DEFAULT_GOALS = (
+    "RackAwareGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+    "PreferredLeaderElectionGoal",
+)
+
+DEFAULT_HARD_GOALS = (
+    "RackAwareGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+)
+
+
+def monitor_config_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("partition.metrics.window.ms", Type.LONG, 3_600_000, Importance.HIGH,
+             "Span of one partition-metrics aggregation window.", at_least(1))
+    d.define("num.partition.metrics.windows", Type.INT, 5, Importance.HIGH,
+             "Number of partition-metrics windows kept in memory.", at_least(1))
+    d.define("broker.metrics.window.ms", Type.LONG, 300_000, Importance.HIGH,
+             "Span of one broker-metrics aggregation window.", at_least(1))
+    d.define("num.broker.metrics.windows", Type.INT, 20, Importance.HIGH,
+             "Number of broker-metrics windows kept in memory.", at_least(1))
+    d.define("min.samples.per.partition.metrics.window", Type.INT, 1, Importance.MEDIUM,
+             "Minimum samples for a partition window to be valid without "
+             "extrapolation.", at_least(1))
+    d.define("min.samples.per.broker.metrics.window", Type.INT, 1, Importance.MEDIUM,
+             "Minimum samples for a broker window to be valid.", at_least(1))
+    d.define("max.allowed.extrapolations.per.partition", Type.INT, 5, Importance.LOW,
+             "Extrapolated windows allowed before a partition is invalid.", at_least(0))
+    d.define("max.allowed.extrapolations.per.broker", Type.INT, 5, Importance.LOW,
+             "Extrapolated windows allowed before a broker is invalid.", at_least(0))
+    d.define("metric.sampling.interval.ms", Type.LONG, 120_000, Importance.HIGH,
+             "Period of the metric sampling loop.", at_least(1))
+    d.define("num.metric.fetchers", Type.INT, 1, Importance.MEDIUM,
+             "Parallel sampling fetcher threads (partitions sharded across "
+             "them).", at_least(1))
+    d.define("metric.sampler.class", Type.CLASS,
+             "ccx.monitor.sampling.reporter_sampler.ReporterMetricSampler",
+             Importance.HIGH, "MetricSampler SPI implementation (ref C10).")
+    d.define("sample.store.class", Type.CLASS,
+             "ccx.monitor.sampling.sample_store.FileSampleStore",
+             Importance.HIGH,
+             "SampleStore SPI implementation; persists samples and replays "
+             "them on startup for a warm model (ref C11, checkpoint/resume).")
+    d.define("sample.store.dir", Type.STRING, "/tmp/ccx-samples", Importance.MEDIUM,
+             "Directory for the default file-backed sample store.")
+    d.define("broker.capacity.config.resolver.class", Type.CLASS,
+             "ccx.monitor.capacity.FileCapacityResolver",
+             Importance.HIGH, "BrokerCapacityConfigResolver SPI (ref C5).")
+    d.define("capacity.config.file", Type.STRING, "config/capacity.json",
+             Importance.HIGH, "Capacity file for the default resolver.")
+    d.define("monitor.state.update.interval.ms", Type.LONG, 30_000, Importance.LOW,
+             "Refresh period of cached monitor state.", at_least(1))
+    d.define("leader.network.inbound.weight.for.cpu.util", Type.DOUBLE, 0.6,
+             Importance.LOW, "ModelUtils leader NW_IN coefficient for CPU "
+             "estimation (ref C6).", between(0, 10))
+    d.define("leader.network.outbound.weight.for.cpu.util", Type.DOUBLE, 0.1,
+             Importance.LOW, "ModelUtils leader NW_OUT coefficient.", between(0, 10))
+    d.define("follower.network.inbound.weight.for.cpu.util", Type.DOUBLE, 0.3,
+             Importance.LOW, "ModelUtils follower NW_IN coefficient.", between(0, 10))
+    return d
+
+
+def analyzer_config_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("goals", Type.LIST, DEFAULT_GOALS, Importance.HIGH,
+             "Goal class names in priority order (lexicographic semantics).",
+             non_empty)
+    d.define("hard.goals", Type.LIST, DEFAULT_HARD_GOALS, Importance.HIGH,
+             "Subset of goals that must be satisfied.", non_empty)
+    d.define("default.goals", Type.LIST, (), Importance.MEDIUM,
+             "Goals used when a request names none (empty = `goals`).")
+    d.define("self.healing.goals", Type.LIST, (), Importance.MEDIUM,
+             "Goals used by self-healing (empty = hard goals).")
+    d.define("anomaly.detection.goals", Type.LIST, DEFAULT_HARD_GOALS,
+             Importance.MEDIUM, "Goals scored by the goal-violation detector.")
+    d.define("cpu.balance.threshold", Type.DOUBLE, 1.1, Importance.MEDIUM,
+             "Max broker CPU utilization ratio vs cluster average.", at_least(1))
+    d.define("disk.balance.threshold", Type.DOUBLE, 1.1, Importance.MEDIUM,
+             "Max broker DISK utilization ratio vs average.", at_least(1))
+    d.define("network.inbound.balance.threshold", Type.DOUBLE, 1.1, Importance.MEDIUM,
+             "Max broker NW_IN utilization ratio vs average.", at_least(1))
+    d.define("network.outbound.balance.threshold", Type.DOUBLE, 1.1, Importance.MEDIUM,
+             "Max broker NW_OUT utilization ratio vs average.", at_least(1))
+    d.define("cpu.capacity.threshold", Type.DOUBLE, 0.7, Importance.MEDIUM,
+             "Usable fraction of broker CPU capacity.", between(0, 1))
+    d.define("disk.capacity.threshold", Type.DOUBLE, 0.8, Importance.MEDIUM,
+             "Usable fraction of broker DISK capacity.", between(0, 1))
+    d.define("network.inbound.capacity.threshold", Type.DOUBLE, 0.8,
+             Importance.MEDIUM, "Usable fraction of NW_IN capacity.", between(0, 1))
+    d.define("network.outbound.capacity.threshold", Type.DOUBLE, 0.8,
+             Importance.MEDIUM, "Usable fraction of NW_OUT capacity.", between(0, 1))
+    d.define("max.replicas.per.broker", Type.LONG, 10_000, Importance.MEDIUM,
+             "ReplicaCapacityGoal limit.", at_least(1))
+    d.define("min.topic.leaders.per.broker", Type.INT, 1, Importance.LOW,
+             "MinTopicLeadersPerBrokerGoal requirement.", at_least(0))
+    d.define("topics.with.min.leaders.per.broker", Type.STRING, "", Importance.LOW,
+             "Regex of topics subject to MinTopicLeadersPerBrokerGoal.")
+    d.define("topic.replica.count.balance.threshold", Type.DOUBLE, 3.0,
+             Importance.LOW, "TopicReplicaDistributionGoal band width.", at_least(1))
+    d.define("leader.replica.count.balance.threshold", Type.DOUBLE, 1.1,
+             Importance.LOW, "LeaderReplicaDistributionGoal band width.", at_least(1))
+    d.define("replica.count.balance.threshold", Type.DOUBLE, 1.1, Importance.MEDIUM,
+             "ReplicaDistributionGoal band width.", at_least(1))
+    d.define("num.proposal.precompute.threads", Type.INT, 1, Importance.MEDIUM,
+             "Background proposal precompute workers (ref C14).", at_least(0))
+    d.define("proposal.expiration.ms", Type.LONG, 900_000, Importance.MEDIUM,
+             "Cached proposal freshness horizon.", at_least(0))
+    d.define("allow.capacity.estimation.on.proposal.precompute", Type.BOOLEAN, True,
+             Importance.LOW, "Permit estimated capacities during precompute.")
+    # --- ccx TPU backend (north star: goal.optimizer.backend=tpu) ----------
+    d.define("goal.optimizer.backend", Type.STRING, "tpu", Importance.HIGH,
+             "Proposal search backend: 'tpu' = batched SA + greedy polish on "
+             "device (BASELINE.json north star); 'greedy' = host-side greedy "
+             "oracle only.", one_of("tpu", "greedy"))
+    d.define("optimizer.num.chains", Type.INT, 32, Importance.MEDIUM,
+             "SA chains vmapped on device.", at_least(1))
+    d.define("optimizer.num.steps", Type.INT, 3000, Importance.MEDIUM,
+             "SA steps per chain.", at_least(1))
+    d.define("optimizer.seed", Type.INT, 42, Importance.LOW, "SA PRNG seed.")
+    d.define("optimizer.polish.candidates", Type.INT, 256, Importance.LOW,
+             "Greedy polish candidate moves per iteration.", at_least(1))
+    d.define("optimizer.polish.max.iters", Type.INT, 400, Importance.LOW,
+             "Greedy polish iteration cap.", at_least(1))
+    return d
+
+
+def executor_config_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("num.concurrent.partition.movements.per.broker", Type.INT, 5,
+             Importance.HIGH, "Per-broker inter-broker movement cap.", at_least(1))
+    d.define("num.concurrent.intra.broker.partition.movements", Type.INT, 2,
+             Importance.MEDIUM, "Per-broker intra-broker (disk) movement cap.",
+             at_least(1))
+    d.define("num.concurrent.leader.movements", Type.INT, 1000, Importance.HIGH,
+             "Cluster-wide leadership movement batch cap.", at_least(1))
+    d.define("max.num.cluster.movements", Type.INT, 1250, Importance.MEDIUM,
+             "Cluster-wide cap on in-flight movements.", at_least(1))
+    d.define("execution.progress.check.interval.ms", Type.LONG, 10_000,
+             Importance.HIGH, "Progress polling period during execution.",
+             at_least(1))
+    d.define("default.replication.throttle", Type.LONG, -1, Importance.MEDIUM,
+             "Replication throttle (bytes/s) applied during execution; -1 = "
+             "no throttle.")
+    d.define("replica.movement.strategies", Type.LIST,
+             ("ccx.executor.strategy.PrioritizeMinIsrWithOfflineReplicasStrategy",
+              "ccx.executor.strategy.PostponeUrpReplicaMovementStrategy",
+              "ccx.executor.strategy.PrioritizeLargeReplicaMovementStrategy"),
+             Importance.MEDIUM,
+             "Chained ReplicaMovementStrategy classes (ref C25).")
+    d.define("default.replica.movement.strategy.class", Type.CLASS,
+             "ccx.executor.strategy.BaseReplicaMovementStrategy",
+             Importance.LOW, "Tie-breaking tail of the strategy chain.")
+    d.define("executor.concurrency.adjuster.enabled", Type.BOOLEAN, True,
+             Importance.MEDIUM, "Auto-tune movement concurrency from live "
+             "broker health (ref C26).")
+    d.define("executor.concurrency.adjuster.interval.ms", Type.LONG, 30_000,
+             Importance.LOW, "Concurrency adjuster period.", at_least(1))
+    d.define("executor.concurrency.adjuster.max.partition.movements.per.broker",
+             Type.INT, 12, Importance.LOW, "Adjuster upper bound.", at_least(1))
+    d.define("executor.concurrency.adjuster.min.partition.movements.per.broker",
+             Type.INT, 1, Importance.LOW, "Adjuster lower bound.", at_least(1))
+    d.define("leader.movement.timeout.ms", Type.LONG, 180_000, Importance.LOW,
+             "Leadership movement completion timeout.", at_least(1))
+    d.define("task.execution.alerting.threshold.ms", Type.LONG, 90_000,
+             Importance.LOW, "Warn when a task runs longer than this.", at_least(1))
+    d.define("admin.client.class", Type.CLASS,
+             "ccx.executor.admin.SimulatedAdminClient", Importance.HIGH,
+             "AdminApi SPI implementation — the only component that writes "
+             "to the managed cluster (ref C28).")
+    return d
+
+
+def anomaly_detector_config_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("anomaly.detection.interval.ms", Type.LONG, 300_000, Importance.HIGH,
+             "Default detector period (per-type overrides below).", at_least(1))
+    d.define("goal.violation.detection.interval.ms", Type.LONG, -1, Importance.LOW,
+             "Goal-violation detector period; -1 = default interval.")
+    d.define("metric.anomaly.detection.interval.ms", Type.LONG, -1, Importance.LOW,
+             "Metric-anomaly detector period; -1 = default interval.")
+    d.define("disk.failure.detection.interval.ms", Type.LONG, -1, Importance.LOW,
+             "Disk-failure detector period; -1 = default interval.")
+    d.define("topic.anomaly.detection.interval.ms", Type.LONG, -1, Importance.LOW,
+             "Topic-anomaly detector period; -1 = default interval.")
+    d.define("broker.failure.detection.backoff.ms", Type.LONG, 300_000,
+             Importance.LOW, "Broker-failure re-check backoff.", at_least(1))
+    d.define("anomaly.notifier.class", Type.CLASS,
+             "ccx.detector.notifier.SelfHealingNotifier", Importance.HIGH,
+             "AnomalyNotifier SPI (ref C30).")
+    d.define("self.healing.enabled", Type.BOOLEAN, False, Importance.HIGH,
+             "Master switch for automatic anomaly fixing.")
+    d.define("self.healing.exclude.recently.demoted.brokers", Type.BOOLEAN, True,
+             Importance.LOW, "Exclude recently demoted brokers from fixes.")
+    d.define("self.healing.exclude.recently.removed.brokers", Type.BOOLEAN, True,
+             Importance.LOW, "Exclude recently removed brokers from fixes.")
+    d.define("broker.failure.alert.threshold.ms", Type.LONG, 900_000,
+             Importance.HIGH, "Grace before alerting on a dead broker.", at_least(0))
+    d.define("broker.failure.self.healing.threshold.ms", Type.LONG, 1_800_000,
+             Importance.HIGH, "Grace before auto-fixing a dead broker.", at_least(0))
+    d.define("metric.anomaly.finder.class", Type.CLASS,
+             "ccx.detector.slow_broker.SlowBrokerFinder", Importance.MEDIUM,
+             "MetricAnomalyFinder SPI (ref C29).")
+    d.define("slow.broker.bytes.in.rate.detection.threshold", Type.DOUBLE, 1024.0,
+             Importance.LOW, "Min bytes-in rate (KB/s) for slow-broker "
+             "eligibility.", at_least(0))
+    d.define("slow.broker.log.flush.time.threshold.ms", Type.DOUBLE, 1000.0,
+             Importance.LOW, "Log-flush-time threshold for slowness.", at_least(0))
+    d.define("slow.broker.metric.history.percentile.threshold", Type.DOUBLE, 90.0,
+             Importance.LOW, "History percentile a slow broker must exceed.",
+             between(0, 100))
+    d.define("topic.anomaly.finder.class", Type.CLASS,
+             "ccx.detector.topic_anomaly.TopicReplicationFactorAnomalyFinder",
+             Importance.LOW, "TopicAnomalyFinder SPI.")
+    d.define("target.topic.replication.factor", Type.INT, 3, Importance.LOW,
+             "Desired RF for topic-anomaly detection.", at_least(1))
+    d.define("maintenance.event.reader.class", Type.CLASS,
+             "ccx.detector.maintenance.NoopMaintenanceEventReader",
+             Importance.LOW, "MaintenanceEventReader SPI.")
+    d.define("provisioner.class", Type.CLASS,
+             "ccx.detector.provisioner.BasicProvisioner", Importance.LOW,
+             "Provisioner SPI behind the rightsize endpoint (ref C21).")
+    d.define("anomaly.detection.allow.unready.cluster", Type.BOOLEAN, False,
+             Importance.LOW, "Run detectors before monitor windows are ready.")
+    return d
+
+
+def webserver_config_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("webserver.http.address", Type.STRING, "127.0.0.1", Importance.HIGH,
+             "REST server bind address.")
+    d.define("webserver.http.port", Type.INT, 9090, Importance.HIGH,
+             "REST server port.", between(0, 65535))
+    d.define("webserver.api.urlprefix", Type.STRING, "/kafkacruisecontrol/*",
+             Importance.LOW, "Endpoint URL prefix.")
+    d.define("webserver.session.maxExpiryPeriodMs", Type.LONG, 60_000,
+             Importance.LOW, "Session expiry for async request tracking.",
+             at_least(1))
+    d.define("webserver.request.maxBlockTimeMs", Type.LONG, 10_000,
+             Importance.LOW, "Max time a request blocks before going async.",
+             at_least(0))
+    d.define("two.step.verification.enabled", Type.BOOLEAN, False, Importance.MEDIUM,
+             "Park POSTs in purgatory until reviewed (ref C33).")
+    d.define("two.step.purgatory.retention.time.ms", Type.LONG, 1_209_600_000,
+             Importance.LOW, "Purgatory request retention.", at_least(1))
+    d.define("two.step.purgatory.max.requests", Type.INT, 25, Importance.LOW,
+             "Purgatory capacity.", at_least(1))
+    d.define("webserver.security.enable", Type.BOOLEAN, False, Importance.MEDIUM,
+             "Enable authentication/authorization (ref C34).")
+    d.define("webserver.security.provider", Type.CLASS,
+             "ccx.servlet.security.BasicSecurityProvider", Importance.MEDIUM,
+             "SecurityProvider SPI.")
+    d.define("webserver.auth.credentials.file", Type.STRING, "", Importance.MEDIUM,
+             "Credentials file for the basic provider "
+             "(user: password,ROLE per line).")
+    d.define("vertx.api.enabled", Type.BOOLEAN, False, Importance.LOW,
+             "Alternative API server flavor flag (ref C36; same endpoints).")
+    return d
+
+
+def user_task_manager_config_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("max.active.user.tasks", Type.INT, 25, Importance.MEDIUM,
+             "Concurrent async user tasks.", at_least(1))
+    d.define("max.cached.completed.user.tasks", Type.INT, 100, Importance.LOW,
+             "Completed tasks kept for replay via user_tasks.", at_least(1))
+    d.define("completed.user.task.retention.time.ms", Type.LONG, 86_400_000,
+             Importance.LOW, "Completed task retention.", at_least(1))
+    return d
+
+
+def reporter_config_def() -> ConfigDef:
+    """Broker-side metrics reporter keys (ref C37/M3)."""
+    d = ConfigDef()
+    d.define("metric.reporting.interval.ms", Type.LONG, 60_000, Importance.HIGH,
+             "Reporter publish period inside each broker.", at_least(1))
+    d.define("cruise.control.metrics.topic", Type.STRING,
+             "__CruiseControlMetrics", Importance.MEDIUM,
+             "Transport channel name for raw metric records.")
+    return d
+
+
+def cruise_control_config_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define("bootstrap.servers", Type.STRING, "localhost:9092", Importance.HIGH,
+             "Managed cluster contact point (simulated transport address for "
+             "the in-process cluster).")
+    d.define("cluster.configs.file", Type.STRING, "config/clusterConfigs.json",
+             Importance.LOW, "Cluster-level config overrides file.")
+    for sub in (
+        monitor_config_def(),
+        analyzer_config_def(),
+        executor_config_def(),
+        anomaly_detector_config_def(),
+        webserver_config_def(),
+        user_task_manager_config_def(),
+        reporter_config_def(),
+    ):
+        d.merge(sub)
+    return d
+
+
+class CruiseControlConfig:
+    """Parsed, validated configuration (ref KafkaCruiseControlConfig).
+
+    ``cfg[key]`` returns the typed value; ``configured_instance(key)``
+    instantiates a class-valued key, passing this config to the constructor
+    (or calling a no-arg constructor, then ``configure(cfg)`` if defined) —
+    the reference's reflective SPI pattern.
+    """
+
+    def __init__(self, props: dict[str, Any] | None = None,
+                 definition: ConfigDef | None = None) -> None:
+        self.definition = definition or cruise_control_config_def()
+        self.originals = dict(props or {})
+        self._values = self.definition.parse(self.originals)
+
+    @classmethod
+    def from_properties_file(cls, path: str) -> "CruiseControlConfig":
+        return cls(load_properties(path))
+
+    def __getitem__(self, key: str) -> Any:
+        try:
+            return self._values[key]
+        except KeyError:
+            raise ConfigException(f"Unknown configuration {key!r}") from None
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._values
+
+    def with_overrides(self, **overrides: Any) -> "CruiseControlConfig":
+        """Per-request parameter overrides (ref C32 parameters/)."""
+        props = dict(self.originals)
+        props.update({k.replace("_", "."): v for k, v in overrides.items()})
+        return CruiseControlConfig(props, self.definition)
+
+    def configured_instance(self, key: str, *args: Any, **kwargs: Any) -> Any:
+        from ccx.config.definition import resolve_class
+
+        cls = self[key]
+        if cls is None:
+            return None
+        if isinstance(cls, str):
+            cls = resolve_class(cls)
+        try:
+            obj = cls(*args, config=self, **kwargs)
+        except TypeError:
+            obj = cls(*args, **kwargs)
+        if hasattr(obj, "configure"):
+            obj.configure(self)
+        return obj
+
+    def configured_instances(self, key: str, *args: Any) -> list[Any]:
+        out = []
+        for path in self[key]:
+            from ccx.config.definition import resolve_class
+
+            cls = resolve_class(path) if isinstance(path, str) else path
+            try:
+                obj = cls(*args, config=self)
+            except TypeError:
+                obj = cls(*args)
+            if hasattr(obj, "configure"):
+                obj.configure(self)
+            out.append(obj)
+        return out
+
+    def values(self) -> dict[str, Any]:
+        return dict(self._values)

@@ -1,0 +1,121 @@
+"""Config-system tests (ref C35: KafkaCruiseControlConfig / ConfigDef)."""
+
+import pytest
+
+from ccx.config import (
+    ConfigDef,
+    ConfigException,
+    CruiseControlConfig,
+    Importance,
+    Type,
+    load_properties,
+)
+from ccx.config.definition import NO_DEFAULT, at_least, between, one_of
+
+
+def test_defaults_parse_clean():
+    cfg = CruiseControlConfig()
+    assert cfg["num.partition.metrics.windows"] == 5
+    assert cfg["goals"][0] == "RackAwareGoal"
+    assert cfg["goal.optimizer.backend"] == "tpu"
+    assert cfg["self.healing.enabled"] is False
+    assert cfg["webserver.http.port"] == 9090
+
+
+def test_typed_coercion_from_strings():
+    cfg = CruiseControlConfig(
+        {
+            "num.partition.metrics.windows": "7",
+            "cpu.balance.threshold": "1.25",
+            "self.healing.enabled": "true",
+            "goals": "RackAwareGoal, ReplicaCapacityGoal",
+        }
+    )
+    assert cfg["num.partition.metrics.windows"] == 7
+    assert cfg["cpu.balance.threshold"] == 1.25
+    assert cfg["self.healing.enabled"] is True
+    assert cfg["goals"] == ("RackAwareGoal", "ReplicaCapacityGoal")
+
+
+def test_validators_reject_bad_values():
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"num.partition.metrics.windows": "0"})
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"cpu.capacity.threshold": "1.5"})
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"goal.optimizer.backend": "gpu"})
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"num.partition.metrics.windows": "abc"})
+
+
+def test_required_key_missing_raises():
+    d = ConfigDef().define("a.b", Type.INT, NO_DEFAULT, Importance.HIGH, "doc")
+    with pytest.raises(ConfigException, match="Missing required"):
+        d.parse({})
+    assert d.parse({"a.b": 3})["a.b"] == 3
+
+
+def test_unknown_key_lookup_raises():
+    cfg = CruiseControlConfig()
+    with pytest.raises(ConfigException):
+        cfg["no.such.key"]
+
+
+def test_with_overrides_per_request():
+    cfg = CruiseControlConfig()
+    cfg2 = cfg.with_overrides(**{"optimizer.num.chains": 8})
+    assert cfg2["optimizer.num.chains"] == 8
+    assert cfg["optimizer.num.chains"] == 32  # original untouched
+
+
+def test_properties_file_roundtrip(tmp_path):
+    p = tmp_path / "cruisecontrol.properties"
+    p.write_text(
+        "# comment\n"
+        "bootstrap.servers=sim://local\n"
+        "goals=RackAwareGoal,\\\n    ReplicaCapacityGoal\n"
+        "webserver.http.port: 9191\n"
+    )
+    props = load_properties(str(p))
+    assert props["bootstrap.servers"] == "sim://local"
+    cfg = CruiseControlConfig(props)
+    assert cfg["goals"] == ("RackAwareGoal", "ReplicaCapacityGoal")
+    assert cfg["webserver.http.port"] == 9191
+
+
+def test_configured_instance_resolves_and_configures():
+    cfg = CruiseControlConfig(
+        {"anomaly.notifier.class": "tests.test_config.FakePlugin"}
+    )
+    obj = cfg.configured_instance("anomaly.notifier.class")
+    assert type(obj).__name__ == "FakePlugin"
+    assert obj.seen_config is cfg
+
+
+def test_doc_table_covers_all_keys():
+    from ccx.config import cruise_control_config_def
+
+    rows = cruise_control_config_def().doc_table()
+    names = {r["name"] for r in rows}
+    assert "goals" in names and "broker.failure.alert.threshold.ms" in names
+    assert all(r["doc"] for r in rows)  # every key documented
+
+
+class FakePlugin:
+    def __init__(self):
+        self.seen_config = None
+
+    def configure(self, config):
+        self.seen_config = config
+
+
+def test_validator_helpers():
+    at_least(1)("k", 1)
+    with pytest.raises(ConfigException):
+        at_least(1)("k", 0)
+    between(0, 1)("k", 0.5)
+    with pytest.raises(ConfigException):
+        between(0, 1)("k", 2)
+    one_of("a", "b")("k", "a")
+    with pytest.raises(ConfigException):
+        one_of("a", "b")("k", "c")
