@@ -1,0 +1,89 @@
+"""Leader/follower load decomposition — CPU estimation coefficients.
+
+Parity: ``model/{ModelUtils,ModelParameters,LinearRegressionModelParameters}
+.java`` (SURVEY.md C6): the reference estimates a replica's CPU from its
+network activity with fixed coefficients (configurable; a legacy linear-
+regression training path can fit them), and derives the **follower** role's
+load profile from the leader's (follower CPU ~ replication traffic only,
+follower NW_OUT = 0, follower NW_IN = leader bytes-in).
+
+These functions produce the ``leader_load`` / ``follower_load`` pair the
+TensorClusterModel stores per partition (ccx.model.tensor_model), which is
+how leadership transfer re-weights broker loads with no re-aggregation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ccx.common.resources import Resource
+
+
+@dataclasses.dataclass(frozen=True)
+class CpuEstimationParams:
+    """Ref MonitorConfig `*.weight.for.cpu.util` keys (SURVEY.md C6)."""
+
+    leader_nw_in_weight: float = 0.6
+    leader_nw_out_weight: float = 0.1
+    follower_nw_in_weight: float = 0.3
+
+    @classmethod
+    def from_config(cls, config) -> "CpuEstimationParams":
+        return cls(
+            config["leader.network.inbound.weight.for.cpu.util"],
+            config["leader.network.outbound.weight.for.cpu.util"],
+            config["follower.network.inbound.weight.for.cpu.util"],
+        )
+
+
+def estimate_leader_cpu(params: CpuEstimationParams, broker_cpu: np.ndarray,
+                        nw_in: np.ndarray, nw_out: np.ndarray,
+                        broker_nw_in: np.ndarray, broker_nw_out: np.ndarray) -> np.ndarray:
+    """Apportion measured broker CPU to a leader replica by its share of
+    weighted network activity (ref ModelUtils.estimateLeaderCpuUtil)."""
+    denom = (params.leader_nw_in_weight * broker_nw_in
+             + params.leader_nw_out_weight * broker_nw_out)
+    numer = (params.leader_nw_in_weight * nw_in
+             + params.leader_nw_out_weight * nw_out)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        share = np.where(denom > 0, numer / np.maximum(denom, 1e-12), 0.0)
+    return broker_cpu * share
+
+
+def follower_cpu_from_leader(params: CpuEstimationParams,
+                             leader_cpu: np.ndarray,
+                             leader_nw_in: np.ndarray,
+                             leader_nw_out: np.ndarray) -> np.ndarray:
+    """Ref ModelUtils.getFollowerCpuUtilFromLeaderLoad: follower CPU is the
+    replication-fetch share of the leader's network-attributed CPU."""
+    denom = (params.leader_nw_in_weight * leader_nw_in
+             + params.leader_nw_out_weight * leader_nw_out)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        ratio = np.where(
+            denom > 0,
+            params.follower_nw_in_weight * leader_nw_in / np.maximum(denom, 1e-12),
+            0.0,
+        )
+    return leader_cpu * ratio
+
+
+def split_roles(params: CpuEstimationParams,
+                leader_metrics: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(leader_load, follower_load) float64[RES, P] from leader-side windowed
+    metrics float64[P, M] (M = partition metric def = Resource order).
+
+    Role semantics (ref Load/ModelUtils, tensor_model docstring):
+    follower NW_OUT = 0 (no consumer traffic), follower NW_IN = leader NW_IN
+    (replication), DISK role-independent, follower CPU derived.
+    """
+    lm = np.asarray(leader_metrics, np.float64)
+    leader = lm.T.copy()  # [RES, P]
+    follower = leader.copy()
+    follower[Resource.NW_OUT] = 0.0
+    follower[Resource.CPU] = follower_cpu_from_leader(
+        params, leader[Resource.CPU], leader[Resource.NW_IN],
+        leader[Resource.NW_OUT],
+    )
+    return leader, follower

@@ -1,0 +1,174 @@
+"""Monitor-core tests (ref M1 MetricSampleAggregatorTest, C12/C13)."""
+
+import numpy as np
+
+from ccx.monitor.aggregator import (
+    AggregationResult,
+    Extrapolation,
+    MetricSampleAggregator,
+    ModelCompletenessRequirements,
+)
+from ccx.monitor.metricdef import (
+    BROKER_METRIC_DEF,
+    PARTITION_METRIC_DEF,
+    AggregationFunction,
+)
+from ccx.monitor.sampling.holders import (
+    BrokerMetricSample,
+    PartitionMetricSample,
+    broker_sample,
+    deserialize_batch,
+    partition_sample,
+    serialize_batch,
+)
+
+WINDOW = 1000
+
+
+def make_agg(**kw):
+    defaults = dict(
+        metric_def=PARTITION_METRIC_DEF, num_windows=4, window_ms=WINDOW,
+        min_samples_per_window=2, max_allowed_extrapolations=1,
+    )
+    defaults.update(kw)
+    return MetricSampleAggregator(**defaults)
+
+
+def fill(agg, entity, windows, per_window=2, value=10.0):
+    for w in windows:
+        for i in range(per_window):
+            agg.add_sample(entity, w * WINDOW + i, [value, value, value, value])
+
+
+def test_metricdef_resource_alignment():
+    names = [m.name for m in PARTITION_METRIC_DEF.all_metrics()]
+    assert names == ["CPU_USAGE", "NETWORK_IN_RATE", "NETWORK_OUT_RATE", "DISK_USAGE"]
+    assert PARTITION_METRIC_DEF.metric_info("DISK_USAGE").aggregation is (
+        AggregationFunction.LATEST
+    )
+    assert BROKER_METRIC_DEF.ids_in_group("LATENCY")
+
+
+def test_avg_max_latest_aggregation_functions():
+    agg = make_agg(min_samples_per_window=1)
+    # two samples in window 0 for entity 0: avg for CPU, latest for DISK
+    agg.add_sample(0, 100, [10.0, 1.0, 2.0, 100.0])
+    agg.add_sample(0, 900, [30.0, 3.0, 4.0, 300.0])
+    # advance so windows 0..3 are completed
+    agg.add_sample(0, 4 * WINDOW + 1, [0, 0, 0, 0])
+    r = agg.aggregate()
+    w0 = 0  # oldest completed window
+    assert r.values[0, w0, 0] == 20.0      # CPU AVG
+    assert r.values[0, w0, 3] == 300.0     # DISK LATEST (t=900 wins)
+
+
+def test_full_windows_no_extrapolation():
+    agg = make_agg()
+    fill(agg, 0, range(5))  # windows 0..4; 4 is current, 0..3 aggregate
+    r = agg.aggregate()
+    assert r.num_windows == 4
+    assert (r.extrapolations[0] == Extrapolation.NONE).all()
+    assert r.entity_valid[0]
+    assert np.allclose(r.values[0, :, 0], 10.0)
+
+
+def test_forced_insufficient_extrapolation():
+    agg = make_agg()  # min 2 samples
+    fill(agg, 0, [0, 2, 3], per_window=2)
+    fill(agg, 0, [1], per_window=1, value=42.0)  # under the minimum
+    fill(agg, 0, [4], per_window=1)  # current window
+    r = agg.aggregate()
+    assert r.extrapolations[0, 1] == Extrapolation.FORCED_INSUFFICIENT
+    assert r.values[0, 1, 0] == 42.0   # uses what's there
+    assert r.entity_valid[0]           # one extrapolation <= budget 1
+
+
+def test_avg_adjacent_extrapolation():
+    agg = make_agg()
+    fill(agg, 0, [0, 2, 3], per_window=2, value=10.0)
+    fill(agg, 0, [4], per_window=1)
+    # window 1 empty, neighbors 0 and 2 sampled -> AVG_ADJACENT
+    r = agg.aggregate()
+    assert r.extrapolations[0, 1] == Extrapolation.AVG_ADJACENT
+    assert np.isclose(r.values[0, 1, 0], 10.0)
+    assert r.entity_valid[0]
+
+
+def test_no_valid_extrapolation_invalidates_entity():
+    agg = make_agg()
+    fill(agg, 0, [0, 3], per_window=2)  # windows 1,2 both empty -> NO_VALID
+    fill(agg, 0, [4], per_window=1)
+    r = agg.aggregate()
+    assert Extrapolation.NO_VALID in r.extrapolations[0]
+    assert not r.entity_valid[0]
+
+
+def test_extrapolation_budget_exceeded():
+    agg = make_agg(max_allowed_extrapolations=0)
+    fill(agg, 0, [0, 2, 3], per_window=2)
+    fill(agg, 0, [1], per_window=1)  # 1 extrapolation > budget 0
+    fill(agg, 0, [4], per_window=1)
+    r = agg.aggregate()
+    assert not r.entity_valid[0]
+
+
+def test_rolling_evicts_old_windows_and_bumps_generation():
+    agg = make_agg()
+    fill(agg, 0, range(5))
+    g0 = agg.generation
+    fill(agg, 0, [7])  # jump ahead: windows 0..2 fall out of retention
+    assert agg.generation > g0
+    r = agg.aggregate()
+    assert r.window_starts_ms[0] == 3 * WINDOW
+    # stale sample for an evicted window is rejected
+    assert not agg.add_sample(0, 100, [1, 1, 1, 1])
+
+
+def test_completeness_ratio_and_requirements():
+    agg = make_agg()
+    fill(agg, 0, range(5))
+    fill(agg, 1, range(5))
+    fill(agg, 2, [0, 3, 4])  # entity 2 invalid (two empty interior windows)
+    r = agg.aggregate(num_entities=4)  # entity 3 never sampled
+    assert r.entity_valid.tolist() == [True, True, False, False]
+    assert np.isclose(r.valid_entity_ratio, 0.5)
+    assert r.meets(ModelCompletenessRequirements(2, 0.5))
+    assert not r.meets(ModelCompletenessRequirements(2, 0.9))
+    assert not r.meets(ModelCompletenessRequirements(5, 0.1))
+    assert not r.meets(ModelCompletenessRequirements(1, 0.1, include_all_entities=True))
+
+
+def test_requirements_merge_is_stricter_union():
+    a = ModelCompletenessRequirements(1, 0.3)
+    b = ModelCompletenessRequirements(3, 0.2, include_all_entities=True)
+    m = a.merged(b)
+    assert m.min_required_num_windows == 3
+    assert m.min_valid_entity_ratio == 0.3
+    assert m.include_all_entities
+
+
+def test_batch_ingest_matches_loop():
+    a1, a2 = make_agg(), make_agg()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 6, 200)
+    times = rng.integers(0, 5 * WINDOW, 200)
+    metrics = rng.random((200, 4))
+    a1.add_samples(ids, times, metrics)
+    for i, t, m in zip(ids, times, metrics):
+        a2.add_sample(int(i), int(t), m)
+    r1, r2 = a1.aggregate(), a2.aggregate()
+    np.testing.assert_allclose(r1.values, r2.values)
+    assert (r1.extrapolations == r2.extrapolations).all()
+
+
+def test_sample_serde_roundtrip():
+    ps = partition_sample(3, 17, 12345, CPU_USAGE=0.5, NETWORK_IN_RATE=10.0,
+                          DISK_USAGE=99.0)
+    bs = broker_sample(2, 999, BROKER_CPU_UTIL=0.7,
+                       BROKER_LOG_FLUSH_TIME_MS_MEAN=12.0)
+    batch = serialize_batch([ps, bs])
+    out = deserialize_batch(batch)
+    assert out == [ps, bs]
+    assert isinstance(out[0], PartitionMetricSample)
+    assert isinstance(out[1], BrokerMetricSample)
+    assert out[0].metric(0) == 0.5 and out[0].metric(3) == 99.0

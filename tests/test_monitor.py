@@ -1,0 +1,199 @@
+"""Monitor-layer tests (ref C5-C11: LoadMonitor, capacity, samplers, store)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ccx.common.exceptions import NotEnoughValidWindowsException
+from ccx.common.resources import Resource
+from ccx.config import CruiseControlConfig
+from ccx.executor.admin import SimulatedAdminClient, SimulatedCluster
+from ccx.monitor.aggregator import ModelCompletenessRequirements
+from ccx.monitor.capacity import FileCapacityResolver, StaticCapacityResolver
+from ccx.monitor.load_monitor import LoadMonitor, LoadMonitorState, ModelBuildOptions
+from ccx.monitor.model_utils import CpuEstimationParams, split_roles
+
+
+def write_capacity(tmp_path, doc):
+    p = tmp_path / "capacity.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_file_capacity_resolver_plain(tmp_path):
+    path = write_capacity(tmp_path, {
+        "brokerCapacities": [
+            {"brokerId": "-1", "capacity": {"DISK": "100000", "CPU": "100",
+                                            "NW_IN": "10000", "NW_OUT": "10000"}},
+            {"brokerId": "0", "capacity": {"DISK": "500000", "CPU": "200",
+                                           "NW_IN": "50000", "NW_OUT": "50000"}},
+        ]
+    })
+    r = FileCapacityResolver(path)
+    assert r.capacity_for(0).resource(Resource.DISK) == 500000
+    assert not r.capacity_for(0).estimated
+    # unknown broker falls back to the default row, flagged estimated
+    info = r.capacity_for(42)
+    assert info.resource(Resource.CPU) == 100
+    assert info.estimated
+
+
+def test_file_capacity_resolver_jbod_and_cores(tmp_path):
+    path = write_capacity(tmp_path, {
+        "brokerCapacities": [
+            {"brokerId": "-1", "capacity": {
+                "DISK": {"/d0": "50000", "/d1": "30000"},
+                "CPU": {"num.cores": "8"},
+                "NW_IN": "10000", "NW_OUT": "10000"}},
+        ]
+    })
+    r = FileCapacityResolver(path)
+    info = r.capacity_for(1)
+    assert info.resource(Resource.DISK) == 80000
+    assert info.disk_capacities == (50000.0, 30000.0)
+    assert info.resource(Resource.CPU) == 800.0
+    assert info.num_cores == 8
+
+
+def test_file_capacity_resolver_requires_default(tmp_path):
+    path = write_capacity(tmp_path, {"brokerCapacities": [
+        {"brokerId": "0", "capacity": {"DISK": "1", "CPU": "1",
+                                       "NW_IN": "1", "NW_OUT": "1"}}]})
+    with pytest.raises(ValueError, match="default"):
+        FileCapacityResolver(path)
+
+
+def test_split_roles_follower_semantics():
+    params = CpuEstimationParams()
+    # one partition: CPU=10, NW_IN=100, NW_OUT=200, DISK=500
+    leader, follower = split_roles(params, np.array([[10.0, 100.0, 200.0, 500.0]]))
+    assert leader[Resource.NW_OUT, 0] == 200.0
+    assert follower[Resource.NW_OUT, 0] == 0.0          # followers serve nobody
+    assert follower[Resource.NW_IN, 0] == 100.0         # replication traffic
+    assert follower[Resource.DISK, 0] == 500.0          # role-independent
+    # follower CPU = leader CPU * 0.3*NW_IN / (0.6*NW_IN + 0.1*NW_OUT)
+    expect = 10.0 * (0.3 * 100) / (0.6 * 100 + 0.1 * 200)
+    assert np.isclose(follower[Resource.CPU, 0], expect)
+    assert follower[Resource.CPU, 0] < leader[Resource.CPU, 0]
+
+
+def sim_cluster(n_brokers=4, n_partitions=8, rf=2):
+    sim = SimulatedCluster()
+    for b in range(n_brokers):
+        sim.add_broker(b, rack=f"r{b % 2}")
+    sim.create_topic("t0", n_partitions, rf)
+    sim.create_topic("t1", n_partitions // 2, rf)
+    return sim
+
+
+def make_monitor(tmp_path, sim=None, **extra):
+    sim = sim or sim_cluster()
+    props = {
+        "metric.sampler.class": "ccx.monitor.sampling.sampler.SyntheticMetricSampler",
+        "broker.capacity.config.resolver.class": "ccx.monitor.capacity.StaticCapacityResolver",
+        "sample.store.dir": str(tmp_path / "samples"),
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 4,
+        "broker.metrics.window.ms": 1000,
+        "num.broker.metrics.windows": 4,
+        "metric.sampling.interval.ms": 1000,
+    }
+    props.update(extra)
+    cfg = CruiseControlConfig(props)
+    admin = SimulatedAdminClient(sim)
+    clock = {"now": 0}
+    lm = LoadMonitor(cfg, admin, clock=lambda: clock["now"])
+    return lm, sim, clock
+
+
+def run_windows(lm, clock, n=6):
+    for _ in range(n):
+        clock["now"] += 1000
+        lm.sample_once()
+
+
+def test_load_monitor_builds_model(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    run_windows(lm, clock)
+    model, metadata, gen = lm.cluster_model(
+        ModelCompletenessRequirements(2, 0.9)
+    )
+    assert model.n_partitions == 12  # 8 + 4
+    assert int(np.asarray(model.n_alive_brokers)) == 4
+    # loads are positive for valid partitions
+    lead = np.asarray(model.leader_load)
+    valid = np.asarray(model.partition_valid)
+    assert (lead[:, valid] > 0).all()
+    assert gen.metadata_generation == metadata.generation
+    st = lm.state()
+    assert st["state"] == "RUNNING"
+    assert st["numTotalSamples"] > 0
+
+
+def test_load_monitor_completeness_gate(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    clock["now"] = 1000
+    lm.sample_once()  # a single round cannot fill 4 windows
+    with pytest.raises(NotEnoughValidWindowsException):
+        lm.cluster_model(ModelCompletenessRequirements(4, 0.9))
+
+
+def test_load_monitor_pause_resume(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    lm.pause_sampling("maintenance")
+    clock["now"] += 1000
+    assert lm.sample_once() == 0
+    assert lm.state()["state"] == "PAUSED"
+    assert lm.state()["reasonOfLatestPauseOrResume"] == "maintenance"
+    lm.resume_sampling()
+    clock["now"] += 1000
+    assert lm.sample_once() > 0
+
+
+def test_sample_store_warm_start(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    run_windows(lm, clock)
+    n1 = lm.partition_aggregator.aggregate().valid_entity_ratio
+    assert n1 > 0.9
+    # new monitor instance over the same store: windows survive the restart
+    lm2, _, _ = make_monitor(tmp_path, sim=sim)
+    lm2.start_up(run_sampling_loop=False)
+    r = lm2.partition_aggregator.aggregate(len(sim._partitions))
+    assert r.valid_entity_ratio == pytest.approx(n1)
+
+
+def test_model_build_options_masks(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    run_windows(lm, clock)
+    model, metadata, _ = lm.cluster_model(
+        ModelCompletenessRequirements(2, 0.9),
+        ModelBuildOptions(
+            excluded_topics_pattern="t1",
+            brokers_to_remove=(3,),
+            brokers_to_demote=(1,),
+        ),
+    )
+    alive = np.asarray(model.broker_alive)
+    assert not alive[3]
+    assert np.asarray(model.broker_excl_leadership)[1]
+    imm = np.asarray(model.partition_immovable)
+    topics = np.asarray(model.partition_topic)
+    valid = np.asarray(model.partition_valid)
+    assert (imm[valid] == (topics[valid] == 1)).all()
+
+
+def test_dead_broker_reflected_in_model(tmp_path):
+    sim = sim_cluster()
+    lm, _, clock = make_monitor(tmp_path, sim=sim)
+    lm.start_up(run_sampling_loop=False)
+    run_windows(lm, clock)
+    sim.kill_broker(2)
+    model, metadata, _ = lm.cluster_model(ModelCompletenessRequirements(2, 0.5))
+    assert not np.asarray(model.broker_alive)[2]
+    assert 2 in metadata.dead_broker_ids()
