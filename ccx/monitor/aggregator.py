@@ -113,6 +113,7 @@ class MetricSampleAggregator:
         # excluded from aggregation, as in the reference.
         self._slots = self.num_windows + 1
         self._base_window = None  # absolute index of slot 0
+        self._first_window = None  # absolute index of the earliest real sample
         self._generation = 0      # bumps on every window roll (ModelGeneration)
         self._lock = threading.RLock()
         E, W, M = num_entities, self._slots, metric_def.num_metrics
@@ -184,20 +185,32 @@ class MetricSampleAggregator:
         self._base_window += shift
 
     def add_samples(self, entity_ids: np.ndarray, times_ms: np.ndarray,
-                    metrics: np.ndarray) -> int:
+                    metrics: np.ndarray, now_ms: int | None = None) -> int:
         """Batch ingest; returns the number of accepted samples.
 
-        Samples older than the retained window range are dropped (the
-        reference rejects samples outside the monitored period).
+        Samples outside the monitored period are dropped (ref: the
+        aggregator rejects out-of-period samples): older than the retained
+        window range, or — when ``now_ms`` is given — timestamped beyond
+        one window into the future (clock skew / buggy sampler), which would
+        otherwise wipe history by force-rolling the buffer forward.
         """
         with self._lock:
             entity_ids = np.asarray(entity_ids, np.int64)
             times_ms = np.asarray(times_ms, np.int64)
             metrics = np.asarray(metrics, np.float64)
+            if now_ms is not None:
+                fresh = times_ms <= now_ms + self.window_ms
+                entity_ids, times_ms, metrics = (
+                    entity_ids[fresh], times_ms[fresh], metrics[fresh]
+                )
             if entity_ids.size == 0:
                 return 0
             self.ensure_entities(int(entity_ids.max()) + 1)
             windows = times_ms // self.window_ms
+            if self._first_window is None:
+                self._first_window = int(windows.min())
+            else:
+                self._first_window = min(self._first_window, int(windows.min()))
             self._roll_to(int(windows.max()))
             slot = windows - self._base_window
             ok = slot >= 0
@@ -235,9 +248,9 @@ class MetricSampleAggregator:
             E = self.num_entities if num_entities is None else int(num_entities)
             W, M = self.num_windows, self.metric_def.num_metrics
             if self._base_window is None:
-                values = np.zeros((E, W, M))
-                extrap = np.full((E, W), Extrapolation.NO_VALID, np.int8)
-                starts = np.zeros(W, np.int64)
+                values = np.zeros((E, 0, M))
+                extrap = np.zeros((E, 0), np.int8)
+                starts = np.zeros(0, np.int64)
                 return AggregationResult(
                     values, extrap, np.zeros(E, bool), starts, 0.0,
                     self._generation,
@@ -282,13 +295,24 @@ class MetricSampleAggregator:
             extrap[adjacent_ok] = Extrapolation.AVG_ADJACENT
             extrap[~has_any & ~adjacent_ok] = Extrapolation.NO_VALID
 
+            # Windows predating the first real sample ("pre-genesis") do not
+            # exist yet: report only windows since genesis so early models
+            # with few-but-complete windows are possible (numValidWindows
+            # reflects actual data, as in the reference).
+            k = 0
+            if self._first_window is not None:
+                k = min(max(self._first_window - self._base_window, 0), W)
+            vals = vals[:, k:]
+            extrap = extrap[:, k:]
+
             n_extrapolated = (extrap > Extrapolation.NONE).sum(axis=1)
             entity_valid = (
                 (extrap != Extrapolation.NO_VALID).all(axis=1)
                 & (n_extrapolated <= self.max_allowed_extrapolations)
+                & (extrap.shape[1] > 0)
             )
             ratio = float(entity_valid.mean()) if E else 0.0
-            starts = (self._base_window + np.arange(W)) * self.window_ms
+            starts = (self._base_window + np.arange(k, W)) * self.window_ms
             return AggregationResult(
                 vals, extrap, entity_valid, starts, ratio, self._generation
             )

@@ -109,10 +109,18 @@ class MetricFetcherManager:
             # advance the sampled horizon past an un-fetched interval.
             raise errors[0]
         merged = Samples([], [])
+        seen_broker: set[tuple[int, int]] = set()
         for r in results:
             if r is not None:
                 merged.partition_samples.extend(r.partition_samples)
-                merged.broker_samples.extend(r.broker_samples)
+                # Broker samples are not sharded by the fetcher split — a
+                # sampler may emit them on every shard; dedupe by
+                # (broker, timestamp) so counts are not inflated N-fetchers x.
+                for s in r.broker_samples:
+                    key = (s.broker_id, s.time_ms)
+                    if key not in seen_broker:
+                        seen_broker.add(key)
+                        merged.broker_samples.append(s)
         return merged
 
 
@@ -211,14 +219,20 @@ class LoadMonitor:
             )
             metadata = self.admin.describe_cluster()
             samples = self.fetcher_manager.fetch(metadata, start_ms, end_ms)
-            self._ingest(samples, metadata)
+            self._ingest(samples, metadata, now_ms=end_ms)
             self.sample_store.store_samples(samples)
-            # Retention: drop persisted samples older than the monitored span
-            # so warm start replays only what the aggregators can hold.
-            horizon = (
+            # Retention: drop persisted samples older than each scope's
+            # monitored span so warm start replays only what the aggregators
+            # can hold.
+            p_horizon = (
                 self.config["num.partition.metrics.windows"] + 1
             ) * self.config["partition.metrics.window.ms"]
-            self.sample_store.evict_before(end_ms - horizon)
+            b_horizon = (
+                self.config["num.broker.metrics.windows"] + 1
+            ) * self.config["broker.metrics.window.ms"]
+            self.sample_store.evict_before(
+                end_ms - p_horizon, end_ms - b_horizon
+            )
             self._last_sample_ms = end_ms
             return len(samples.partition_samples) + len(samples.broker_samples)
         finally:
@@ -226,10 +240,11 @@ class LoadMonitor:
                 if self._state is LoadMonitorState.SAMPLING:
                     self._state = prev_state
 
-    def _ingest(self, samples: Samples, metadata: ClusterMetadata | None = None) -> None:
+    def _ingest(self, samples: Samples, metadata: ClusterMetadata | None = None,
+                now_ms: int | None = None) -> None:
         if samples.partition_samples:
             ids, times, metrics = samples_to_arrays(samples.partition_samples)
-            self.partition_aggregator.add_samples(ids, times, metrics)
+            self.partition_aggregator.add_samples(ids, times, metrics, now_ms=now_ms)
         if samples.broker_samples:
             # Broker ids are operator-chosen and possibly sparse/large; map to
             # the dense broker axis via the metadata snapshot (same contract
@@ -242,7 +257,7 @@ class LoadMonitor:
                 ids = np.array([bidx[s.broker_id] for s in kept], np.int64)
                 times = np.array([s.time_ms for s in kept], np.int64)
                 metrics = np.array([s.metrics for s in kept])
-                self.broker_aggregator.add_samples(ids, times, metrics)
+                self.broker_aggregator.add_samples(ids, times, metrics, now_ms=now_ms)
         self._num_samples += len(samples.partition_samples) + len(samples.broker_samples)
 
     def pause_sampling(self, reason: str = "user request") -> None:

@@ -36,8 +36,9 @@ class SampleStore:
         """Replay persisted samples (called once at LoadMonitor startup)."""
         raise NotImplementedError
 
-    def evict_before(self, time_ms: int) -> None:
-        pass
+    def evict_before(self, partition_before_ms: int,
+                     broker_before_ms: int | None = None) -> None:
+        """Drop expired samples; broker scope may retain a different span."""
 
     def close(self) -> None:
         pass
@@ -106,9 +107,24 @@ class FileSampleStore(SampleStore):
                  if isinstance(s, BrokerMetricSample)],
             )
 
-    def evict_before(self, time_ms: int) -> None:
+    def evict_before(self, partition_before_ms: int,
+                     broker_before_ms: int | None = None) -> None:
+        if broker_before_ms is None:
+            broker_before_ms = partition_before_ms
         with self._lock:
-            for name in (self.PARTITION_LOG, self.BROKER_LOG):
-                recs = [s for s in self._read(name) if s.time_ms >= time_ms]
-                with open(self._path(name), "wb") as f:
+            for name, cutoff in (
+                (self.PARTITION_LOG, partition_before_ms),
+                (self.BROKER_LOG, broker_before_ms),
+            ):
+                path = self._path(name)
+                if not os.path.exists(path):
+                    continue
+                recs = [s for s in self._read(name) if s.time_ms >= cutoff]
+                # Atomic rewrite: a crash mid-eviction must not destroy the
+                # warm-start checkpoint (write-temp + rename).
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
                     f.write(serialize_batch(recs))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)

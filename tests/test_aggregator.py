@@ -124,6 +124,34 @@ def test_rolling_evicts_old_windows_and_bumps_generation():
     assert not agg.add_sample(0, 100, [1, 1, 1, 1])
 
 
+def test_early_model_with_few_windows_is_valid():
+    # Before a full W-window span has elapsed, only elapsed windows count
+    # (pre-genesis windows are not fabricated as NO_VALID).
+    agg = make_agg()
+    fill(agg, 0, [0, 1])
+    fill(agg, 0, [2], per_window=1)  # current window
+    r = agg.aggregate()
+    assert r.num_windows == 2
+    assert r.entity_valid[0]
+    assert r.meets(ModelCompletenessRequirements(2, 0.9))
+    assert not r.meets(ModelCompletenessRequirements(3, 0.9))
+
+
+def test_future_sample_rejected_with_now():
+    agg = make_agg()
+    fill(agg, 0, range(5))
+    now = 5 * WINDOW
+    # a sample 10 windows in the future must not wipe history
+    import numpy as np
+    n = agg.add_samples(
+        np.array([0]), np.array([now + 10 * WINDOW]),
+        np.array([[1.0, 1, 1, 1]]), now_ms=now,
+    )
+    assert n == 0
+    r = agg.aggregate()
+    assert r.entity_valid[0]  # history intact
+
+
 def test_completeness_ratio_and_requirements():
     agg = make_agg()
     fill(agg, 0, range(5))

@@ -1,0 +1,316 @@
+"""Executor-layer tests (ref C23-C28: ExecutionTaskPlannerTest/ExecutorTest)."""
+
+import pytest
+
+from ccx.common.exceptions import OngoingExecutionException
+from ccx.common.metadata import TopicPartition
+from ccx.config import CruiseControlConfig
+from ccx.executor.admin import THROTTLE_CONFIG, SimulatedAdminClient, SimulatedCluster
+from ccx.executor.execution_task import (
+    ExecutionTask,
+    TaskState,
+    TaskType,
+    tasks_from_proposals,
+)
+from ccx.executor.executor import ExecutionConcurrencyManager, Executor, ExecutorState
+from ccx.executor.strategy import (
+    BaseReplicaMovementStrategy,
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+)
+from ccx.executor.task_manager import (
+    ExecutionCaps,
+    ExecutionTaskManager,
+    ExecutionTaskTracker,
+)
+from ccx.proposals import ExecutionProposal
+
+
+def proposal(p, old, new, old_leader=None, new_leader=None, topic=0):
+    return ExecutionProposal(
+        partition=p, topic=topic,
+        old_replicas=tuple(old), new_replicas=tuple(new),
+        old_leader=old[0] if old_leader is None else old_leader,
+        new_leader=new[0] if new_leader is None else new_leader,
+        old_disks=tuple([0] * len(old)), new_disks=tuple([0] * len(new)),
+    )
+
+
+def sim_cluster(n_brokers=4, partitions=8, rf=2):
+    sim = SimulatedCluster()
+    for b in range(n_brokers):
+        sim.add_broker(b, rack=f"r{b % 2}")
+    sim.create_topic("t0", partitions, rf)
+    return sim
+
+
+def executor_config(**extra):
+    props = {
+        "execution.progress.check.interval.ms": 100,
+        "executor.concurrency.adjuster.enabled": "false",
+    }
+    props.update(extra)
+    return CruiseControlConfig(props)
+
+
+def test_tasks_from_proposals_typing():
+    ps = [
+        proposal(0, [0, 1], [2, 1]),                      # inter-broker (+leader)
+        proposal(1, [0, 1], [0, 1], old_leader=0, new_leader=1),  # leadership only
+        ExecutionProposal(2, 0, (0, 1), (0, 1), 0, 0,
+                          old_disks=(0, 0), new_disks=(1, 0)),    # disk move
+    ]
+    tasks = tasks_from_proposals(ps)
+    assert len(tasks[TaskType.INTER_BROKER_REPLICA_ACTION]) == 1
+    assert len(tasks[TaskType.LEADER_ACTION]) == 2  # inter move changed leader too
+    assert len(tasks[TaskType.INTRA_BROKER_REPLICA_ACTION]) == 1
+    t = tasks[TaskType.INTER_BROKER_REPLICA_ACTION][0]
+    assert t.source_brokers == (0,) and t.destination_brokers == (2,)
+
+
+def test_task_state_machine():
+    t = ExecutionTask(proposal(0, [0], [1]), TaskType.INTER_BROKER_REPLICA_ACTION)
+    t.transition(TaskState.IN_PROGRESS, 5)
+    assert t.start_ms == 5
+    t.transition(TaskState.COMPLETED, 9)
+    assert t.end_ms == 9
+    with pytest.raises(ValueError):
+        t.transition(TaskState.IN_PROGRESS)
+
+
+def test_strategy_ordering():
+    big = ExecutionTask(proposal(0, [0, 1], [2, 3]), TaskType.INTER_BROKER_REPLICA_ACTION)
+    small = ExecutionTask(proposal(1, [0, 1], [2, 1]), TaskType.INTER_BROKER_REPLICA_ACTION)
+    assert PrioritizeLargeReplicaMovementStrategy().sorted_tasks([small, big]) == [big, small]
+    assert PrioritizeSmallReplicaMovementStrategy().sorted_tasks([big, small]) == [small, big]
+    chain = PrioritizeSmallReplicaMovementStrategy().chain(BaseReplicaMovementStrategy())
+    assert chain.sorted_tasks([big, small]) == [small, big]
+    assert "PrioritizeSmall" in chain.name
+
+
+def test_postpone_urp_strategy():
+    sim = sim_cluster()
+    sim.kill_broker(3)
+    metadata = SimulatedAdminClient(sim).describe_cluster()
+    urp_tp = next(p.tp for p in metadata.under_replicated())
+    healthy_tp = next(p.tp for p in metadata.partitions
+                      if p.tp not in {u.tp for u in metadata.under_replicated()})
+    t_urp = ExecutionTask(proposal(0, [0], [1]), TaskType.INTER_BROKER_REPLICA_ACTION, urp_tp)
+    t_ok = ExecutionTask(proposal(1, [0], [1]), TaskType.INTER_BROKER_REPLICA_ACTION, healthy_tp)
+    out = PostponeUrpReplicaMovementStrategy().sorted_tasks([t_urp, t_ok], metadata)
+    assert out == [t_ok, t_urp]
+
+
+def test_planner_respects_per_broker_cap():
+    # 4 moves all out of broker 0 -> cap 2 admits only 2 at a time
+    ps = [proposal(i, [0], [i + 1]) for i in range(4)]
+    mgr = ExecutionTaskManager(
+        ps, BaseReplicaMovementStrategy(),
+        ExecutionCaps(per_broker_inter=2, max_cluster_movements=100),
+    )
+    batch = mgr.planner.inter_broker_batch(mgr.tracker, None)
+    assert len(batch) == 2
+    mgr.mark(batch, TaskState.IN_PROGRESS)
+    assert mgr.planner.inter_broker_batch(mgr.tracker, None) == []
+    mgr.mark(batch, TaskState.COMPLETED)
+    assert len(mgr.planner.inter_broker_batch(mgr.tracker, None)) == 2
+
+
+def test_planner_respects_cluster_cap():
+    ps = [proposal(i, [i % 4], [(i % 4 + 1) % 8 + (4 if i % 2 else 0)])
+          for i in range(12)]
+    mgr = ExecutionTaskManager(
+        ps, BaseReplicaMovementStrategy(),
+        ExecutionCaps(per_broker_inter=100, max_cluster_movements=3),
+    )
+    assert len(mgr.planner.inter_broker_batch(mgr.tracker, None)) == 3
+
+
+def test_tracker_counts_and_progress():
+    ps = [proposal(i, [0], [1]) for i in range(3)]
+    tasks = tasks_from_proposals(ps)
+    tr = ExecutionTaskTracker(tasks)
+    assert not tr.finished
+    ts = tr.tasks_of(TaskType.INTER_BROKER_REPLICA_ACTION)
+    for t in ts:
+        t.transition(TaskState.IN_PROGRESS, 0)
+        t.transition(TaskState.COMPLETED, 1)
+    for t in tr.tasks_of(TaskType.LEADER_ACTION):
+        t.transition(TaskState.ABORTED, 1)
+    assert tr.finished
+    done, total = tr.data_moved_mb()
+    assert done == total == 3
+
+
+def make_executor(sim, **cfg):
+    admin = SimulatedAdminClient(sim)
+    waiter = lambda ms: sim.tick(int(ms))  # noqa: E731 — simulated time
+    ex = Executor(executor_config(**cfg), admin, clock=lambda: sim.time_ms,
+                  waiter=waiter)
+    return ex, admin
+
+
+def test_executor_end_to_end_moves_replicas():
+    sim = sim_cluster()
+    ex, admin = make_executor(sim)
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 0)
+    old = list(sim.partition(tp).replicas)
+    new = [b for b in range(4) if b not in old][:1] + old[1:]
+    p = ExecutionProposal(0, 0, tuple(old), tuple(new), old[0], new[0])
+    mgr = ex.execute_proposals([p], metadata, uuid="u1")
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+    assert sorted(sim.partition(tp).replicas) == sorted(new)
+    assert all(t.state is TaskState.COMPLETED
+               for t in mgr.tracker.tasks_of(TaskType.INTER_BROKER_REPLICA_ACTION))
+    # leadership of the proposal was honored
+    assert sim.partition(tp).leader == new[0]
+
+
+def test_executor_leadership_only_movement():
+    sim = sim_cluster()
+    ex, admin = make_executor(sim)
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 1)
+    part = sim.partition(tp)
+    old_leader, new_leader = part.replicas[0], part.replicas[1]
+    p = ExecutionProposal(1, 0, tuple(part.replicas), tuple(part.replicas),
+                          old_leader, new_leader)
+    mgr = ex.execute_proposals([p], metadata)
+    assert sim.partition(tp).leader == new_leader
+    assert all(t.state is TaskState.COMPLETED
+               for t in mgr.tracker.tasks_of(TaskType.LEADER_ACTION))
+
+
+def test_executor_throttle_set_and_cleared():
+    sim = sim_cluster()
+    seen = {"during": None}
+    ex, admin = make_executor(sim, **{"default.replication.throttle": 50_000_000})
+
+    orig_tick = sim.tick
+
+    def spy_tick(ms):
+        cfgs = admin.describe_configs([0])[0]
+        if THROTTLE_CONFIG in cfgs:
+            seen["during"] = cfgs[THROTTLE_CONFIG]
+        orig_tick(ms)
+
+    sim.tick = spy_tick
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 0)
+    old = list(sim.partition(tp).replicas)
+    new = [b for b in range(4) if b not in old][:1] + old[1:]
+    ex.execute_proposals([proposal(0, old, new)], metadata)
+    assert seen["during"] == "50000000"          # throttle present mid-flight
+    assert THROTTLE_CONFIG not in admin.describe_configs([0])[0]  # cleared
+
+
+def test_executor_reservation_blocks_concurrent_runs():
+    sim = sim_cluster()
+    ex, admin = make_executor(sim)
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 0)
+    sim._partitions[tp].size_mb = 1e6  # ~1000 ticks: stays in flight
+    old = list(sim.partition(tp).replicas)
+    new = [b for b in range(4) if b not in old][:1] + old[1:]
+    p = proposal(0, old, new)
+    ex.execute_proposals([p], metadata, background=True)
+    with pytest.raises(OngoingExecutionException):
+        ex.execute_proposals([p], metadata)
+    ex.await_completion()
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_executor_stop_aborts_pending():
+    sim = sim_cluster(n_brokers=6, partitions=12, rf=1)
+    # big partitions so movement takes many ticks; cap 1 so most stay pending
+    for tp_ in list(sim._partitions):
+        sim._partitions[tp_].size_mb = 1e5
+    ex, admin = make_executor(
+        sim, **{"num.concurrent.partition.movements.per.broker": 1}
+    )
+    metadata = admin.describe_cluster()
+    ps = []
+    for i in range(12):
+        tp_ = TopicPartition("t0", i)
+        old = list(sim.partition(tp_).replicas)
+        new = [(old[0] + 1) % 6]
+        ps.append(ExecutionProposal(i, 0, tuple(old), tuple(new), old[0], new[0]))
+
+    stopped = {"done": False}
+    orig_tick = sim.tick
+
+    def tick_then_stop(ms):
+        orig_tick(ms)
+        if not stopped["done"]:
+            stopped["done"] = True
+            ex.stop_execution()
+
+    ex.waiter = tick_then_stop
+    mgr = ex.execute_proposals(ps, metadata)
+    states = {t.state for t in mgr.tracker.all_tasks()}
+    assert TaskState.ABORTED in states
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_executor_dead_destination_marks_task_dead():
+    sim = sim_cluster()
+    ex, admin = make_executor(sim)
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 0)
+    sim._partitions[tp].size_mb = 1e5  # slow move
+    old = list(sim.partition(tp).replicas)
+    dest = [b for b in range(4) if b not in old][0]
+    new = [dest] + old[1:]
+
+    killed = {"done": False}
+    orig_tick = sim.tick
+
+    def tick_kill(ms):
+        orig_tick(ms)
+        if not killed["done"]:
+            killed["done"] = True
+            sim.kill_broker(dest)
+
+    ex.waiter = tick_kill
+    mgr = ex.execute_proposals([proposal(0, old, new, new_leader=old[1])], metadata)
+    inter = mgr.tracker.tasks_of(TaskType.INTER_BROKER_REPLICA_ACTION)
+    assert inter[0].state is TaskState.DEAD
+
+
+def test_concurrency_manager_adjusts():
+    cfg = CruiseControlConfig({
+        "num.concurrent.partition.movements.per.broker": 4,
+        "executor.concurrency.adjuster.max.partition.movements.per.broker": 8,
+        "executor.concurrency.adjuster.min.partition.movements.per.broker": 1,
+    })
+    cm = ExecutionConcurrencyManager(cfg)
+    sim = sim_cluster()
+    admin = SimulatedAdminClient(sim)
+    healthy = admin.describe_cluster()
+    assert cm.adjust(healthy) == 5          # healthy -> +1
+    sim.kill_broker(3)
+    unhealthy = admin.describe_cluster()
+    assert cm.adjust(unhealthy) == 2        # URP -> halve
+    assert cm.adjust(unhealthy) == 1
+    assert cm.adjust(unhealthy) == 1        # floor
+
+
+def test_dense_index_resolution_via_metadata():
+    sim = SimulatedCluster()
+    for b in (10, 20, 30):   # sparse broker ids
+        sim.add_broker(b, rack="r0")
+    sim.create_topic("t0", 2, 2)
+    admin = SimulatedAdminClient(sim)
+    metadata = admin.describe_cluster()
+    # proposal in dense indices: partition 0 moves dense 0 -> dense 2
+    info = metadata.partitions[0]
+    bidx = metadata.broker_index()
+    dense_old = tuple(bidx[b] for b in info.replicas)
+    dense_new = (2,) + dense_old[1:]
+    p = ExecutionProposal(0, 0, dense_old, dense_new, dense_old[0], 2)
+    tasks = tasks_from_proposals([p], metadata)
+    t = tasks[TaskType.INTER_BROKER_REPLICA_ACTION][0]
+    assert t.proposal.new_replicas[0] == 30   # resolved to real id
+    assert t.tp == TopicPartition("t0", 0)
