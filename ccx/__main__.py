@@ -1,0 +1,3 @@
+from ccx.main import main
+
+raise SystemExit(main())

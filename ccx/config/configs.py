@@ -139,6 +139,18 @@ def analyzer_config_def() -> ConfigDef:
              Importance.MEDIUM, "Usable fraction of NW_OUT capacity.", between(0, 1))
     d.define("max.replicas.per.broker", Type.LONG, 10_000, Importance.MEDIUM,
              "ReplicaCapacityGoal limit.", at_least(1))
+    d.define("cpu.low.utilization.threshold", Type.DOUBLE, 0.0, Importance.LOW,
+             "Below this CPU utilization a broker is ignored by the CPU "
+             "distribution goal.", between(0, 1))
+    d.define("disk.low.utilization.threshold", Type.DOUBLE, 0.0, Importance.LOW,
+             "DISK low-utilization gate.", between(0, 1))
+    d.define("network.inbound.low.utilization.threshold", Type.DOUBLE, 0.0,
+             Importance.LOW, "NW_IN low-utilization gate.", between(0, 1))
+    d.define("network.outbound.low.utilization.threshold", Type.DOUBLE, 0.0,
+             Importance.LOW, "NW_OUT low-utilization gate.", between(0, 1))
+    d.define("leader.bytes.in.balance.threshold", Type.DOUBLE, 1.1,
+             Importance.LOW, "LeaderBytesInDistributionGoal band width.",
+             at_least(1))
     d.define("min.topic.leaders.per.broker", Type.INT, 1, Importance.LOW,
              "MinTopicLeadersPerBrokerGoal requirement.", at_least(0))
     d.define("topics.with.min.leaders.per.broker", Type.STRING, "", Importance.LOW,
@@ -232,6 +244,10 @@ def anomaly_detector_config_def() -> ConfigDef:
              "Topic-anomaly detector period; -1 = default interval.")
     d.define("broker.failure.detection.backoff.ms", Type.LONG, 300_000,
              Importance.LOW, "Broker-failure re-check backoff.", at_least(1))
+    d.define("failed.brokers.file.path", Type.STRING, "", Importance.LOW,
+             "File persisting broker-failure first-seen times across "
+             "restarts (ref failed.brokers.zk.path/file); empty = "
+             "<sample.store.dir>/failed_brokers.json.")
     d.define("anomaly.notifier.class", Type.CLASS,
              "ccx.detector.notifier.SelfHealingNotifier", Importance.HIGH,
              "AnomalyNotifier SPI (ref C30).")
@@ -257,12 +273,12 @@ def anomaly_detector_config_def() -> ConfigDef:
              Importance.LOW, "History percentile a slow broker must exceed.",
              between(0, 100))
     d.define("topic.anomaly.finder.class", Type.CLASS,
-             "ccx.detector.topic_anomaly.TopicReplicationFactorAnomalyFinder",
+             "ccx.detector.detectors.TopicReplicationFactorAnomalyFinder",
              Importance.LOW, "TopicAnomalyFinder SPI.")
     d.define("target.topic.replication.factor", Type.INT, 3, Importance.LOW,
              "Desired RF for topic-anomaly detection.", at_least(1))
     d.define("maintenance.event.reader.class", Type.CLASS,
-             "ccx.detector.maintenance.NoopMaintenanceEventReader",
+             "ccx.detector.detectors.NoopMaintenanceEventReader",
              Importance.LOW, "MaintenanceEventReader SPI.")
     d.define("provisioner.class", Type.CLASS,
              "ccx.detector.provisioner.BasicProvisioner", Importance.LOW,
@@ -299,7 +315,14 @@ def webserver_config_def() -> ConfigDef:
              "SecurityProvider SPI.")
     d.define("webserver.auth.credentials.file", Type.STRING, "", Importance.MEDIUM,
              "Credentials file for the basic provider "
-             "(user: password,ROLE per line).")
+             "(user: password,ROLE per line); for the JWT provider it holds "
+             "the HMAC signing secret.")
+    d.define("webserver.trusted.proxy.ips", Type.LIST, ("127.0.0.1",),
+             Importance.LOW, "Peer addresses allowed to assert principals "
+             "via the trusted-proxy provider.")
+    d.define("webserver.trusted.proxy.admin.principals", Type.LIST, (),
+             Importance.LOW, "Principals granted ADMIN by the trusted-proxy "
+             "provider (others get USER).")
     d.define("vertx.api.enabled", Type.BOOLEAN, False, Importance.LOW,
              "Alternative API server flavor flag (ref C36; same endpoints).")
     return d

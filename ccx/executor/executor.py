@@ -130,6 +130,7 @@ class Executor:
         self._manager: ExecutionTaskManager | None = None
         self._thread: threading.Thread | None = None
         self._last_uuid: str | None = None
+        self._replication_throttle = config["default.replication.throttle"]
 
     # ----- state ------------------------------------------------------------
 
@@ -166,6 +167,11 @@ class Executor:
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested.clear()
             self._last_uuid = uuid
+            self._replication_throttle = (
+                replication_throttle
+                if replication_throttle is not None
+                else self.config["default.replication.throttle"]
+            )
             self._manager = ExecutionTaskManager(
                 proposals, self.strategy, self.caps, metadata
             )
@@ -199,9 +205,7 @@ class Executor:
     def _run(self) -> None:
         mgr = self._manager
         assert mgr is not None
-        throttle = ReplicationThrottleHelper(
-            self.admin, self.config["default.replication.throttle"]
-        )
+        throttle = ReplicationThrottleHelper(self.admin, self._replication_throttle)
         brokers = [b.broker_id for b in mgr.metadata.brokers] if mgr.metadata else []
         throttle.set_throttles(brokers)
         try:

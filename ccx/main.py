@@ -1,0 +1,102 @@
+"""Service entry point.
+
+Parity: ``KafkaCruiseControlMain.java`` (SURVEY.md C22, call stack 3.1):
+parse the properties file, build the façade (monitor → analyzer → executor →
+detector), start the REST server, serve until interrupted.
+
+Usage::
+
+    python -m ccx [config/cruisecontrol.properties] [port] [hostname]
+
+With the default simulated admin client this boots a self-contained demo
+cluster (brokers/topics from ``demo.*`` keys) — the standalone mode used by
+benchmarks and integration tests; pointing ``admin.client.class`` at a real
+cluster adapter is the production path.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+
+from ccx.config import CruiseControlConfig
+from ccx.servlet.server import CruiseControlApp
+from ccx.service.facade import CruiseControl
+
+
+def build_demo_admin(n_brokers: int = 6, n_racks: int = 3,
+                     topics: tuple[tuple[str, int, int], ...] = (
+                         ("demo-a", 32, 2), ("demo-b", 16, 3)
+                     )):
+    from ccx.executor.admin import SimulatedAdminClient, SimulatedCluster
+
+    sim = SimulatedCluster()
+    for b in range(n_brokers):
+        sim.add_broker(b, rack=f"rack-{b % n_racks}", num_disks=2)
+    for name, parts, rf in topics:
+        sim.create_topic(name, parts, rf)
+    return SimulatedAdminClient(sim)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    props = {}
+    if argv:
+        cfg = CruiseControlConfig.from_properties_file(argv[0])
+    else:
+        cfg = CruiseControlConfig(
+            {
+                "metric.sampler.class":
+                    "ccx.monitor.sampling.sampler.SyntheticMetricSampler",
+                "broker.capacity.config.resolver.class":
+                    "ccx.monitor.capacity.StaticCapacityResolver",
+                "metric.sampling.interval.ms": 5000,
+                "partition.metrics.window.ms": 10_000,
+                "num.partition.metrics.windows": 3,
+                "broker.metrics.window.ms": 10_000,
+                "num.broker.metrics.windows": 3,
+            }
+        )
+    if len(argv) > 1:
+        cfg = cfg.with_overrides(**{"webserver.http.port": int(argv[1])})
+    if len(argv) > 2:
+        cfg = cfg.with_overrides(**{"webserver.http.address": argv[2]})
+
+    admin = cfg.configured_instance("admin.client.class")
+    from ccx.executor.admin import SimulatedAdminClient
+
+    if isinstance(admin, SimulatedAdminClient) and not admin.cluster._brokers:
+        admin = build_demo_admin()
+
+    facade = CruiseControl(cfg, admin=admin)
+    facade.start_up()
+    app = CruiseControlApp(cfg, facade)
+    host, port = app.start()
+    logging.info("ccx REST API listening on http://%s:%s%s", host, port,
+                 "/kafkacruisecontrol/state")
+
+    stop = {"flag": False}
+
+    def on_signal(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop["flag"]:
+            signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        app.stop()
+        facade.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,613 @@
+"""CruiseControl — the service façade: one method per operation verb.
+
+Parity: ``KafkaCruiseControl.java`` + ``KafkaCruiseControlApp`` lifecycle
+(SURVEY.md C22, call stacks 3.1/3.2/3.3): construction wires LoadMonitor,
+the analyzer (TPU optimizer), Executor and AnomalyDetectorManager; startUp
+order is monitor → detector → (REST server started by the caller). Each verb
+builds a model from the monitor, runs the goal stack on device, and either
+returns the dry-run result or hands proposals to the executor.
+
+The analyzer side honors ``goal.optimizer.backend`` (north star
+``=tpu``, BASELINE.json:5): 'tpu' = batched SA + polish on device,
+'greedy' = host-side greedy oracle only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+from ccx.common.exceptions import UserRequestException
+from ccx.detector.manager import AnomalyDetectorManager
+from ccx.detector.provisioner import BasicProvisioner
+from ccx.executor.admin import SimulatedAdminClient
+from ccx.executor.executor import Executor
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import INTRA_BROKER_GOAL_ORDER
+from ccx.monitor.aggregator import ModelCompletenessRequirements
+from ccx.monitor.load_monitor import LoadMonitor, ModelBuildOptions
+from ccx.monitor.metricdef import BROKER_METRIC_DEF
+from ccx.optimizer import OptimizeOptions, OptimizerResult, optimize
+from ccx.search.annealer import AnnealOptions
+from ccx.search.greedy import GreedyOptions, greedy_optimize
+from ccx.proposals import diff
+
+
+class CruiseControl:
+    """The L4 façade (ref C22)."""
+
+    def __init__(self, config, admin=None, clock=None, executor_waiter=None) -> None:
+        self.config = config
+        self.clock = clock or (lambda: int(_time.time() * 1000))
+        self.admin = admin or config.configured_instance("admin.client.class")
+        self.load_monitor = LoadMonitor(config, self.admin, clock=self.clock)
+        self.executor = Executor(
+            config, self.admin, clock=self.clock, waiter=executor_waiter,
+            broker_metrics_fn=self._broker_health_metrics,
+        )
+        self.anomaly_detector = AnomalyDetectorManager(
+            config, self.load_monitor, facade=self, clock=self.clock
+        )
+        self.provisioner = config.configured_instance("provisioner.class")
+        if self.provisioner is None:
+            self.provisioner = BasicProvisioner(config)
+        self.goal_config = GoalConfig.from_config(config)
+        self._proposal_cache: OptimizerResult | None = None
+        self._proposal_cache_ms = -1
+        self._proposal_lock = threading.Lock()
+        self._precompute_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._start_ms = self.clock()
+
+    # ----- lifecycle (ref startUp order: monitor -> detector -> servlet) ----
+
+    def start_up(self, run_background_threads: bool = True) -> None:
+        self.load_monitor.start_up(run_sampling_loop=run_background_threads)
+        if run_background_threads:
+            self.anomaly_detector.start_detection()
+            if self.config["num.proposal.precompute.threads"] > 0:
+                self._precompute_thread = threading.Thread(
+                    target=self._precompute_loop,
+                    name="ProposalCandidateComputer", daemon=True,
+                )
+                self._precompute_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.anomaly_detector.shutdown()
+        self.load_monitor.shutdown()
+
+    # ----- goal plumbing ----------------------------------------------------
+
+    def _resolve_goals(self, goals=None, self_healing: bool = False) -> tuple[str, ...]:
+        """Request goal list -> registry stack with the structural term first
+        (ref: goalsByPriority resolution in GoalOptimizer)."""
+        if goals:
+            unknown = [g for g in goals if g not in GOAL_REGISTRY]
+            if unknown:
+                raise UserRequestException(f"Unknown goals: {unknown}")
+            names = tuple(goals)
+        elif self_healing:
+            names = tuple(self.config["self.healing.goals"]) or tuple(
+                self.config["hard.goals"]
+            )
+        else:
+            names = tuple(self.config["default.goals"]) or tuple(
+                self.config["goals"]
+            )
+        names = tuple(g for g in names if g in GOAL_REGISTRY)
+        return ("StructuralFeasibility",) + tuple(
+            g for g in names if g != "StructuralFeasibility"
+        )
+
+    def _optimize_options(self, leadership_only: bool = False,
+                          disk_only: bool = False) -> OptimizeOptions:
+        anneal = AnnealOptions(
+            n_chains=self.config["optimizer.num.chains"],
+            n_steps=self.config["optimizer.num.steps"],
+            seed=self.config["optimizer.seed"],
+        )
+        polish = GreedyOptions(
+            n_candidates=self.config["optimizer.polish.candidates"],
+            max_iters=self.config["optimizer.polish.max.iters"],
+        )
+        if leadership_only:
+            anneal = AnnealOptions(
+                n_chains=anneal.n_chains, n_steps=anneal.n_steps,
+                seed=anneal.seed, p_leadership=1.0, p_biased_dest=0.0,
+            )
+            polish = GreedyOptions(
+                n_candidates=polish.n_candidates, max_iters=polish.max_iters,
+                p_leadership=1.0,
+            )
+        if disk_only:
+            anneal = AnnealOptions(
+                n_chains=anneal.n_chains, n_steps=anneal.n_steps,
+                seed=anneal.seed, p_disk=1.0, p_leadership=0.0,
+                p_biased_dest=0.0,
+            )
+            polish = GreedyOptions(
+                n_candidates=polish.n_candidates, max_iters=polish.max_iters,
+                p_disk=1.0, p_leadership=0.0,
+            )
+        return OptimizeOptions(
+            anneal=anneal, polish=polish,
+            check_evacuation=not disk_only,
+        )
+
+    def _run_optimizer(self, model, goal_names, opts: OptimizeOptions,
+                       progress=None) -> OptimizerResult:
+        backend = self.config["goal.optimizer.backend"]
+        if progress:
+            progress.step(f"Optimizing ({backend} backend, {len(goal_names)} goals)")
+        if backend == "greedy":
+            import time as _t
+
+            t0 = _t.monotonic()
+            g = greedy_optimize(model, self.goal_config, goal_names, opts.polish)
+            from ccx.goals.stack import evaluate_stack
+            from ccx.verify import verify_optimization
+
+            proposals = diff(model, g.model)
+            stack_before = evaluate_stack(model, self.goal_config, goal_names)
+            verification = verify_optimization(
+                model, g.model, self.goal_config, goal_names,
+                proposals=proposals,
+                require_hard_zero=opts.require_hard_zero,
+                check_evacuation=opts.check_evacuation,
+                stack_before=stack_before,
+                stack_after=g.stack_after,
+            )
+            return OptimizerResult(
+                proposals=proposals,
+                stack_before=stack_before,
+                stack_after=g.stack_after,
+                verification=verification,
+                model=g.model,
+                wall_seconds=_t.monotonic() - t0,
+                n_sa_accepted=0,
+                n_polish_moves=g.n_moves,
+            )
+        return optimize(model, self.goal_config, goal_names, opts)
+
+    def _model(self, options: ModelBuildOptions | None = None,
+               requirements: ModelCompletenessRequirements | None = None,
+               progress=None):
+        if progress:
+            progress.step("Acquiring cluster model")
+        req = requirements or ModelCompletenessRequirements(1, 0.5)
+        with self.load_monitor.acquire_for_model_generation():
+            return self.load_monitor.cluster_model(req, options)
+
+    def _finish(self, res: OptimizerResult, metadata, dryrun: bool,
+                reason: str, uuid: str | None, progress=None,
+                replication_throttle=None) -> dict:
+        out = res.to_json()
+        out["dryRun"] = dryrun
+        out["reason"] = reason
+        out["provisionStatus"] = self.provisioner.rightsize(res.model).to_json()
+        if not dryrun and res.proposals:
+            if progress:
+                progress.step(f"Executing {len(res.proposals)} proposals")
+            self.executor.execute_proposals(
+                res.proposals, metadata, uuid=uuid,
+                replication_throttle=replication_throttle, background=True,
+            )
+            out["executionStarted"] = True
+        return out
+
+    # ----- verbs (one per REST operation, ref C22) --------------------------
+
+    def rebalance(self, goals=None, dryrun: bool = True, reason: str = "",
+                  self_healing: bool = False, excluded_topics: str = "",
+                  uuid: str | None = None, progress=None,
+                  rebalance_disk: bool = False,
+                  destination_brokers=(),
+                  replication_throttle=None) -> dict:
+        if rebalance_disk:
+            return self.rebalance_disk(
+                dryrun=dryrun, reason=reason, uuid=uuid, progress=progress
+            )
+        model, metadata, gen = self._model(
+            ModelBuildOptions(excluded_topics_pattern=excluded_topics),
+            progress=progress,
+        )
+        model = _restrict_destinations(model, metadata, destination_brokers)
+        res = self._run_optimizer(
+            model, self._resolve_goals(goals, self_healing),
+            self._optimize_options(), progress,
+        )
+        return self._finish(res, metadata, dryrun, reason, uuid, progress,
+                            replication_throttle)
+
+    def add_brokers(self, broker_ids, goals=None, dryrun: bool = True,
+                    reason: str = "", self_healing: bool = False,
+                    uuid: str | None = None, progress=None,
+                    replication_throttle=None) -> dict:
+        """Move load onto the added brokers (ref addBrokers: existing brokers
+        may not receive replicas during the operation)."""
+        model, metadata, gen = self._model(
+            ModelBuildOptions(brokers_to_add=tuple(broker_ids)),
+            progress=progress,
+        )
+        import numpy as np
+
+        new_mask = np.asarray(model.broker_new)
+        excl = np.asarray(model.broker_valid) & ~new_mask
+        model = model.replace(
+            broker_excl_replicas=model.broker_excl_replicas | excl
+        )
+        res = self._run_optimizer(
+            model, self._resolve_goals(goals, self_healing),
+            self._optimize_options(), progress,
+        )
+        return self._finish(res, metadata, dryrun, reason, uuid, progress,
+                            replication_throttle)
+
+    def remove_brokers(self, broker_ids, goals=None, dryrun: bool = True,
+                       reason: str = "", self_healing: bool = False,
+                       uuid: str | None = None, progress=None,
+                       destination_brokers=(),
+                       replication_throttle=None) -> dict:
+        """Evacuate the given brokers (ref removeBrokers; also the
+        broker-failure self-healing fix, call stack 3.5)."""
+        model, metadata, gen = self._model(
+            ModelBuildOptions(brokers_to_remove=tuple(broker_ids)),
+            progress=progress,
+        )
+        model = _restrict_destinations(model, metadata, destination_brokers)
+        res = self._run_optimizer(
+            model, self._resolve_goals(goals, self_healing),
+            self._optimize_options(), progress,
+        )
+        return self._finish(res, metadata, dryrun, reason, uuid, progress,
+                            replication_throttle)
+
+    def demote_brokers(self, broker_ids, dryrun: bool = True, reason: str = "",
+                       self_healing: bool = False, uuid: str | None = None,
+                       progress=None) -> dict:
+        """Shed leadership from the given brokers (ref demoteBrokers →
+        PreferredLeaderElectionGoal, leadership moves only)."""
+        model, metadata, gen = self._model(
+            ModelBuildOptions(brokers_to_demote=tuple(broker_ids)),
+            progress=progress,
+        )
+        res = self._run_optimizer(
+            model,
+            ("StructuralFeasibility", "PreferredLeaderElectionGoal"),
+            self._optimize_options(leadership_only=True),
+            progress,
+        )
+        return self._finish(res, metadata, dryrun, reason, uuid, progress)
+
+    def fix_offline_replicas(self, goals=None, dryrun: bool = True,
+                             reason: str = "", self_healing: bool = False,
+                             uuid: str | None = None, progress=None) -> dict:
+        """Move replicas off dead brokers/disks (ref fixOfflineReplicas;
+        the disk-failure self-healing fix)."""
+        model, metadata, gen = self._model(progress=progress)
+        res = self._run_optimizer(
+            model, self._resolve_goals(goals, self_healing=True),
+            self._optimize_options(), progress,
+        )
+        return self._finish(res, metadata, dryrun, reason, uuid, progress)
+
+    def rebalance_disk(self, dryrun: bool = True, reason: str = "",
+                       uuid: str | None = None, progress=None) -> dict:
+        """Intra-broker JBOD rebalance (ref rebalance?rebalance_disk, C18)."""
+        model, metadata, gen = self._model(
+            ModelBuildOptions(populate_disks=True), progress=progress
+        )
+        res = self._run_optimizer(
+            model, INTRA_BROKER_GOAL_ORDER,
+            self._optimize_options(disk_only=True), progress,
+        )
+        return self._finish(res, metadata, dryrun, reason, uuid, progress)
+
+    def update_topic_configuration(self, topic_rf: dict[str, int],
+                                   dryrun: bool = True, reason: str = "",
+                                   self_healing: bool = False,
+                                   uuid: str | None = None,
+                                   progress=None) -> dict:
+        """Change topic replication factors (ref TOPIC_CONFIGURATION
+        endpoint): grow RF rack-aware onto least-loaded brokers, shrink by
+        dropping the most-loaded non-leader replica; placement is then
+        verified/executed through the normal proposal path."""
+        if progress:
+            progress.step("Computing replication-factor changes")
+        metadata = self.admin.describe_cluster()
+        from ccx.proposals import ExecutionProposal
+
+        bidx = metadata.broker_index()
+        alive = metadata.alive_broker_ids()
+        rack_of = {b.broker_id: b.rack for b in metadata.brokers}
+        load = {b.broker_id: 0 for b in metadata.brokers}
+        for p in metadata.partitions:
+            for b in p.replicas:
+                load[b] = load.get(b, 0) + 1
+        proposals = []
+        pidx = metadata.partition_index()
+        for topic, target in topic_rf.items():
+            for part in metadata.partitions_of(topic):
+                current = list(part.replicas)
+                new = list(current)
+                while len(new) < target:
+                    used_racks = {rack_of[b] for b in new}
+                    candidates = sorted(
+                        (b for b in alive if b not in new),
+                        key=lambda b: (rack_of[b] in used_racks, load[b]),
+                    )
+                    if not candidates:
+                        break
+                    new.append(candidates[0])
+                    load[candidates[0]] += 1
+                while len(new) > target and len(new) > 1:
+                    drop = max(
+                        (b for b in new if b != part.leader),
+                        key=lambda b: load[b],
+                        default=None,
+                    )
+                    if drop is None:
+                        break
+                    new.remove(drop)
+                    load[drop] -= 1
+                if new != current:
+                    proposals.append(
+                        ExecutionProposal(
+                            partition=pidx[part.tp], topic=0,
+                            old_replicas=tuple(current),
+                            new_replicas=tuple(new),
+                            old_leader=part.leader, new_leader=part.leader,
+                        )
+                    )
+        out = {
+            "proposals": [p.to_json() for p in proposals],
+            "numReplicaMovements": len(proposals),
+            "dryRun": dryrun,
+            "reason": reason,
+        }
+        if not dryrun and proposals:
+            # proposals here already use real broker ids: execute with a
+            # metadata whose broker order maps identity
+            if progress:
+                progress.step(f"Executing {len(proposals)} RF changes")
+            self.executor.execute_proposals(
+                proposals, _identity_metadata(metadata), uuid=uuid,
+                background=True,
+            )
+            out["executionStarted"] = True
+        return out
+
+    def rightsize(self, progress=None) -> dict:
+        """Ref RIGHTSIZE endpoint → Provisioner SPI (C21)."""
+        model, metadata, gen = self._model(progress=progress)
+        return self.provisioner.rightsize(model).to_json()
+
+    # ----- cached proposals (ref GoalOptimizer precompute, C14) -------------
+
+    def proposals(self, progress=None, ignore_cache: bool = False) -> dict:
+        with self._proposal_lock:
+            fresh = (
+                self._proposal_cache is not None
+                and self.clock() - self._proposal_cache_ms
+                < self.config["proposal.expiration.ms"]
+            )
+            if fresh and not ignore_cache:
+                out = self._proposal_cache.to_json()
+                out["fromCache"] = True
+                return out
+        model, metadata, gen = self._model(progress=progress)
+        res = self._run_optimizer(
+            model, self._resolve_goals(), self._optimize_options(), progress
+        )
+        with self._proposal_lock:
+            self._proposal_cache = res
+            self._proposal_cache_ms = self.clock()
+        out = res.to_json()
+        out["fromCache"] = False
+        return out
+
+    def _precompute_loop(self) -> None:
+        interval = max(self.config["proposal.expiration.ms"] / 2, 1000) / 1000.0
+        while not self._stop.wait(interval):
+            try:
+                self.proposals(ignore_cache=True)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("proposal precompute failed")
+
+    # ----- read endpoints ---------------------------------------------------
+
+    def state(self, substates: tuple[str, ...] = ()) -> dict:
+        want = set(s.lower() for s in substates) or {
+            "monitor", "executor", "analyzer", "anomaly_detector"
+        }
+        out: dict = {"version": 1}
+        if "monitor" in want:
+            out["MonitorState"] = self.load_monitor.state()
+        if "executor" in want:
+            out["ExecutorState"] = self.executor.state_json()
+        if "analyzer" in want:
+            with self._proposal_lock:
+                out["AnalyzerState"] = {
+                    "isProposalReady": self._proposal_cache is not None,
+                    "readyGoals": list(self._resolve_goals()),
+                    "backend": self.config["goal.optimizer.backend"],
+                }
+        if "anomaly_detector" in want:
+            out["AnomalyDetectorState"] = self.anomaly_detector.state()
+        return out
+
+    def kafka_cluster_state(self) -> dict:
+        """Ref KAFKA_CLUSTER_STATE endpoint."""
+        md = self.admin.describe_cluster()
+        return {
+            "KafkaBrokerState": {
+                "ReplicaCountByBrokerId": _count_by_broker(md, leaders=False),
+                "LeaderCountByBrokerId": _count_by_broker(md, leaders=True),
+                "OnlineLogDirsByBrokerId": {
+                    str(b): [d for d, ok in dirs.items() if ok]
+                    for b, dirs in self.admin.describe_log_dirs().items()
+                },
+                "IsController": {},
+                "Summary": {
+                    "Brokers": len(md.brokers),
+                    "AliveBrokers": len(md.alive_broker_ids()),
+                    "Topics": len(md.topics()),
+                    "Partitions": len(md.partitions),
+                    "Replicas": md.replica_count(),
+                    "UnderReplicatedPartitions": len(md.under_replicated()),
+                },
+            }
+        }
+
+    def load(self) -> dict:
+        """Ref LOAD endpoint: per-broker resource utilization."""
+        model, metadata, gen = self._model()
+        from ccx.model.aggregates import broker_aggregates
+        import numpy as np
+
+        agg = broker_aggregates(model)
+        loads = np.asarray(agg.broker_load)          # [RES, B]
+        caps = np.asarray(model.broker_capacity)
+        out = []
+        for i, b in enumerate(metadata.brokers):
+            out.append(
+                {
+                    "Broker": b.broker_id,
+                    "Rack": b.rack,
+                    "BrokerState": "ALIVE" if b.alive else "DEAD",
+                    "Replicas": int(np.asarray(agg.replica_count)[i]),
+                    "Leaders": int(np.asarray(agg.leader_count)[i]),
+                    "CpuPct": float(loads[0, i]),
+                    "NwInRate": float(loads[1, i]),
+                    "NwOutRate": float(loads[2, i]),
+                    "DiskMB": float(loads[3, i]),
+                    "DiskPct": float(
+                        100.0 * loads[3, i] / max(caps[3, i], 1e-9)
+                    ),
+                }
+            )
+        return {"brokers": out, "modelGeneration": str(gen)}
+
+    def partition_load(self, max_entries: int = 100, resource: str = "CPU",
+                       topic: str = "") -> dict:
+        """Ref PARTITION_LOAD endpoint: partitions sorted by the requested
+        resource's utilization, optionally filtered by topic regex."""
+        import re as _re
+
+        from ccx.common.resources import Resource
+
+        try:
+            res = Resource[resource.upper()]
+        except KeyError:
+            raise UserRequestException(
+                f"Unknown resource {resource!r}; one of "
+                f"{[r.name for r in Resource]}"
+            ) from None
+        model, metadata, gen = self._model()
+        import numpy as np
+
+        lead = np.asarray(model.leader_load)  # [RES, P]
+        valid = np.asarray(model.partition_valid).copy()
+        if topic:
+            rx = _re.compile(topic)
+            for i, info in enumerate(metadata.partitions):
+                if not rx.fullmatch(info.tp.topic):
+                    valid[i] = False
+        order = np.argsort(-lead[res] * valid)[:max_entries]
+        records = []
+        for p in order:
+            if not valid[p]:
+                continue
+            info = metadata.partitions[int(p)]
+            records.append(
+                {
+                    "topic": info.tp.topic,
+                    "partition": info.tp.partition,
+                    "leader": info.leader,
+                    "followers": [b for b in info.replicas if b != info.leader],
+                    "cpu": float(lead[0, p]),
+                    "networkInbound": float(lead[1, p]),
+                    "networkOutbound": float(lead[2, p]),
+                    "disk": float(lead[3, p]),
+                }
+            )
+        return {"records": records}
+
+    # ----- admin verbs ------------------------------------------------------
+
+    def pause_sampling(self, reason: str = "") -> dict:
+        self.load_monitor.pause_sampling(reason or "paused by user")
+        return {"message": "Sampling paused"}
+
+    def resume_sampling(self, reason: str = "") -> dict:
+        self.load_monitor.resume_sampling()
+        return {"message": "Sampling resumed"}
+
+    def stop_proposal_execution(self) -> dict:
+        self.executor.stop_execution()
+        return {"message": "Execution stop requested"}
+
+    # ----- internals --------------------------------------------------------
+
+    def _broker_health_metrics(self) -> dict[int, dict[str, float]]:
+        """Latest broker-window metrics for the concurrency adjuster (C26)."""
+        md = self.admin.describe_cluster()
+        agg = self.load_monitor.broker_aggregator.aggregate(len(md.brokers))
+        if agg.num_windows == 0:
+            return {}
+        urp_id = BROKER_METRIC_DEF.metric_info("UNDER_REPLICATED_PARTITIONS").id
+        out = {}
+        for i, b in enumerate(md.brokers):
+            out[b.broker_id] = {
+                "UNDER_REPLICATED_PARTITIONS": float(agg.values[i, -1, urp_id])
+            }
+        return out
+
+
+def _restrict_destinations(model, metadata, destination_broker_ids):
+    """Ref destination_broker_ids parameter: only the listed brokers may
+    receive replicas during this operation."""
+    if not destination_broker_ids:
+        return model
+    import numpy as np
+
+    bidx = metadata.broker_index()
+    allowed = np.zeros(model.B, bool)
+    for b in destination_broker_ids:
+        if b in bidx:
+            allowed[bidx[b]] = True
+    excl = np.asarray(model.broker_valid) & ~allowed
+    return model.replace(
+        broker_excl_replicas=model.broker_excl_replicas | excl
+    )
+
+
+def _count_by_broker(md, leaders: bool) -> dict[str, int]:
+    counts: dict[str, int] = {str(b.broker_id): 0 for b in md.brokers}
+    for p in md.partitions:
+        if leaders:
+            if p.leader >= 0:
+                counts[str(p.leader)] = counts.get(str(p.leader), 0) + 1
+        else:
+            for b in p.replicas:
+                counts[str(b)] = counts.get(str(b), 0) + 1
+    return counts
+
+
+def _identity_metadata(md):
+    """Metadata whose dense broker index == broker id is unnecessary for
+    proposals already carrying real ids; tasks_from_proposals resolves via
+    metadata.brokers order, so build a shim mapping dense idx -> same id."""
+    import dataclasses as _dc
+
+    from ccx.common.metadata import BrokerInfo, ClusterMetadata
+
+    max_id = max((b.broker_id for b in md.brokers), default=0)
+    brokers = []
+    real = {b.broker_id: b for b in md.brokers}
+    for i in range(max_id + 1):
+        brokers.append(real.get(i, BrokerInfo(i, "", alive=False)))
+    return ClusterMetadata(md.generation, tuple(brokers), md.partitions)

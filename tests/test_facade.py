@@ -1,0 +1,268 @@
+"""Façade + async-layer tests (ref C21-C22, C31-C32)."""
+
+import numpy as np
+import pytest
+
+from ccx.common.exceptions import UserRequestException
+from ccx.config import CruiseControlConfig
+from ccx.executor.admin import SimulatedAdminClient, SimulatedCluster
+from ccx.service.async_ops import OperationProgress, TaskState, UserTaskManager
+from ccx.service.facade import CruiseControl
+
+
+def sim_cluster(n_brokers=4, partitions=8, rf=2, skewed=False):
+    sim = SimulatedCluster()
+    for b in range(n_brokers):
+        sim.add_broker(b, rack=f"r{b % 2}", num_disks=2)
+    sim.create_topic("t0", partitions, rf, size_mb=10)
+    if skewed:
+        for part in sim._partitions.values():
+            part.replicas = [0, 1][:rf]
+            part.leader = 0
+            part.dirs = [0] * rf
+        sim._generation += 1
+    return sim
+
+
+def make_cc(tmp_path, sim=None, **extra):
+    sim = sim or sim_cluster()
+    props = {
+        "metric.sampler.class": "ccx.monitor.sampling.sampler.SyntheticMetricSampler",
+        "broker.capacity.config.resolver.class": "ccx.monitor.capacity.StaticCapacityResolver",
+        "sample.store.dir": str(tmp_path / "samples"),
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "broker.metrics.window.ms": 1000,
+        "num.broker.metrics.windows": 3,
+        "metric.sampling.interval.ms": 1000,
+        "execution.progress.check.interval.ms": 50,
+        "optimizer.num.chains": 8,
+        "optimizer.num.steps": 300,
+        "proposal.expiration.ms": 1_000_000,
+    }
+    props.update(extra)
+    cfg = CruiseControlConfig(props)
+    clock = {"now": 0}
+    admin = SimulatedAdminClient(sim)
+    cc = CruiseControl(
+        cfg, admin=admin, clock=lambda: clock["now"],
+        executor_waiter=lambda ms: sim.tick(int(ms)),
+    )
+    cc.start_up(run_background_threads=False)
+    for _ in range(5):
+        clock["now"] += 1000
+        cc.load_monitor.sample_once()
+    return cc, sim, clock
+
+
+def test_rebalance_dryrun_and_execute(tmp_path):
+    cc, sim, clock = make_cc(tmp_path, sim_cluster(skewed=True))
+    dry = cc.rebalance(dryrun=True, reason="test")
+    assert dry["dryRun"] and dry["numReplicaMovements"] > 0
+    assert "executionStarted" not in dry
+    wet = cc.rebalance(dryrun=False, reason="test")
+    assert wet["executionStarted"]
+    cc.executor.await_completion()
+    # replicas actually spread
+    per_broker = {b: 0 for b in range(4)}
+    for p in sim._partitions.values():
+        for b in p.replicas:
+            per_broker[b] += 1
+    assert max(per_broker.values()) - min(per_broker.values()) <= 2
+
+
+def test_rebalance_rejects_unknown_goal(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    with pytest.raises(UserRequestException):
+        cc.rebalance(goals=["NoSuchGoal"])
+
+
+def test_remove_brokers_evacuates(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    res = cc.remove_brokers((3,), dryrun=False, reason="decommission")
+    cc.executor.await_completion()
+    hosts = {b for p in sim._partitions.values() for b in p.replicas}
+    assert 3 not in hosts
+    assert res["verified"]
+
+
+def test_add_brokers_moves_load_onto_new(tmp_path):
+    sim = sim_cluster(n_brokers=3, partitions=9, rf=1)
+    sim.add_broker(3, rack="r1")  # fresh broker, no replicas
+    sim._generation += 1
+    cc, _, clock = make_cc(tmp_path, sim)
+    res = cc.add_brokers((3,), dryrun=False, reason="scale out")
+    cc.executor.await_completion()
+    count3 = sum(1 for p in sim._partitions.values() if 3 in p.replicas)
+    assert count3 > 0
+    # no replica moved onto a non-new broker
+    for prop in res["proposals"]:
+        gained = set(prop["newReplicas"]) - set(prop["oldReplicas"])
+        assert gained <= {3}
+
+
+def test_demote_brokers_sheds_leadership(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    res = cc.demote_brokers((0,), dryrun=False, reason="maintenance")
+    cc.executor.await_completion()
+    leaders = {p.leader for p in sim._partitions.values()}
+    assert 0 not in leaders
+    # demotion only moves leadership, never replicas
+    for prop in res["proposals"]:
+        assert sorted(prop["oldReplicas"]) == sorted(prop["newReplicas"])
+
+
+def test_fix_offline_replicas(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    sim.kill_broker(2)
+    clock["now"] += 1000
+    cc.load_monitor.sample_once()
+    res = cc.fix_offline_replicas(dryrun=False, reason="broker died")
+    cc.executor.await_completion()
+    hosts = {b for p in sim._partitions.values() for b in p.replicas}
+    assert 2 not in hosts
+
+
+def test_proposals_cache(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    p1 = cc.proposals()
+    assert p1["fromCache"] is False
+    p2 = cc.proposals()
+    assert p2["fromCache"] is True
+    clock["now"] += 2_000_000  # past proposal.expiration.ms
+    p3 = cc.proposals()
+    assert p3["fromCache"] is False
+
+
+def test_state_and_reads(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    st = cc.state()
+    assert st["MonitorState"]["state"] == "RUNNING"
+    assert st["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+    assert st["AnalyzerState"]["backend"] == "tpu"
+    assert "AnomalyDetectorState" in st
+    sub = cc.state(("monitor",))
+    assert "ExecutorState" not in sub
+
+    ks = cc.kafka_cluster_state()["KafkaBrokerState"]
+    assert ks["Summary"]["Brokers"] == 4
+    assert sum(ks["ReplicaCountByBrokerId"].values()) == 16
+
+    load = cc.load()
+    assert len(load["brokers"]) == 4
+    assert all(b["Replicas"] >= 0 for b in load["brokers"])
+
+    pl = cc.partition_load(max_entries=5)
+    assert len(pl["records"]) == 5
+    cpus = [r["cpu"] for r in pl["records"]]
+    assert cpus == sorted(cpus, reverse=True)
+
+
+def test_update_topic_configuration_rf_change(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    res = cc.update_topic_configuration({"t0": 3}, dryrun=False, reason="rf up")
+    cc.executor.await_completion()
+    for p in sim._partitions.values():
+        assert len(p.replicas) == 3
+        assert len(set(p.replicas)) == 3
+
+
+def test_rightsize_endpoint(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    rec = cc.rightsize()
+    assert rec["status"] in ("RIGHT_SIZED", "OVER_PROVISIONED",
+                             "UNDER_PROVISIONED")
+
+
+def test_greedy_backend_selection(tmp_path):
+    cc, sim, clock = make_cc(
+        tmp_path, sim_cluster(skewed=True),
+        **{"goal.optimizer.backend": "greedy"},
+    )
+    res = cc.rebalance(dryrun=True)
+    assert res["numReplicaMovements"] > 0
+
+
+def test_self_healing_end_to_end(tmp_path):
+    """Broker dies -> detector grace -> auto-fix actually evacuates it
+    (catches the dryrun-default trap: fixes must execute, not dry-run)."""
+    cc, sim, clock = make_cc(
+        tmp_path,
+        **{
+            "self.healing.enabled": "true",
+            "broker.failure.alert.threshold.ms": 1000,
+            "broker.failure.self.healing.threshold.ms": 2000,
+        },
+    )
+    sim.kill_broker(3)
+    cc.anomaly_detector.run_once()          # inside grace: CHECK
+    hosts = {b for p in sim._partitions.values() for b in p.replicas}
+    assert 3 in hosts
+    clock["now"] += 5000                    # past the self-healing threshold
+    decisions = cc.anomaly_detector.run_once()
+    fix = [d for d in decisions if d["action"] == "FIX"]
+    assert fix and fix[0]["selfHealingStarted"]
+    cc.executor.await_completion()
+    hosts = {b for p in sim._partitions.values() for b in p.replicas}
+    assert 3 not in hosts                   # actually healed, not dry-run
+    assert cc.anomaly_detector.state()["numSelfHealingStarted"] >= 1
+
+
+def test_destination_broker_restriction(tmp_path):
+    cc, sim, clock = make_cc(tmp_path)
+    res = cc.remove_brokers((0,), dryrun=True, destination_brokers=(1,))
+    for prop in res["proposals"]:
+        gained = set(prop["newReplicas"]) - set(prop["oldReplicas"])
+        assert gained <= {1}
+
+
+def test_user_task_manager_lifecycle():
+    clock = {"now": 0}
+    utm = UserTaskManager(max_active_tasks=2, completed_retention_ms=10_000,
+                          clock=lambda: clock["now"])
+    import threading
+
+    gate = threading.Event()
+
+    def slow(progress):
+        progress.step("working")
+        gate.wait(5)
+        return {"ok": True}
+
+    t1 = utm.submit("REBALANCE", slow, "/rebalance")
+    t2 = utm.submit("PROPOSALS", slow, "/proposals")
+    assert t1.state == TaskState.ACTIVE
+    with pytest.raises(RuntimeError, match="active user tasks"):
+        utm.submit("STATE", slow)
+    gate.set()
+    assert t1.future.result(timeout=5) == {"ok": True}
+    assert t1.state == TaskState.COMPLETED
+    assert utm.get(t1.task_id) is t1
+    assert len(utm.tasks()) == 2
+    assert len(utm.tasks(states=(TaskState.COMPLETED,))) == 2
+    # retention expiry
+    clock["now"] += 20_000
+    assert utm.tasks() == []
+
+
+def test_user_task_error_capture():
+    utm = UserTaskManager()
+
+    def boom(progress):
+        raise ValueError("bad params")
+
+    t = utm.submit("REBALANCE", boom)
+    with pytest.raises(ValueError):
+        t.future.result(timeout=5)
+    assert t.state == TaskState.COMPLETED_WITH_ERROR
+    assert "bad params" in t.to_json()["ErrorMessage"]
+
+
+def test_operation_progress_steps():
+    p = OperationProgress()
+    p.step("a")
+    p.step("b")
+    p.done()
+    steps = p.to_json()
+    assert [s["step"] for s in steps] == ["a", "b"]
+    assert all("timeToFinishSec" in s for s in steps)

@@ -1,0 +1,331 @@
+"""REST API tests (ref C32-C34: KafkaCruiseControlServletEndpointTest,
+UserTaskManagerTest, purgatory/security tests) — real HTTP against an
+in-process server over the simulated cluster."""
+
+import base64
+import http.client
+import json
+
+import pytest
+
+from ccx.config import CruiseControlConfig
+from ccx.executor.admin import SimulatedAdminClient, SimulatedCluster
+from ccx.servlet.endpoints import EndPoint, parse_params
+from ccx.servlet.security import (
+    BasicSecurityProvider,
+    JwtSecurityProvider,
+    TrustedProxySecurityProvider,
+    authorized,
+)
+from ccx.servlet.server import CruiseControlApp
+from ccx.service.facade import CruiseControl
+from ccx.common.exceptions import UserRequestException
+
+
+def sim_cluster(n_brokers=4, partitions=8, rf=2):
+    sim = SimulatedCluster()
+    for b in range(n_brokers):
+        sim.add_broker(b, rack=f"r{b % 2}")
+    sim.create_topic("t0", partitions, rf, size_mb=10)
+    return sim
+
+
+@pytest.fixture(scope="module")
+def server():
+    """One server for the module: requests are cheap, boot is not."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp()
+    sim = sim_cluster()
+    cfg = CruiseControlConfig({
+        "metric.sampler.class": "ccx.monitor.sampling.sampler.SyntheticMetricSampler",
+        "broker.capacity.config.resolver.class": "ccx.monitor.capacity.StaticCapacityResolver",
+        "sample.store.dir": f"{tmp}/samples",
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "broker.metrics.window.ms": 1000,
+        "num.broker.metrics.windows": 3,
+        "metric.sampling.interval.ms": 1000,
+        "execution.progress.check.interval.ms": 20,
+        "optimizer.num.chains": 4,
+        "optimizer.num.steps": 100,
+        "webserver.http.port": 0,           # ephemeral
+        "webserver.request.maxBlockTimeMs": 30_000,
+        "two.step.verification.enabled": "true",
+    })
+    clock = {"now": 0}
+    admin = SimulatedAdminClient(sim)
+    cc = CruiseControl(cfg, admin=admin, clock=lambda: clock["now"],
+                       executor_waiter=lambda ms: sim.tick(int(ms)))
+    cc.start_up(run_background_threads=False)
+    for _ in range(5):
+        clock["now"] += 1000
+        cc.load_monitor.sample_once()
+    app = CruiseControlApp(cfg, cc, clock=lambda: clock["now"])
+    host, port = app.start()
+    yield {"host": host, "port": port, "cc": cc, "sim": sim, "clock": clock}
+    app.stop()
+    cc.shutdown()
+
+
+def request(server, method, path, headers=None):
+    conn = http.client.HTTPConnection(server["host"], server["port"], timeout=60)
+    try:
+        conn.request(method, path, headers=headers or {})
+        resp = conn.getresponse()
+        body = json.loads(resp.read() or b"{}")
+        return resp.status, body, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def test_state_endpoint(server):
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/state")
+    assert status == 200
+    assert body["MonitorState"]["state"] in ("RUNNING", "PAUSED")
+    assert body["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+    status, body, _ = request(
+        server, "GET", "/kafkacruisecontrol/state?substates=monitor"
+    )
+    assert "ExecutorState" not in body
+
+
+def test_kafka_cluster_state_endpoint(server):
+    status, body, _ = request(
+        server, "GET", "/kafkacruisecontrol/kafka_cluster_state"
+    )
+    assert status == 200
+    assert body["KafkaBrokerState"]["Summary"]["Brokers"] == 4
+
+
+def test_load_endpoints(server):
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/load")
+    assert status == 200 and len(body["brokers"]) == 4
+    status, body, _ = request(
+        server, "GET",
+        "/kafkacruisecontrol/partition_load?max_load_entries=3",
+    )
+    assert status == 200 and len(body["records"]) == 3
+
+
+def test_proposals_endpoint(server):
+    status, body, hdrs = request(server, "GET", "/kafkacruisecontrol/proposals")
+    assert status == 200
+    assert "goalSummary" in body
+    assert "User-Task-ID" in hdrs
+
+
+def test_dryrun_rebalance_via_http(server):
+    status, body, _ = request(
+        server, "POST", "/kafkacruisecontrol/rebalance?dryrun=true"
+    )
+    assert status == 200
+    assert body["dryRun"] is True
+
+
+def test_unknown_endpoint_and_param_errors(server):
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/nope")
+    assert status == 404
+    status, body, _ = request(
+        server, "GET", "/kafkacruisecontrol/state?bogus=1"
+    )
+    assert status == 400
+    assert "Unrecognized parameter" in body["errorMessage"]
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/rebalance")
+    assert status == 405
+    status, body, _ = request(server, "POST", "/wrongprefix/state")
+    assert status == 404
+
+
+def test_user_tasks_endpoint(server):
+    request(server, "GET", "/kafkacruisecontrol/proposals")
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/user_tasks")
+    assert status == 200
+    assert body["userTasks"]
+    entry = body["userTasks"][0]
+    assert {"UserTaskId", "Endpoint", "Status", "Progress"} <= set(entry)
+
+
+def test_two_step_review_flow(server):
+    # non-dryrun mutating POST parks in purgatory
+    status, body, _ = request(
+        server, "POST",
+        "/kafkacruisecontrol/remove_broker?brokerid=3&dryrun=false",
+    )
+    assert status == 200
+    rid = body["RequestInfo"]["Id"]
+    assert body["RequestInfo"]["Status"] == "PENDING_REVIEW"
+    # visible on the review board
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/review_board")
+    assert any(r["Id"] == rid for r in body["RequestInfo"])
+    # approve, then resubmit with review_id
+    status, body, _ = request(
+        server, "POST", f"/kafkacruisecontrol/review?approve={rid}"
+    )
+    assert status == 200
+    status, body, _ = request(
+        server, "POST",
+        f"/kafkacruisecontrol/remove_broker?brokerid=3&dryrun=false&review_id={rid}",
+    )
+    assert status == 200
+    server["cc"].executor.await_completion()
+    hosts = {b for p in server["sim"]._partitions.values() for b in p.replicas}
+    assert 3 not in hosts
+    # replaying the same review id is rejected
+    status, body, _ = request(
+        server, "POST",
+        f"/kafkacruisecontrol/remove_broker?brokerid=3&dryrun=false&review_id={rid}",
+    )
+    assert status == 400
+
+
+def test_admin_endpoint_toggles(server):
+    status, body, _ = request(
+        server, "POST",
+        "/kafkacruisecontrol/admin?enable_self_healing_for=broker_failure",
+    )
+    assert status == 200
+    st = server["cc"].anomaly_detector.state()
+    assert st["selfHealingEnabled"]["BROKER_FAILURE"] is True
+    status, body, _ = request(
+        server, "POST",
+        "/kafkacruisecontrol/admin?disable_self_healing_for=broker_failure"
+        "&concurrent_partition_movements_per_broker=9",
+    )
+    assert body["concurrentPartitionMovementsPerBroker"] == 9
+    assert server["cc"].executor.caps.per_broker_inter == 9
+
+
+def test_pause_resume_sampling_endpoints(server):
+    status, body, _ = request(
+        server, "POST", "/kafkacruisecontrol/pause_sampling?reason=test"
+    )
+    assert status == 200
+    assert server["cc"].load_monitor.state()["state"] == "PAUSED"
+    request(server, "POST", "/kafkacruisecontrol/resume_sampling")
+    assert server["cc"].load_monitor.state()["state"] == "RUNNING"
+
+
+def test_permissions_endpoint(server):
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/permissions")
+    assert status == 200
+    assert body["roles"] == ["ADMIN"]  # security disabled -> anonymous admin
+
+
+# ----- security unit tests (no server) -------------------------------------
+
+def test_basic_security_provider(tmp_path):
+    creds = tmp_path / "creds"
+    creds.write_text("alice: secret,ADMIN\nbob: hunter2,VIEWER\n")
+    p = BasicSecurityProvider(str(creds))
+
+    def hdr(user, pw):
+        tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+        return {"authorization": f"Basic {tok}"}
+
+    ok = p.authenticate(hdr("alice", "secret"))
+    assert ok.ok and ok.roles == {"ADMIN"}
+    assert authorized(ok.roles, EndPoint.REBALANCE)
+    view = p.authenticate(hdr("bob", "hunter2"))
+    assert view.ok and not authorized(view.roles, EndPoint.REBALANCE)
+    assert authorized(view.roles, EndPoint.STATE)
+    bad = p.authenticate(hdr("alice", "wrong"))
+    assert not bad.ok and bad.challenge.startswith("Basic")
+    assert not p.authenticate({}).ok
+
+
+def test_jwt_security_provider():
+    p = JwtSecurityProvider(secret="s3cret")
+    token = p.issue("carol", {"USER"})
+    ok = p.authenticate({"authorization": f"Bearer {token}"})
+    assert ok.ok and ok.principal == "carol" and ok.roles == {"USER"}
+    assert authorized(ok.roles, EndPoint.USER_TASKS)
+    assert not authorized(ok.roles, EndPoint.ADMIN)
+    tampered = token[:-4] + "AAAA"
+    assert not p.authenticate({"authorization": f"Bearer {tampered}"}).ok
+
+
+def test_trusted_proxy_provider():
+    p = TrustedProxySecurityProvider(
+        trusted_proxies=("10.0.0.1",), admin_principals=("ops",)
+    )
+    peer = {"x-ccx-peer-address": "10.0.0.1"}
+    ok = p.authenticate({**peer, "x-forwarded-principal": "ops"})
+    assert ok.ok and "ADMIN" in ok.roles
+    user = p.authenticate({**peer, "x-forwarded-principal": "dev"})
+    assert user.ok and user.roles == {"USER"}
+    # spoofed header from an untrusted peer is rejected
+    spoof = p.authenticate(
+        {"x-ccx-peer-address": "6.6.6.6", "x-forwarded-principal": "ops"}
+    )
+    assert not spoof.ok
+    assert not p.authenticate(peer).ok  # no principal header
+
+
+def test_jwt_empty_secret_fails_closed():
+    p = JwtSecurityProvider(secret="")
+    # even a token HMAC'd with an empty key must not verify
+    forged = JwtSecurityProvider(secret="").issue("x", {"ADMIN"})
+    assert not p.authenticate({"authorization": f"Bearer {forged}"}).ok
+
+
+def test_param_parsing_types():
+    params = parse_params(
+        EndPoint.REMOVE_BROKER,
+        {"brokerid": "1,2,3", "dryrun": "false", "reason": "x"},
+    )
+    assert params["brokerid"] == (1, 2, 3)
+    assert params["dryrun"] is False
+    with pytest.raises(UserRequestException):
+        parse_params(EndPoint.REMOVE_BROKER, {"brokerid": "a,b"})
+
+
+def test_http_auth_enforced(tmp_path):
+    """Server with basic auth on: 401 without creds, 403 for viewer POST."""
+    creds = tmp_path / "creds"
+    creds.write_text("admin: pw,ADMIN\nro: pw,VIEWER\n")
+    sim = sim_cluster()
+    cfg = CruiseControlConfig({
+        "metric.sampler.class": "ccx.monitor.sampling.sampler.SyntheticMetricSampler",
+        "broker.capacity.config.resolver.class": "ccx.monitor.capacity.StaticCapacityResolver",
+        "sample.store.dir": str(tmp_path / "samples"),
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "metric.sampling.interval.ms": 1000,
+        "webserver.http.port": 0,
+        "webserver.security.enable": "true",
+        "webserver.security.provider": "ccx.servlet.security.BasicSecurityProvider",
+        "webserver.auth.credentials.file": str(creds),
+    })
+    clock = {"now": 0}
+    cc = CruiseControl(cfg, admin=SimulatedAdminClient(sim),
+                       clock=lambda: clock["now"])
+    cc.start_up(run_background_threads=False)
+    app = CruiseControlApp(cfg, cc, clock=lambda: clock["now"])
+    host, port = app.start()
+    srv = {"host": host, "port": port}
+    try:
+        status, _, hdrs = request(srv, "GET", "/kafkacruisecontrol/state")
+        assert status == 401
+        assert "WWW-Authenticate" in hdrs
+
+        def basic(user):
+            tok = base64.b64encode(f"{user}:pw".encode()).decode()
+            return {"Authorization": f"Basic {tok}"}
+
+        status, _, _ = request(srv, "GET", "/kafkacruisecontrol/state",
+                               headers=basic("ro"))
+        assert status == 200
+        status, _, _ = request(
+            srv, "POST", "/kafkacruisecontrol/pause_sampling",
+            headers=basic("ro"),
+        )
+        assert status == 403
+        status, _, _ = request(
+            srv, "POST", "/kafkacruisecontrol/pause_sampling",
+            headers=basic("admin"),
+        )
+        assert status == 200
+    finally:
+        app.stop()
+        cc.shutdown()
