@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Launch the ccx service (ref M6 kafka-cruise-control-start.sh).
+# Usage: ./ccx-start.sh [config/cruisecontrol.properties] [port] [address]
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python -m ccx "${@}"

@@ -1,0 +1,5 @@
+"""Python REST client + cccli (ref M4/C38)."""
+
+from ccx.client.client import CruiseControlClient, CruiseControlClientError
+
+__all__ = ["CruiseControlClient", "CruiseControlClientError"]

@@ -1,0 +1,3 @@
+from ccx.client.cli import main
+
+raise SystemExit(main())

@@ -385,9 +385,31 @@ class CruiseControlConfig:
         self.originals = dict(props or {})
         self._values = self.definition.parse(self.originals)
 
+    #: file-valued keys resolved relative to the properties file's directory
+    PATH_KEYS = (
+        "capacity.config.file",
+        "cluster.configs.file",
+        "webserver.auth.credentials.file",
+        "failed.brokers.file.path",
+    )
+
     @classmethod
     def from_properties_file(cls, path: str) -> "CruiseControlConfig":
-        return cls(load_properties(path))
+        import os
+
+        props = load_properties(path)
+        base = os.path.dirname(os.path.abspath(path))
+        for key in cls.PATH_KEYS:
+            v = props.get(key)
+            if v and not os.path.isabs(v):
+                # Relative paths in a properties file mean "relative to the
+                # file", not to whatever cwd the service was launched from.
+                candidate = os.path.normpath(os.path.join(base, v))
+                parent = os.path.normpath(os.path.join(base, "..", v))
+                props[key] = candidate if os.path.exists(candidate) else (
+                    parent if os.path.exists(parent) else candidate
+                )
+        return cls(props)
 
     def __getitem__(self, key: str) -> Any:
         try:

@@ -45,7 +45,6 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    props = {}
     if argv:
         cfg = CruiseControlConfig.from_properties_file(argv[0])
     else:

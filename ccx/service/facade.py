@@ -318,7 +318,6 @@ class CruiseControl:
         metadata = self.admin.describe_cluster()
         from ccx.proposals import ExecutionProposal
 
-        bidx = metadata.broker_index()
         alive = metadata.alive_broker_ids()
         rack_of = {b.broker_id: b.rack for b in metadata.brokers}
         load = {b.broker_id: 0 for b in metadata.brokers}

@@ -177,11 +177,16 @@ class JwtSecurityProvider(SecurityProvider):
     def _b64url(data: bytes) -> bytes:
         return base64.urlsafe_b64encode(data).rstrip(b"=")
 
-    def issue(self, subject: str, roles: set[str]) -> str:
+    def issue(self, subject: str, roles: set[str],
+              expires_at_s: int | None = None,
+              not_before_s: int | None = None) -> str:
+        claims: dict = {"sub": subject, "roles": sorted(roles)}
+        if expires_at_s is not None:
+            claims["exp"] = expires_at_s
+        if not_before_s is not None:
+            claims["nbf"] = not_before_s
         header = self._b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
-        payload = self._b64url(
-            json.dumps({"sub": subject, "roles": sorted(roles)}).encode()
-        )
+        payload = self._b64url(json.dumps(claims).encode())
         sig = self._b64url(
             hmac.new(self.secret, header + b"." + payload, hashlib.sha256).digest()
         )
@@ -208,6 +213,13 @@ class JwtSecurityProvider(SecurityProvider):
             claims = json.loads(base64.urlsafe_b64decode(payload_b + pad))
         except (ValueError, binascii.Error):
             return AuthResult(False, challenge="Bearer")
+        import time as _time
+
+        now_s = _time.time()
+        if "exp" in claims and now_s >= float(claims["exp"]):
+            return AuthResult(False, challenge='Bearer error="token expired"')
+        if "nbf" in claims and now_s < float(claims["nbf"]):
+            return AuthResult(False, challenge='Bearer error="token not yet valid"')
         return AuthResult(
             True, claims.get("sub", ""), set(claims.get("roles", []))
         )

@@ -308,6 +308,23 @@ def _make_handler(app: CruiseControlApp):
 
         def _dispatch(self, method: str) -> None:
             try:
+                # Drain the request body first — with HTTP/1.1 keep-alive an
+                # unread body would be parsed as the next request line.
+                # Urlencoded form bodies merge into the query parameters
+                # (the reference accepts parameters either way).
+                body_params: dict[str, str] = {}
+                length = int(self.headers.get("Content-Length") or 0)
+                if length > 0:
+                    raw = self.rfile.read(length)
+                    ctype = (self.headers.get("Content-Type") or "").lower()
+                    if "application/x-www-form-urlencoded" in ctype:
+                        body_params = {
+                            k: v[-1]
+                            for k, v in urllib.parse.parse_qs(
+                                raw.decode(errors="replace"),
+                                keep_blank_values=True,
+                            ).items()
+                        }
                 parsed = urllib.parse.urlparse(self.path)
                 if not parsed.path.startswith(URL_PREFIX + "/"):
                     self._send(404, {"errorMessage": f"Unknown path {parsed.path}"})
@@ -351,6 +368,7 @@ def _make_handler(app: CruiseControlApp):
                         parsed.query, keep_blank_values=True
                     ).items()
                 }
+                query = {**body_params, **query}
                 params = parse_params(endpoint, query)
                 status, body, extra = app.handle(
                     method, endpoint, params, headers,

@@ -1,0 +1,89 @@
+"""Documentation generator — config + REST references from the source of
+truth (ref M7 docs/wiki: Configurations / REST API pages).
+
+Run ``python -m ccx.tools.gen_docs`` to regenerate ``docs/wiki/``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ccx.config.configs import cruise_control_config_def
+from ccx.servlet.endpoints import (
+    GET_ENDPOINTS,
+    PARAMETERS,
+    EndPoint,
+)
+
+
+def gen_config_reference() -> str:
+    rows = cruise_control_config_def().doc_table()
+    out = [
+        "# Configurations",
+        "",
+        "Generated from `ccx/config/configs.py` (do not edit by hand; run "
+        "`python -m ccx.tools.gen_docs`). Key names follow the reference's "
+        "`cruisecontrol.properties` vocabulary.",
+        "",
+        "| Name | Type | Default | Importance | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rows:
+        default = r["default"]
+        if isinstance(default, tuple):
+            default = ",".join(str(x) for x in default) or "(empty)"
+        default = "" if default is None else str(default)
+        if len(default) > 60:
+            default = default[:57] + "..."
+        out.append(
+            f"| `{r['name']}` | {r['type']} | {default or '—'} "
+            f"| {r['importance']} | {r['doc']} |"
+        )
+    return "\n".join(out) + "\n"
+
+
+def gen_rest_reference() -> str:
+    out = [
+        "# REST API",
+        "",
+        "Generated from `ccx/servlet/endpoints.py`. All endpoints live under "
+        "`/kafkacruisecontrol/<endpoint>` and return JSON. Requests that "
+        "exceed `webserver.request.maxBlockTimeMs` return **202** with a "
+        "`User-Task-ID` header — repeat the request with that header (or "
+        "poll `user_tasks`) until **200**. With "
+        "`two.step.verification.enabled`, non-dryrun mutating POSTs park in "
+        "the purgatory and must be approved via `review`, then re-submitted "
+        "with `review_id`.",
+        "",
+    ]
+    for ep in EndPoint:
+        method = "GET" if ep in GET_ENDPOINTS else "POST"
+        out.append(f"## {method} `/kafkacruisecontrol/{ep.value}`")
+        out.append("")
+        out.append("| Parameter | Type | Default |")
+        out.append("|---|---|---|")
+        for spec in PARAMETERS[ep]:
+            default = spec.default
+            if isinstance(default, tuple):
+                default = ",".join(map(str, default)) or "(empty)"
+            out.append(
+                f"| `{spec.name}` | {spec.type.value} "
+                f"| {default if default is not None else '—'} |"
+            )
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def main() -> None:
+    root = os.path.join(os.path.dirname(__file__), "..", "..")
+    wiki = os.path.normpath(os.path.join(root, "docs", "wiki"))
+    os.makedirs(wiki, exist_ok=True)
+    with open(os.path.join(wiki, "Configurations.md"), "w") as f:
+        f.write(gen_config_reference())
+    with open(os.path.join(wiki, "REST-API.md"), "w") as f:
+        f.write(gen_rest_reference())
+    print(f"wrote {wiki}/Configurations.md and {wiki}/REST-API.md")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Python client tests (ref M4/C38) — cccli against a live in-process server."""
+
+import json
+
+import pytest
+
+from ccx.client.cli import main as cli_main
+from ccx.client.client import CruiseControlClient, CruiseControlClientError
+from ccx.config import CruiseControlConfig
+from ccx.executor.admin import SimulatedAdminClient, SimulatedCluster
+from ccx.servlet.server import CruiseControlApp
+from ccx.service.facade import CruiseControl
+
+
+@pytest.fixture(scope="module")
+def server():
+    import tempfile
+
+    tmp = tempfile.mkdtemp()
+    sim = SimulatedCluster()
+    for b in range(4):
+        sim.add_broker(b, rack=f"r{b % 2}")
+    sim.create_topic("t0", 8, 2, size_mb=10)
+    cfg = CruiseControlConfig({
+        "metric.sampler.class": "ccx.monitor.sampling.sampler.SyntheticMetricSampler",
+        "broker.capacity.config.resolver.class": "ccx.monitor.capacity.StaticCapacityResolver",
+        "sample.store.dir": f"{tmp}/samples",
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "broker.metrics.window.ms": 1000,
+        "num.broker.metrics.windows": 3,
+        "metric.sampling.interval.ms": 1000,
+        "execution.progress.check.interval.ms": 20,
+        "optimizer.num.chains": 4,
+        "optimizer.num.steps": 100,
+        "webserver.http.port": 0,
+        "webserver.request.maxBlockTimeMs": 500,  # force 202 + long-poll path
+    })
+    clock = {"now": 0}
+    cc = CruiseControl(cfg, admin=SimulatedAdminClient(sim),
+                       clock=lambda: clock["now"],
+                       executor_waiter=lambda ms: sim.tick(int(ms)))
+    cc.start_up(run_background_threads=False)
+    for _ in range(5):
+        clock["now"] += 1000
+        cc.load_monitor.sample_once()
+    app = CruiseControlApp(cfg, cc, clock=lambda: clock["now"])
+    host, port = app.start()
+    yield f"http://{host}:{port}"
+    app.stop()
+    cc.shutdown()
+
+
+def test_client_reads(server):
+    c = CruiseControlClient(server)
+    st = c.state(("monitor",))
+    assert st["MonitorState"]["state"] == "RUNNING"
+    assert len(c.load()["brokers"]) == 4
+    assert c.kafka_cluster_state()["KafkaBrokerState"]["Summary"]["Brokers"] == 4
+    assert c.permissions()["roles"] == ["ADMIN"]
+
+
+def test_client_long_polls_async_operation(server):
+    """maxBlockTimeMs=500 forces the 202 path; the client must poll the
+    User-Task-ID to completion (the reference client's retry loop)."""
+    c = CruiseControlClient(server, poll_interval_s=0.2)
+    res = c.rebalance(dryrun=True)
+    assert res["dryRun"] is True
+    assert "goalSummary" in res
+    assert res["userTaskId"]
+    tasks = c.user_tasks()["userTasks"]
+    assert any(t["UserTaskId"] == res["userTaskId"] for t in tasks)
+
+
+def test_client_error_surfaces(server):
+    c = CruiseControlClient(server)
+    with pytest.raises(CruiseControlClientError) as e:
+        c.call("GET", "state", {"bogus": 1})
+    assert e.value.status == 400
+
+
+def test_cli_state_and_rebalance(server, capsys):
+    rc = cli_main(["state", "-a", server, "--raw"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["MonitorState"]["state"] == "RUNNING"
+
+    rc = cli_main(["rebalance", "-a", server, "--dryrun", "--raw"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["dryRun"] is True
+
+    rc = cli_main(["user-tasks", "-a", server, "--raw"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["userTasks"]
+
+
+def test_cli_error_exit_code(server, capsys):
+    rc = cli_main(["topic-configuration", "", "3", "-a", server, "--raw"])
+    assert rc == 1
+    err = json.loads(capsys.readouterr().err)
+    assert "errorMessage" in err

@@ -262,6 +262,44 @@ def test_trusted_proxy_provider():
     assert not p.authenticate(peer).ok  # no principal header
 
 
+def test_jwt_exp_nbf_validation():
+    import time
+
+    p = JwtSecurityProvider(secret="s3cret")
+    expired = p.issue("x", {"ADMIN"}, expires_at_s=int(time.time()) - 10)
+    assert not p.authenticate({"authorization": f"Bearer {expired}"}).ok
+    future = p.issue("x", {"ADMIN"}, not_before_s=int(time.time()) + 3600)
+    assert not p.authenticate({"authorization": f"Bearer {future}"}).ok
+    live = p.issue("x", {"ADMIN"}, expires_at_s=int(time.time()) + 3600)
+    assert p.authenticate({"authorization": f"Bearer {live}"}).ok
+
+
+def test_keepalive_post_with_body(server):
+    """A POST body must be drained: the same keep-alive connection serves a
+    follow-up request correctly (urlencoded bodies merge into params)."""
+    conn = http.client.HTTPConnection(server["host"], server["port"], timeout=30)
+    try:
+        body = "reason=via-body"
+        conn.request(
+            "POST", "/kafkacruisecontrol/pause_sampling", body=body,
+            headers={"Content-Type": "application/x-www-form-urlencoded",
+                     "Content-Length": str(len(body))},
+        )
+        r1 = conn.getresponse()
+        assert r1.status == 200
+        r1.read()
+        # same connection, next request must parse cleanly
+        conn.request("GET", "/kafkacruisecontrol/state?substates=monitor")
+        r2 = conn.getresponse()
+        assert r2.status == 200
+        body2 = json.loads(r2.read())
+        assert body2["MonitorState"]["state"] == "PAUSED"
+        assert body2["MonitorState"]["reasonOfLatestPauseOrResume"] == "via-body"
+    finally:
+        conn.close()
+        request(server, "POST", "/kafkacruisecontrol/resume_sampling")
+
+
 def test_jwt_empty_secret_fails_closed():
     p = JwtSecurityProvider(secret="")
     # even a token HMAC'd with an empty key must not verify
