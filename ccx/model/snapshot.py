@@ -1,0 +1,185 @@
+"""Snapshot codec — the ClusterModel wire/file format.
+
+SURVEY.md §7.2: the tensor ClusterModel round-trips through a snapshot
+schema that is also the gRPC payload of the sidecar (JVM → TPU hop of the
+north star, BASELINE.json:5). Two encodings share one schema:
+
+* **JSON** — human-readable files for the CLI (`ccx propose --snapshot f.json`)
+  and fixtures; arrays as nested lists.
+* **msgpack** — the wire format: arrays as raw little-endian buffers with
+  dtype/shape headers (zero-copy into numpy), ~10x smaller/faster than JSON
+  at 100k partitions, where snapshot transfer is a real cost (SURVEY.md
+  §7.4 "snapshot transfer").
+
+Delta snapshots (``delta_encode``/``delta_apply``) send only changed fields
+keyed by the base generation — the mitigation SURVEY.md prescribes for
+repeated 100k-partition transfers over DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+import numpy as np
+
+from ccx.model.tensor_model import TensorClusterModel, build_model
+
+#: fields build_model accepts directly (arrays); kept in one place so the
+#: codec, delta logic, and proto schema stay aligned
+ARRAY_FIELDS = (
+    "assignment",
+    "leader_slot",
+    "replica_disk",
+    "partition_topic",
+    "partition_immovable",
+    "leader_load",
+    "follower_load",
+    "broker_capacity",
+    "broker_rack",
+    "broker_alive",
+    "broker_new",
+    "broker_excl_replicas",
+    "broker_excl_leadership",
+    "disk_capacity",
+    "disk_alive",
+    "topic_min_leaders",
+)
+
+SCHEMA_VERSION = 1
+
+
+def model_to_arrays(m: TensorClusterModel, strip_padding: bool = True) -> dict[str, Any]:
+    """Dense (unpadded) numpy views of a model, build_model-compatible."""
+    valid_p = np.asarray(m.partition_valid)
+    valid_b = np.asarray(m.broker_valid)
+    P = int(valid_p.sum())
+    B = int(valid_b.sum())
+    if not strip_padding:
+        P, B = m.P, m.B
+
+    def arr(name: str) -> np.ndarray:
+        return np.asarray(getattr(m, name))
+
+    out: dict[str, Any] = {
+        "version": SCHEMA_VERSION,
+        "num_racks": m.num_racks,
+        "assignment": arr("assignment")[:P],
+        "leader_slot": arr("leader_slot")[:P],
+        "replica_disk": arr("replica_disk")[:P],
+        "partition_topic": arr("partition_topic")[:P],
+        "partition_immovable": arr("partition_immovable")[:P],
+        "leader_load": arr("leader_load")[:, :P],
+        "follower_load": arr("follower_load")[:, :P],
+        "broker_capacity": arr("broker_capacity")[:, :B],
+        "broker_rack": arr("broker_rack")[:B],
+        "broker_alive": arr("broker_alive")[:B],
+        "broker_new": arr("broker_new")[:B],
+        "broker_excl_replicas": arr("broker_excl_replicas")[:B],
+        "broker_excl_leadership": arr("broker_excl_leadership")[:B],
+        "disk_capacity": arr("disk_capacity")[:B],
+        "disk_alive": arr("disk_alive")[:B],
+        "topic_min_leaders": arr("topic_min_leaders"),
+    }
+    return out
+
+
+def arrays_to_model(d: dict[str, Any], pad: bool = True) -> TensorClusterModel:
+    if d.get("version", 1) > SCHEMA_VERSION:
+        raise ValueError(f"unsupported snapshot version {d['version']}")
+    kwargs = {k: np.asarray(d[k]) for k in ARRAY_FIELDS if k in d}
+    return build_model(num_racks=d.get("num_racks"), pad=pad, **kwargs)
+
+
+# ----- JSON ----------------------------------------------------------------
+
+def to_json(m: TensorClusterModel) -> str:
+    d = model_to_arrays(m)
+    enc = {
+        k: (v.tolist() if isinstance(v, np.ndarray) else v) for k, v in d.items()
+    }
+    return json.dumps(enc)
+
+
+def from_json(s: str) -> TensorClusterModel:
+    return arrays_to_model(json.loads(s))
+
+
+# ----- msgpack (wire) ------------------------------------------------------
+
+def _pack_array(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    if a.dtype == np.bool_:
+        a = a.astype(np.uint8)
+    if a.dtype == np.int64:
+        a = a.astype(np.int32)
+    if a.dtype == np.float64:
+        a = a.astype(np.float32)
+    return {"d": a.dtype.str, "s": list(a.shape), "b": a.tobytes()}
+
+
+def _unpack_array(d: dict) -> np.ndarray:
+    a = np.frombuffer(d["b"], dtype=np.dtype(d["d"])).reshape(d["s"])
+    if a.dtype == np.uint8 and d.get("bool"):
+        return a.astype(bool)
+    return a
+
+
+_BOOL_FIELDS = {
+    "partition_immovable", "broker_alive", "broker_new",
+    "broker_excl_replicas", "broker_excl_leadership", "disk_alive",
+    "topic_min_leaders",
+}
+
+
+def to_msgpack(m: TensorClusterModel) -> bytes:
+    import msgpack
+
+    d = model_to_arrays(m)
+    enc: dict[str, Any] = {}
+    for k, v in d.items():
+        if isinstance(v, np.ndarray):
+            p = _pack_array(v)
+            if k in _BOOL_FIELDS:
+                p["bool"] = True
+            enc[k] = p
+        else:
+            enc[k] = v
+    return msgpack.packb(enc, use_bin_type=True)
+
+
+def from_msgpack(buf: bytes) -> TensorClusterModel:
+    d = decode_msgpack(buf)
+    return arrays_to_model(d)
+
+
+def decode_msgpack(buf: bytes) -> dict[str, Any]:
+    import msgpack
+
+    raw = msgpack.unpackb(buf, raw=False)
+    out: dict[str, Any] = {}
+    for k, v in raw.items():
+        out[k] = _unpack_array(v) if isinstance(v, dict) and "b" in v else v
+    return out
+
+
+# ----- deltas (generation-keyed) -------------------------------------------
+
+def delta_encode(base: dict[str, Any], new: dict[str, Any]) -> dict[str, Any]:
+    """Fields of ``new`` that differ from ``base`` (plus scalars)."""
+    out: dict[str, Any] = {"version": new.get("version", SCHEMA_VERSION),
+                           "num_racks": new.get("num_racks")}
+    for k in ARRAY_FIELDS:
+        if k not in new:
+            continue
+        a, b = base.get(k), new[k]
+        if a is None or np.asarray(a).shape != np.asarray(b).shape or not np.array_equal(a, b):
+            out[k] = b
+    return out
+
+
+def delta_apply(base: dict[str, Any], delta: dict[str, Any]) -> dict[str, Any]:
+    out = dict(base)
+    out.update({k: v for k, v in delta.items() if v is not None})
+    return out

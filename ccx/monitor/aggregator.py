@@ -217,16 +217,24 @@ class MetricSampleAggregator:
             if not ok.any():
                 return 0
             e, s, t, m = entity_ids[ok], slot[ok], times_ms[ok], metrics[ok]
-            np.add.at(self._sum, (e, s), m)
-            np.maximum.at(self._max, (e, s), m)
-            np.add.at(self._count, (e, s), 1)
-            # LATEST: keep the newest-timestamped sample per (entity, slot).
+            # Rows sorted ascending by time: required for last-write-wins
+            # LATEST semantics in both the native and numpy paths.
             order = np.argsort(t, kind="stable")
-            eo, so, to = e[order], s[order], t[order]
-            newer = to >= self._latest_t[eo, so]
-            # later duplicates in the same batch overwrite — last write wins
-            self._latest[eo[newer], so[newer]] = m[order][newer]
-            self._latest_t[eo[newer], so[newer]] = to[newer]
+            e, s, t, m = e[order], s[order], t[order], m[order]
+            from ccx import native
+
+            if not native.scatter(
+                self._sum, self._max, self._latest, self._latest_t,
+                self._count, e, s, t, m,
+            ):
+                np.add.at(self._sum, (e, s), m)
+                np.maximum.at(self._max, (e, s), m)
+                np.add.at(self._count, (e, s), 1)
+                newer = t >= self._latest_t[e, s]
+                # later duplicates in the same batch overwrite — sorted order
+                # makes fancy-assignment's last-occurrence the newest sample
+                self._latest[e[newer], s[newer]] = m[newer]
+                self._latest_t[e[newer], s[newer]] = t[newer]
             return int(ok.sum())
 
     def add_sample(self, entity_id: int, time_ms: int, metrics) -> bool:

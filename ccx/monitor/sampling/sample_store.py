@@ -107,6 +107,21 @@ class FileSampleStore(SampleStore):
                  if isinstance(s, BrokerMetricSample)],
             )
 
+    def load_broker_samples(self) -> list[BrokerMetricSample]:
+        with self._lock:
+            return [s for s in self._read(self.BROKER_LOG)
+                    if isinstance(s, BrokerMetricSample)]
+
+    def raw_partition_log(self) -> bytes:
+        """Raw log bytes for the native columnar decoder (warm-start fast
+        path; see ccx.native.decode_partition_samples)."""
+        with self._lock:
+            path = self._path(self.PARTITION_LOG)
+            if not os.path.exists(path):
+                return b""
+            with open(path, "rb") as f:
+                return f.read()
+
     def evict_before(self, partition_before_ms: int,
                      broker_before_ms: int | None = None) -> None:
         if broker_before_ms is None:

@@ -1,0 +1,12 @@
+"""TPU optimizer sidecar: gRPC service + client + CLI (north star bridge).
+
+Only wire-contract constants live here so the remote client
+(``ccx.sidecar.client``) stays importable without the jax/optimizer stack.
+"""
+
+SERVICE = "ccx.sidecar.OptimizerService"
+
+
+def identity(b: bytes) -> bytes:
+    """Byte-identity (de)serializer — payloads are msgpack end to end."""
+    return b

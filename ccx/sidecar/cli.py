@@ -1,0 +1,61 @@
+"""ccx-propose — one-shot proposal computation from a snapshot file.
+
+``python -m ccx.sidecar.cli --snapshot cluster.json`` runs the optimizer
+locally (in-process); ``--address host:port`` sends it to a running sidecar
+instead (SURVEY.md §7.2 step 5 CLI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ccx-propose", description=__doc__)
+    ap.add_argument("--snapshot", required=True,
+                    help="cluster snapshot (.json per ccx/model/snapshot.py)")
+    ap.add_argument("--address", help="sidecar host:port (default: in-process)")
+    ap.add_argument("--goals", default="", help="comma-separated goal names")
+    ap.add_argument("--chains", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=3000)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args(argv)
+
+    from ccx.model.snapshot import from_json
+
+    with open(args.snapshot, encoding="utf-8") as f:
+        model = from_json(f.read())
+    goals = tuple(g.strip() for g in args.goals.split(",") if g.strip())
+
+    if args.address:
+        from ccx.sidecar.client import SidecarClient
+
+        client = SidecarClient(args.address)
+        out = client.propose(
+            model, goals=goals, chains=args.chains, steps=args.steps,
+            seed=args.seed,
+            on_progress=lambda s: print(f"[progress] {s}", file=sys.stderr),
+        )
+    else:
+        from ccx.goals.base import GoalConfig
+        from ccx.goals.stack import DEFAULT_GOAL_ORDER
+        from ccx.optimizer import OptimizeOptions, optimize
+        from ccx.search.annealer import AnnealOptions
+
+        names = goals or DEFAULT_GOAL_ORDER
+        if "StructuralFeasibility" not in names:
+            names = ("StructuralFeasibility",) + tuple(names)
+        res = optimize(
+            model, GoalConfig(), names,
+            OptimizeOptions(anneal=AnnealOptions(
+                n_chains=args.chains, n_steps=args.steps, seed=args.seed)),
+        )
+        out = res.to_json()
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,82 @@
+"""Sidecar client — the JVM bridge's reference implementation.
+
+Mirrors what the JVM-side ``goal.optimizer.backend=tpu`` strategy does
+(SURVEY.md §0 north star): serialize the cluster snapshot, stream progress,
+collect the ``OptimizerResult``. Used by tests, the ``ccx-propose`` CLI, and
+as executable documentation of the wire contract in ``optimizer.proto``.
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+from ccx.sidecar import SERVICE, identity as _identity
+
+# NOTE: ccx.model.snapshot (and with it jax) is imported lazily inside the
+# methods that take a model object — a remote-only client (ping, session
+# reuse) must work on machines without the TPU stack.
+
+
+class SidecarClient:
+    def __init__(self, address: str) -> None:
+        import grpc
+
+        self.channel = grpc.insecure_channel(address)
+        self._propose = self.channel.unary_stream(
+            f"/{SERVICE}/Propose",
+            request_serializer=_identity, response_deserializer=_identity,
+        )
+        self._put = self.channel.unary_unary(
+            f"/{SERVICE}/PutSnapshot",
+            request_serializer=_identity, response_deserializer=_identity,
+        )
+        self._ping = self.channel.unary_unary(
+            f"/{SERVICE}/Ping",
+            request_serializer=_identity, response_deserializer=_identity,
+        )
+
+    def ping(self) -> dict:
+        return msgpack.unpackb(self._ping(msgpack.packb({})), raw=False)
+
+    def put_snapshot(self, model, session: str, generation: int,
+                     is_delta: bool = False, base_generation: int | None = None,
+                     packed: bytes | None = None) -> dict:
+        payload = {
+            "session": session,
+            "generation": generation,
+            "packed": packed if packed is not None else _pack_model(model),
+            "is_delta": is_delta,
+        }
+        if base_generation is not None:
+            payload["base_generation"] = base_generation
+        return msgpack.unpackb(self._put(msgpack.packb(payload)), raw=False)
+
+    def propose(self, model=None, session: str | None = None,
+                goals: tuple[str, ...] = (), on_progress=None,
+                **options) -> dict:
+        req: dict = {"goals": list(goals), "options": options}
+        if model is not None:
+            req["snapshot"] = _pack_model(model)
+        if session is not None:
+            req["session"] = session
+        result: dict | None = None
+        for raw in self._propose(msgpack.packb(req)):
+            update = msgpack.unpackb(raw, raw=False)
+            if "progress" in update and on_progress:
+                on_progress(update["progress"])
+            if "error" in update:
+                raise RuntimeError(update["error"])
+            if "result" in update:
+                result = update["result"]
+        if result is None:
+            raise RuntimeError("stream ended without a result")
+        return result
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+def _pack_model(model) -> bytes:
+    from ccx.model.snapshot import to_msgpack
+
+    return to_msgpack(model)

@@ -1,0 +1,200 @@
+"""The TPU optimizer sidecar — gRPC service.
+
+North star (BASELINE.json:5, SURVEY.md §0): the JVM keeps LoadMonitor /
+Executor / REST; the analyzer hop becomes ``goal.optimizer.backend=tpu`` →
+gRPC to this sidecar: snapshot up, proposals + per-goal stats down, progress
+streamed so the JVM can feed its ``OperationProgress``.
+
+Implementation notes: the wire methods are registered with
+``grpc.GenericRpcHandler`` and byte-identity serializers, so no protoc
+codegen is required on the Python side; payloads are msgpack (see
+``optimizer.proto`` for the JVM-side contract and ``ccx/model/snapshot.py``
+for the tensor schema). Delta snapshots are cached per session keyed by
+generation (SURVEY.md §7.4 snapshot-transfer mitigation).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+
+import msgpack
+
+from ccx import __version__
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER
+from ccx.model.snapshot import (
+    arrays_to_model,
+    decode_msgpack,
+    delta_apply,
+)
+from ccx.optimizer import OptimizeOptions, optimize
+from ccx.search.annealer import AnnealOptions
+from ccx.search.greedy import GreedyOptions
+from ccx.sidecar import SERVICE, identity as _identity
+
+log = logging.getLogger(__name__)
+
+
+class OptimizerSidecar:
+    """Method implementations (transport-independent, tested directly)."""
+
+    def __init__(self, goal_config: GoalConfig | None = None) -> None:
+        self.goal_config = goal_config or GoalConfig()
+        self._snapshots: dict[str, tuple[int, dict]] = {}
+        self._lock = threading.Lock()
+
+    # ----- PutSnapshot ------------------------------------------------------
+
+    def put_snapshot(self, request: bytes) -> bytes:
+        req = msgpack.unpackb(request, raw=False)
+        session = req.get("session", "")
+        generation = int(req.get("generation", 0))
+        packed = req["packed"]
+        arrays = decode_msgpack(packed)
+        with self._lock:
+            if req.get("is_delta"):
+                base = self._snapshots.get(session)
+                if base is None:
+                    raise ValueError(f"no base snapshot for session {session!r}")
+                base_gen = req.get("base_generation")
+                if base_gen is not None and int(base_gen) != base[0]:
+                    # A delta against the wrong base would build a cluster
+                    # state that never existed — reject so the client
+                    # re-sends a full snapshot.
+                    raise ValueError(
+                        f"delta base generation {base_gen} does not match "
+                        f"cached generation {base[0]} for session {session!r}"
+                    )
+                arrays = delta_apply(base[1], arrays)
+            self._snapshots[session] = (generation, arrays)
+        return msgpack.packb({"generation": generation})
+
+    # ----- Propose ----------------------------------------------------------
+
+    def propose(self, request: bytes):
+        """Generator: progress dicts, then the final result dict."""
+        req = msgpack.unpackb(request, raw=False)
+        yield {"progress": "Decoding snapshot"}
+        if req.get("snapshot") is not None:
+            arrays = decode_msgpack(req["snapshot"])
+        else:
+            session = req.get("session", "")
+            # Read, validate, apply, and store under ONE lock acquisition so
+            # concurrent deltas for a session cannot silently drop updates.
+            with self._lock:
+                entry = self._snapshots.get(session)
+                if entry is None:
+                    raise ValueError(f"no snapshot for session {session!r}")
+                if req.get("delta") is not None:
+                    base_gen = req.get("base_generation")
+                    if base_gen is not None and int(base_gen) != entry[0]:
+                        raise ValueError(
+                            f"delta base generation {base_gen} does not "
+                            f"match cached generation {entry[0]} for "
+                            f"session {session!r}"
+                        )
+                    arrays = delta_apply(entry[1], decode_msgpack(req["delta"]))
+                    self._snapshots[session] = (
+                        int(req.get("generation", entry[0] + 1)), arrays
+                    )
+                else:
+                    arrays = entry[1]
+        model = arrays_to_model(arrays)
+
+        goals = tuple(req.get("goals") or ()) or DEFAULT_GOAL_ORDER
+        unknown = [g for g in goals if g not in GOAL_REGISTRY]
+        if unknown:
+            raise ValueError(f"unknown goals: {unknown}")
+        if "StructuralFeasibility" not in goals:
+            goals = ("StructuralFeasibility",) + tuple(goals)
+        o = req.get("options") or {}
+        opts = OptimizeOptions(
+            anneal=AnnealOptions(
+                n_chains=int(o.get("chains", 32)),
+                n_steps=int(o.get("steps", 3000)),
+                seed=int(o.get("seed", 42)),
+            ),
+            polish=GreedyOptions(
+                n_candidates=int(o.get("polish_candidates", 256)),
+                max_iters=int(o.get("polish_max_iters", 400)),
+            ),
+            check_evacuation=bool(o.get("check_evacuation", True)),
+        )
+        yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}
+        res = optimize(model, self.goal_config, goals, opts)
+        yield {"progress": "Diff + verification done"}
+        yield {"result": res.to_json()}
+
+    def ping(self, request: bytes) -> bytes:
+        import jax
+
+        return msgpack.packb({
+            "version": __version__,
+            "backend": jax.default_backend(),
+            "num_devices": jax.device_count(),
+        })
+
+
+def make_grpc_server(sidecar: OptimizerSidecar | None = None,
+                     address: str = "127.0.0.1:0", max_workers: int = 4):
+    """Returns (grpc server, bound port)."""
+    import grpc
+
+    sidecar = sidecar or OptimizerSidecar()
+
+    def unary(fn):
+        def handler(request: bytes, context):
+            try:
+                return fn(request)
+            except Exception as e:  # noqa: BLE001 — RPC boundary
+                log.exception("rpc failed")
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return handler
+
+    def propose_stream(request: bytes, context):
+        try:
+            for update in sidecar.propose(request):
+                yield msgpack.packb(update)
+        except Exception as e:  # noqa: BLE001
+            log.exception("propose failed")
+            yield msgpack.packb({"error": str(e)})
+
+    method_handlers = {
+        "Propose": grpc.unary_stream_rpc_method_handler(
+            propose_stream, request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "PutSnapshot": grpc.unary_unary_rpc_method_handler(
+            unary(sidecar.put_snapshot), request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+        "Ping": grpc.unary_unary_rpc_method_handler(
+            unary(sidecar.ping), request_deserializer=_identity,
+            response_serializer=_identity,
+        ),
+    }
+    handler = grpc.method_handlers_generic_handler(SERVICE, method_handlers)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((handler,))
+    port = server.add_insecure_port(address)
+    return server, port
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="ccx TPU optimizer sidecar")
+    ap.add_argument("--address", default="127.0.0.1:50051")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server, port = make_grpc_server(address=args.address)
+    server.start()
+    log.info("optimizer sidecar listening on port %s", port)
+    server.wait_for_termination()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,153 @@
+"""Sidecar + snapshot codec tests (north star: JVM <-> TPU gRPC hop)."""
+
+import numpy as np
+import pytest
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import evaluate_stack
+from ccx.model.fixtures import RandomClusterSpec, random_cluster, small_deterministic
+from ccx.model.snapshot import (
+    delta_apply,
+    delta_encode,
+    from_json,
+    from_msgpack,
+    model_to_arrays,
+    to_json,
+    to_msgpack,
+)
+from ccx.sidecar.server import OptimizerSidecar, make_grpc_server
+
+
+def models_equal(a, b) -> bool:
+    da, db = model_to_arrays(a), model_to_arrays(b)
+    for k, v in da.items():
+        if isinstance(v, np.ndarray):
+            if not np.array_equal(np.asarray(v), np.asarray(db[k])):
+                return False
+        elif v != db[k]:
+            return False
+    return True
+
+
+@pytest.fixture(scope="module")
+def model():
+    return random_cluster(RandomClusterSpec(
+        n_brokers=6, n_racks=3, n_topics=3, n_partitions=32, seed=5
+    ))
+
+
+def test_json_roundtrip(model):
+    assert models_equal(model, from_json(to_json(model)))
+
+
+def test_msgpack_roundtrip(model):
+    m2 = from_msgpack(to_msgpack(model))
+    assert models_equal(model, m2)
+    # scoring the restored model gives identical results
+    s1 = evaluate_stack(model, GoalConfig())
+    s2 = evaluate_stack(m2, GoalConfig())
+    np.testing.assert_allclose(np.asarray(s1.costs), np.asarray(s2.costs),
+                               rtol=1e-6)
+
+
+def test_msgpack_much_smaller_than_json():
+    # at realistic scale the binary arrays beat JSON decimal text handily
+    big = random_cluster(RandomClusterSpec(
+        n_brokers=32, n_racks=4, n_topics=8, n_partitions=2048, seed=0
+    ))
+    assert len(to_msgpack(big)) < len(to_json(big).encode()) / 2
+
+
+def test_delta_roundtrip(model):
+    base = model_to_arrays(model)
+    new = dict(base)
+    new["leader_slot"] = base["leader_slot"].copy()
+    new["leader_slot"][0] = (base["leader_slot"][0] + 1) % 2
+    delta = delta_encode(base, new)
+    # only the changed array (plus scalars) rides the wire
+    changed = [k for k, v in delta.items() if isinstance(v, np.ndarray)]
+    assert changed == ["leader_slot"]
+    restored = delta_apply(base, delta)
+    assert np.array_equal(restored["leader_slot"], new["leader_slot"])
+
+
+def test_sidecar_propose_inprocess():
+    sidecar = OptimizerSidecar()
+    import msgpack
+
+    m = small_deterministic()
+    from ccx.model.snapshot import to_msgpack as pack
+
+    req = msgpack.packb({
+        "snapshot": pack(m),
+        "goals": [],
+        "options": {"chains": 4, "steps": 50},
+    })
+    updates = list(sidecar.propose(req))
+    progress = [u["progress"] for u in updates if "progress" in u]
+    results = [u["result"] for u in updates if "result" in u]
+    assert progress and len(results) == 1
+    assert "proposals" in results[0] and "goalSummary" in results[0]
+
+
+def test_sidecar_session_and_delta():
+    import msgpack
+
+    sidecar = OptimizerSidecar()
+    m = small_deterministic()
+    from ccx.model.snapshot import to_msgpack as pack
+
+    ack = sidecar.put_snapshot(msgpack.packb({
+        "session": "jvm-1", "generation": 7, "packed": pack(m),
+    }))
+    assert msgpack.unpackb(ack, raw=False)["generation"] == 7
+    # propose against the cached session snapshot (no snapshot in request)
+    req = msgpack.packb({
+        "session": "jvm-1", "goals": [], "options": {"chains": 2, "steps": 20},
+    })
+    results = [u for u in sidecar.propose(req) if "result" in u]
+    assert results
+    with pytest.raises(ValueError, match="no snapshot"):
+        list(sidecar.propose(msgpack.packb({"session": "nope"})))
+
+
+def test_grpc_end_to_end(model):
+    """Full wire test: real gRPC server + client, progress streaming."""
+    grpc = pytest.importorskip("grpc")
+    from ccx.sidecar.client import SidecarClient
+
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        c = SidecarClient(f"127.0.0.1:{port}")
+        pong = c.ping()
+        assert pong["version"]
+        seen = []
+        out = c.propose(model, goals=("ReplicaDistributionGoal",),
+                        chains=4, steps=100, on_progress=seen.append)
+        assert seen, "no progress streamed"
+        assert "proposals" in out
+        assert out["verified"] in (True, False)
+        # session + reuse
+        c.put_snapshot(model, session="s1", generation=1)
+        out2 = c.propose(session="s1", goals=("ReplicaDistributionGoal",),
+                         chains=2, steps=20)
+        assert "proposals" in out2
+        c.close()
+    finally:
+        server.stop(0)
+
+
+def test_grpc_error_surfaces(model):
+    pytest.importorskip("grpc")
+    from ccx.sidecar.client import SidecarClient
+
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        c = SidecarClient(f"127.0.0.1:{port}")
+        with pytest.raises(RuntimeError, match="unknown goals"):
+            c.propose(model, goals=("NoSuchGoal",), chains=2, steps=10)
+        c.close()
+    finally:
+        server.stop(0)
