@@ -1,0 +1,124 @@
+"""Metrics registry — timers/meters/gauges with a Prometheus-text view.
+
+Parity: the reference exports Dropwizard ``MetricRegistry`` timers and
+meters over JMX domain ``kafka.cruisecontrol`` — e.g. GoalOptimizer's
+``proposal-computation-timer`` and per-endpoint servlet timers (SURVEY.md
+§5.1/§5.5). Python has no JMX; the idiomatic equivalent is a registry
+rendered in Prometheus text exposition format, which SURVEY.md §7.2 step 5
+prescribes for the rebuild.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Timer:
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+
+    def time(self):
+        registry_timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                registry_timer.update(time.monotonic() - self.t0)
+                return False
+
+        return _Ctx()
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class Counter:
+    def __init__(self) -> None:
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class MetricsRegistry:
+    """Process-wide named timers/counters/gauges (ref MetricRegistry)."""
+
+    def __init__(self, prefix: str = "ccx") -> None:
+        self.prefix = prefix
+        self._timers: dict[str, Timer] = {}
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, object] = {}  # name -> callable() -> float
+        self._lock = threading.Lock()
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str, fn) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of everything registered."""
+        out: list[str] = []
+
+        def sanitize(name: str) -> str:
+            return name.replace("-", "_").replace(".", "_").replace(" ", "_")
+
+        with self._lock:
+            timers = dict(self._timers)
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        for name, t in sorted(timers.items()):
+            n = f"{self.prefix}_{sanitize(name)}"
+            out.append(f"# TYPE {n}_seconds_total counter")
+            out.append(f"{n}_seconds_total {t.total_s:.6f}")
+            out.append(f"{n}_count {t.count}")
+            out.append(f"{n}_seconds_max {t.max_s:.6f}")
+        for name, c in sorted(counters.items()):
+            n = f"{self.prefix}_{sanitize(name)}"
+            out.append(f"# TYPE {n} counter")
+            out.append(f"{n} {c.value}")
+        for name, fn in sorted(gauges.items()):
+            n = f"{self.prefix}_{sanitize(name)}"
+            try:
+                v = float(fn())
+            except Exception:
+                continue
+            out.append(f"# TYPE {n} gauge")
+            out.append(f"{n} {v}")
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "timers": {
+                    k: {"count": t.count, "meanSec": t.mean_s, "maxSec": t.max_s}
+                    for k, t in self._timers.items()
+                },
+                "counters": {k: c.value for k, c in self._counters.items()},
+            }
+
+
+#: the process-wide default registry (ref: the app's single MetricRegistry)
+REGISTRY = MetricsRegistry()

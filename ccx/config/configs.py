@@ -98,6 +98,9 @@ def monitor_config_def() -> ConfigDef:
              Importance.HIGH, "Capacity file for the default resolver.")
     d.define("monitor.state.update.interval.ms", Type.LONG, 30_000, Importance.LOW,
              "Refresh period of cached monitor state.", at_least(1))
+    d.define("prometheus.server.endpoint", Type.STRING, "http://127.0.0.1:9090",
+             Importance.LOW, "Prometheus base URL for the "
+             "PrometheusMetricSampler (ref C10 alternative sampler).")
     d.define("leader.network.inbound.weight.for.cpu.util", Type.DOUBLE, 0.6,
              Importance.LOW, "ModelUtils leader NW_IN coefficient for CPU "
              "estimation (ref C6).", between(0, 10))

@@ -69,6 +69,53 @@ def follower_cpu_from_leader(params: CpuEstimationParams,
     return leader_cpu * ratio
 
 
+class LinearRegressionModelParameters:
+    """Parity: ``model/LinearRegressionModelParameters.java`` (SURVEY.md C6)
+    — the legacy ``train`` path fitting the CPU coefficients from observed
+    (broker CPU, NW_IN, NW_OUT) triples instead of using the static config
+    weights. Least-squares over accumulated samples; ``to_params`` emits a
+    ``CpuEstimationParams`` once enough observations arrived.
+    """
+
+    MIN_SAMPLES = 16
+
+    def __init__(self) -> None:
+        self._rows: list[tuple[float, float, float]] = []
+
+    def add_observation(self, broker_cpu: float, nw_in: float, nw_out: float) -> None:
+        self._rows.append((broker_cpu, nw_in, nw_out))
+
+    def add_broker_samples(self, agg_values: np.ndarray, cpu_id: int,
+                           in_id: int, out_id: int) -> None:
+        """Ingest from a broker AggregationResult values array [B, W, M]."""
+        v = agg_values.reshape(-1, agg_values.shape[-1])
+        for row in v:
+            if row[in_id] > 0 or row[out_id] > 0:
+                self.add_observation(row[cpu_id], row[in_id], row[out_id])
+
+    @property
+    def trainable(self) -> bool:
+        return len(self._rows) >= self.MIN_SAMPLES
+
+    def fit(self) -> tuple[float, float]:
+        """(nw_in_weight, nw_out_weight) such that cpu ~ a*in + b*out."""
+        if not self.trainable:
+            raise ValueError(
+                f"need >= {self.MIN_SAMPLES} observations, have {len(self._rows)}"
+            )
+        rows = np.asarray(self._rows)
+        coeffs, *_ = np.linalg.lstsq(rows[:, 1:], rows[:, 0], rcond=None)
+        return float(max(coeffs[0], 0.0)), float(max(coeffs[1], 0.0))
+
+    def to_params(self, follower_ratio: float = 0.5) -> CpuEstimationParams:
+        a, b = self.fit()
+        return CpuEstimationParams(
+            leader_nw_in_weight=a,
+            leader_nw_out_weight=b,
+            follower_nw_in_weight=follower_ratio * a,
+        )
+
+
 def split_roles(params: CpuEstimationParams,
                 leader_metrics: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """(leader_load, follower_load) float64[RES, P] from leader-side windowed

@@ -121,8 +121,16 @@ def make_goal_vector_fn(
     the incrementally-maintained sums.
     """
     part_idx = {n: i for i, n in enumerate(pt.PARTITION_GOALS)}
+    # KafkaAssignerEvenRackAwareGoal (SURVEY.md C19) decomposes into the
+    # incrementally-maintained RackAwareGoal sum + an aggregate-side
+    # leader-evenness term, so it is searchable without its own slot.
+    DECOMPOSED = {"KafkaAssignerEvenRackAwareGoal"}
     for name in goal_names:
-        if GOAL_REGISTRY[name].placement_dependent and name not in part_idx:
+        if (
+            GOAL_REGISTRY[name].placement_dependent
+            and name not in part_idx
+            and name not in DECOMPOSED
+        ):
             raise ValueError(
                 f"goal {name} reads per-partition placement but has no "
                 "incrementally-maintained sum; it cannot be searched "
@@ -142,6 +150,19 @@ def make_goal_vector_fn(
                 c = part_sums[part_idx[name]]
                 if name == "PreferredLeaderElectionGoal":
                     c = c * inv_np
+            elif name == "KafkaAssignerEvenRackAwareGoal":
+                # rack part from the incremental sum; leader-evenness from
+                # the live aggregates (same math as the full kernel)
+                alive = m.broker_valid & m.broker_alive
+                n_alive = jnp.maximum(jnp.sum(alive).astype(jnp.float32), 1.0)
+                avg = jnp.sum(agg.leader_count).astype(jnp.float32) / n_alive
+                upper = jnp.ceil(avg)
+                over = jnp.where(
+                    alive, jnp.maximum(agg.leader_count - upper, 0.0), 0.0
+                )
+                c = part_sums[part_idx["RackAwareGoal"]] + jnp.sum(over) / (
+                    jnp.maximum(avg, 1e-9)
+                )
             else:
                 c = GOAL_REGISTRY[name].fn(m, agg, cfg).cost
             costs.append(c)

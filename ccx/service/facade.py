@@ -17,7 +17,14 @@ from __future__ import annotations
 import threading
 import time as _time
 
+import logging
+
 from ccx.common.exceptions import UserRequestException
+from ccx.common.metrics import REGISTRY
+
+#: the reference's separate operations log (SURVEY.md §5.1: log4j
+#: `operationLogger` recording every request/decision)
+oplog = logging.getLogger("ccx.operationLogger")
 from ccx.detector.manager import AnomalyDetectorManager
 from ccx.detector.provisioner import BasicProvisioner
 from ccx.executor.admin import SimulatedAdminClient
@@ -140,6 +147,11 @@ class CruiseControl:
         backend = self.config["goal.optimizer.backend"]
         if progress:
             progress.step(f"Optimizing ({backend} backend, {len(goal_names)} goals)")
+        with REGISTRY.timer("proposal-computation").time():
+            return self._run_optimizer_timed(model, goal_names, opts, progress, backend)
+
+    def _run_optimizer_timed(self, model, goal_names, opts, progress,
+                             backend) -> OptimizerResult:
         if backend == "greedy":
             import time as _t
 
@@ -182,6 +194,13 @@ class CruiseControl:
     def _finish(self, res: OptimizerResult, metadata, dryrun: bool,
                 reason: str, uuid: str | None, progress=None,
                 replication_throttle=None) -> dict:
+        oplog.info(
+            "operation uuid=%s dryrun=%s reason=%r proposals=%d verified=%s "
+            "wall=%.3fs",
+            uuid, dryrun, reason, len(res.proposals), res.verification.ok,
+            res.wall_seconds,
+        )
+        REGISTRY.counter("operations" if dryrun else "executions").inc()
         out = res.to_json()
         out["dryRun"] = dryrun
         out["reason"] = reason
@@ -198,18 +217,30 @@ class CruiseControl:
 
     # ----- verbs (one per REST operation, ref C22) --------------------------
 
+    #: ref kafkaassigner-mode goal stack (SURVEY.md C19): the compatibility
+    #: mode mimicking the older kafka-assigner tool
+    KAFKA_ASSIGNER_GOALS = (
+        "KafkaAssignerEvenRackAwareGoal",
+        "KafkaAssignerDiskUsageDistributionGoal",
+    )
+
     def rebalance(self, goals=None, dryrun: bool = True, reason: str = "",
                   self_healing: bool = False, excluded_topics: str = "",
                   uuid: str | None = None, progress=None,
                   rebalance_disk: bool = False,
                   destination_brokers=(),
+                  kafka_assigner: bool = False,
+                  data_from: str = "VALID_WINDOWS",
                   replication_throttle=None) -> dict:
         if rebalance_disk:
             return self.rebalance_disk(
                 dryrun=dryrun, reason=reason, uuid=uuid, progress=progress
             )
+        if kafka_assigner and not goals:
+            goals = self.KAFKA_ASSIGNER_GOALS
         model, metadata, gen = self._model(
             ModelBuildOptions(excluded_topics_pattern=excluded_topics),
+            requirements=_requirements_for(data_from),
             progress=progress,
         )
         model = _restrict_destinations(model, metadata, destination_brokers)
@@ -564,6 +595,23 @@ class CruiseControl:
                 "UNDER_REPLICATED_PARTITIONS": float(agg.values[i, -1, urp_id])
             }
         return out
+
+
+def _requirements_for(data_from: str):
+    """Ref ``data_from`` parameter: VALID_WINDOWS (default — enough complete
+    windows) vs VALID_PARTITIONS (one window, nearly all partitions).
+    Invalid values are rejected like the reference's enum parse."""
+    from ccx.monitor.aggregator import ModelCompletenessRequirements
+
+    v = data_from.upper()
+    if v == "VALID_PARTITIONS":
+        return ModelCompletenessRequirements(1, 0.95)
+    if v == "VALID_WINDOWS":
+        return ModelCompletenessRequirements(1, 0.5)
+    raise UserRequestException(
+        f"Invalid data_from {data_from!r}; one of VALID_WINDOWS, "
+        "VALID_PARTITIONS"
+    )
 
 
 def _restrict_destinations(model, metadata, destination_broker_ids):

@@ -126,6 +126,8 @@ PARAMETERS: dict[EndPoint, tuple[ParamSpec, ...]] = {
     EndPoint.REBALANCE: _COMMON + _MUTATION + (
         ParamSpec("rebalance_disk", ParamType.BOOLEAN, False),
         ParamSpec("destination_broker_ids", ParamType.CSV_INT, ()),
+        ParamSpec("kafka_assigner", ParamType.BOOLEAN, False),
+        ParamSpec("data_from", ParamType.STRING, "VALID_WINDOWS"),
     ),
     EndPoint.ADD_BROKER: _COMMON + _MUTATION + (
         ParamSpec("brokerid", ParamType.CSV_INT, ()),

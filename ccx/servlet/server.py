@@ -259,6 +259,8 @@ class CruiseControlApp:
                 excluded_topics=params["excluded_topics"],
                 rebalance_disk=params["rebalance_disk"],
                 destination_brokers=params["destination_broker_ids"],
+                kafka_assigner=params["kafka_assigner"],
+                data_from=params["data_from"],
                 replication_throttle=params["replication_throttle"],
                 progress=progress, **common,
             )
@@ -326,6 +328,37 @@ def _make_handler(app: CruiseControlApp):
                             ).items()
                         }
                 parsed = urllib.parse.urlparse(self.path)
+                # non-JSON surfaces: dashboard (ref M5 ui) + Prometheus
+                # metrics (ref §5.1 JMX registry -> text exposition).
+                # Same authentication gate as the JSON endpoints.
+                is_ui = method == "GET" and parsed.path in ("/", "/ui", "/ui/")
+                is_metrics = (
+                    method == "GET" and parsed.path == URL_PREFIX + "/metrics"
+                )
+                if is_ui or is_metrics:
+                    hdrs = {k.lower(): v for k, v in self.headers.items()}
+                    hdrs["x-ccx-peer-address"] = self.client_address[0]
+                    auth = app.security.authenticate(hdrs)
+                    if not auth.ok:
+                        self._send(
+                            401, {"errorMessage": "Authentication required"},
+                            {"WWW-Authenticate": auth.challenge or "Basic"},
+                        )
+                        return
+                    if is_ui:
+                        from ccx.servlet.ui import PAGE
+
+                        self._send_raw(
+                            200, PAGE.encode(), "text/html; charset=utf-8"
+                        )
+                    else:
+                        from ccx.common.metrics import REGISTRY
+
+                        self._send_raw(
+                            200, REGISTRY.render_prometheus().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    return
                 if not parsed.path.startswith(URL_PREFIX + "/"):
                     self._send(404, {"errorMessage": f"Unknown path {parsed.path}"})
                     return
@@ -388,8 +421,12 @@ def _make_handler(app: CruiseControlApp):
 
         def _send(self, status: int, body: dict, extra: dict | None = None) -> None:
             payload = json.dumps({"version": 1, **body}).encode()
+            self._send_raw(status, payload, "application/json", extra)
+
+        def _send_raw(self, status: int, payload: bytes, content_type: str,
+                      extra: dict | None = None) -> None:
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(payload)))
             for k, v in (extra or {}).items():
                 self.send_header(k, v)

@@ -1,0 +1,72 @@
+"""Minimal operator dashboard.
+
+Parity: the reference serves ``cruise-control-ui`` (a Vue SPA, separate
+repo) from its web root (SURVEY.md M5). ccx ships a single-file dashboard —
+no build step, stdlib-served — that polls the same REST endpoints the UI
+uses (``state``, ``load``, ``kafka_cluster_state``) and renders cluster
+summary, per-broker load bars, monitor/executor/anomaly state.
+"""
+
+PAGE = """<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8"/>
+<title>ccx — cluster dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a22; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.6rem; }
+ table { border-collapse: collapse; margin-top: .5rem; }
+ td, th { padding: .25rem .7rem; border-bottom: 1px solid #e3e3ea;
+          text-align: right; font-variant-numeric: tabular-nums; }
+ th { text-align: left; } td:first-child { text-align: left; }
+ .bar { display:inline-block; height: .65rem; background:#5b7fff;
+        border-radius:2px; vertical-align: middle; }
+ .dead { color: #c0392b; font-weight: 600; }
+ .ok { color: #1e8e3e; } .muted { color:#777; font-size:.85rem; }
+ pre { background:#f6f6f9; padding: .7rem; border-radius:6px;
+       max-width: 72rem; overflow-x: auto; }
+</style></head><body>
+<h1>ccx — cluster dashboard</h1>
+<div class="muted" id="meta">loading…</div>
+<h2>Cluster</h2><div id="summary"></div>
+<h2>Broker load</h2><div id="load"></div>
+<h2>Service state</h2><pre id="state"></pre>
+<script>
+const J = (u) => fetch(u).then(r => r.json());
+async function refresh() {
+  try {
+    const [st, ks, ld] = await Promise.all([
+      J('/kafkacruisecontrol/state'),
+      J('/kafkacruisecontrol/kafka_cluster_state'),
+      J('/kafkacruisecontrol/load'),
+    ]);
+    const s = ks.KafkaBrokerState.Summary;
+    document.getElementById('meta').textContent =
+      'refreshed ' + new Date().toLocaleTimeString();
+    document.getElementById('summary').innerHTML =
+      `<table><tr><th>Brokers</th><th>Alive</th><th>Topics</th>
+       <th>Partitions</th><th>Replicas</th><th>URP</th></tr>
+       <tr><td>${s.Brokers}</td><td class="${s.AliveBrokers < s.Brokers ?
+       'dead' : 'ok'}">${s.AliveBrokers}</td><td>${s.Topics}</td>
+       <td>${s.Partitions}</td><td>${s.Replicas}</td>
+       <td class="${s.UnderReplicatedPartitions ? 'dead' : 'ok'}">
+       ${s.UnderReplicatedPartitions}</td></tr></table>`;
+    const maxDisk = Math.max(1, ...ld.brokers.map(b => b.DiskMB));
+    document.getElementById('load').innerHTML =
+      '<table><tr><th>Broker</th><th>Rack</th><th>State</th>' +
+      '<th>Replicas</th><th>Leaders</th><th>CPU%</th><th>NwIn</th>' +
+      '<th>NwOut</th><th>Disk MB</th><th></th></tr>' +
+      ld.brokers.map(b =>
+        `<tr><td>${b.Broker}</td><td>${b.Rack}</td>
+         <td class="${b.BrokerState === 'ALIVE' ? 'ok' : 'dead'}">${b.BrokerState}</td>
+         <td>${b.Replicas}</td><td>${b.Leaders}</td>
+         <td>${b.CpuPct.toFixed(1)}</td><td>${b.NwInRate.toFixed(0)}</td>
+         <td>${b.NwOutRate.toFixed(0)}</td><td>${b.DiskMB.toFixed(0)}</td>
+         <td><span class="bar" style="width:${120 * b.DiskMB / maxDisk}px"></span></td>
+         </tr>`).join('') + '</table>';
+    document.getElementById('state').textContent = JSON.stringify(st, null, 2);
+  } catch (e) {
+    document.getElementById('meta').textContent = 'error: ' + e;
+  }
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""

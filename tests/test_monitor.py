@@ -78,6 +78,26 @@ def test_split_roles_follower_semantics():
     assert follower[Resource.CPU, 0] < leader[Resource.CPU, 0]
 
 
+def test_linear_regression_cpu_training():
+    """Ref C6 legacy `train` path: recover known coefficients from data."""
+    from ccx.monitor.model_utils import LinearRegressionModelParameters
+
+    rng = np.random.default_rng(3)
+    true_a, true_b = 0.5, 0.2
+    lr = LinearRegressionModelParameters()
+    assert not lr.trainable
+    for _ in range(50):
+        nw_in, nw_out = rng.uniform(10, 100, 2)
+        lr.add_observation(true_a * nw_in + true_b * nw_out, nw_in, nw_out)
+    assert lr.trainable
+    a, b = lr.fit()
+    assert np.isclose(a, true_a, atol=1e-6)
+    assert np.isclose(b, true_b, atol=1e-6)
+    params = lr.to_params()
+    assert np.isclose(params.leader_nw_in_weight, true_a, atol=1e-6)
+    assert params.follower_nw_in_weight < params.leader_nw_in_weight
+
+
 def sim_cluster(n_brokers=4, n_partitions=8, rf=2):
     sim = SimulatedCluster()
     for b in range(n_brokers):

@@ -1,0 +1,120 @@
+"""PrometheusMetricSampler tests against a stub query_range API (ref C10)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+from ccx.executor.admin import SimulatedAdminClient, SimulatedCluster
+from ccx.monitor.sampling.prometheus_sampler import PrometheusMetricSampler
+
+
+class StubPrometheus(BaseHTTPRequestHandler):
+    """Serves canned series keyed on substrings of the PromQL query."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        q = parse_qs(urlparse(self.path).query)["query"][0]
+        start = float(parse_qs(urlparse(self.path).query)["start"][0])
+        ts = start
+        if "bytesin_total" in q and "sum by" not in q:
+            result = [
+                {"metric": {"topic": "t0", "partition": str(p),
+                            "instance": "broker-0:7071"},
+                 "values": [[ts, str(100.0 + p)]]}
+                for p in range(4)
+            ]
+        elif "bytesout_total" in q and "sum by" not in q:
+            result = [
+                {"metric": {"topic": "t0", "partition": str(p),
+                            "instance": "broker-0:7071"},
+                 "values": [[ts, str(200.0 + p)]]}
+                for p in range(4)
+            ]
+        elif "log_size" in q:
+            result = [
+                {"metric": {"topic": "t0", "partition": str(p)},
+                 "values": [[ts, str(500.0 + p)]]}
+                for p in range(4)
+            ]
+        elif "sum by" in q and "bytesin" in q:
+            result = [{"metric": {"instance": "broker-0:7071"},
+                       "values": [[ts, "800.0"]]},
+                      {"metric": {"instance": "broker-1:7071"},
+                       "values": [[ts, "100.0"]]}]
+        elif "sum by" in q and "bytesout" in q:
+            result = [{"metric": {"instance": "broker-0:7071"},
+                       "values": [[ts, "900.0"]]}]
+        elif "node_cpu" in q:
+            result = [{"metric": {"instance": "broker-0:7071"},
+                       "values": [[ts, "0.6"]]}]
+        elif "logflush" in q:
+            result = [{"metric": {"instance": "broker-0:7071"},
+                       "values": [[ts, "7.5"]]}]
+        else:
+            result = []
+        body = json.dumps(
+            {"status": "success", "data": {"result": result}}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def stub():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), StubPrometheus)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_prometheus_sampler_end_to_end(stub):
+    sim = SimulatedCluster()
+    for b in range(2):
+        sim.add_broker(b, rack="r0")
+    sim.create_topic("t0", 4, 1)
+    # put all leadership on broker 0 to match the stub's series
+    for part in sim._partitions.values():
+        part.replicas = [0]
+        part.leader = 0
+        part.dirs = [0]
+    metadata = SimulatedAdminClient(sim).describe_cluster()
+
+    sampler = PrometheusMetricSampler(endpoint=stub)
+    samples = sampler.get_samples(metadata, [0, 1, 2, 3], 60_000, 120_000)
+
+    assert len(samples.partition_samples) == 4
+    by_partition = {s.partition: s for s in samples.partition_samples}
+    s0 = by_partition[0]
+    assert s0.broker_id == 0
+    assert s0.metric(1) == 100.0      # NW_IN straight from the query
+    assert s0.metric(3) == 500.0      # DISK
+    # CPU apportioned from broker CPU by weighted network share
+    assert 0 < s0.metric(0) < 60.0
+
+    brokers = {s.broker_id for s in samples.broker_samples}
+    assert 0 in brokers
+    b0 = next(s for s in samples.broker_samples if s.broker_id == 0)
+    from ccx.monitor.metricdef import BROKER_METRIC_DEF
+
+    flush_id = BROKER_METRIC_DEF.metric_info("BROKER_LOG_FLUSH_TIME_MS_MEAN").id
+    assert b0.metric(flush_id) == 7.5
+
+
+def test_prometheus_sampler_respects_assignment(stub):
+    sim = SimulatedCluster()
+    sim.add_broker(0, rack="r0")
+    sim.create_topic("t0", 4, 1)
+    metadata = SimulatedAdminClient(sim).describe_cluster()
+    sampler = PrometheusMetricSampler(endpoint=stub)
+    samples = sampler.get_samples(metadata, [1, 2], 60_000, 120_000)
+    assert {s.partition for s in samples.partition_samples} == {1, 2}

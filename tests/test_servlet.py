@@ -206,6 +206,25 @@ def test_pause_resume_sampling_endpoints(server):
     assert server["cc"].load_monitor.state()["state"] == "RUNNING"
 
 
+def test_ui_and_metrics_surfaces(server):
+    conn = http.client.HTTPConnection(server["host"], server["port"], timeout=30)
+    try:
+        conn.request("GET", "/ui")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert "text/html" in r.getheader("Content-Type")
+        assert b"ccx" in r.read()
+        conn.request("GET", "/kafkacruisecontrol/metrics")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert "text/plain" in r.getheader("Content-Type")
+        text = r.read().decode()
+        # the rebalance tests above exercised the optimizer timer
+        assert "ccx_proposal_computation" in text
+    finally:
+        conn.close()
+
+
 def test_permissions_endpoint(server):
     status, body, _ = request(server, "GET", "/kafkacruisecontrol/permissions")
     assert status == 200
