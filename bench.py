@@ -71,6 +71,11 @@ def main() -> None:
     before = res.stack_before.by_name()
     after = res.stack_after.by_name()
     print(
+        f"[bench] phases: "
+        + " ".join(f"{k}={v:.2f}s" for k, v in res.phase_seconds.items()),
+        file=sys.stderr,
+    )
+    print(
         f"[bench] cold={t_cold:.2f}s warm={t_warm:.2f}s"
         f" proposals={len(res.proposals)}"
         f" verified={res.verification.ok}"

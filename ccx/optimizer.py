@@ -25,8 +25,10 @@ from ccx.goals.stack import (
 )
 from ccx.model.tensor_model import TensorClusterModel
 from ccx.proposals import ExecutionProposal, diff
+from ccx.goals.stack import evaluate_stack
 from ccx.search.annealer import AnnealOptions, anneal
 from ccx.search.greedy import GreedyOptions, greedy_optimize
+from ccx.search.repair import hard_repair
 from ccx.verify import Verification, verify_optimization
 
 
@@ -42,6 +44,7 @@ class OptimizerResult:
     wall_seconds: float
     n_sa_accepted: int
     n_polish_moves: int
+    phase_seconds: dict = dataclasses.field(default_factory=dict)
 
     @property
     def num_replica_movements(self) -> int:
@@ -102,27 +105,48 @@ def optimize(
     goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
     opts: OptimizeOptions = OptimizeOptions(),
 ) -> OptimizerResult:
-    """Full-stack proposal computation (reference call stack 3.2, L3a part)."""
+    """Full-stack proposal computation (reference call stack 3.2, L3a part).
+
+    Pipeline (mirrors the reference's sequential-goal semantics, SURVEY.md
+    §7.4): (1) vectorized hard-goal repair sweeps establish feasibility
+    exactly — the analogue of the hard goals' own optimize() passes; (2)
+    batched SA balances the soft goals without breaking hard ones; (3) a
+    greedy polish + repair loop cleans up residuals.
+    """
     t0 = time.monotonic()
-    sa = anneal(m, cfg, goal_names, opts.anneal)
+    phases: dict[str, float] = {}
+    stack_before = evaluate_stack(m, cfg, goal_names)
+    t = time.monotonic()
+    repaired, n_repair = hard_repair(m, cfg, goal_names)
+    phases["repair"] = time.monotonic() - t
+    t = time.monotonic()
+    sa = anneal(repaired, cfg, goal_names, opts.anneal)
+    phases["anneal"] = time.monotonic() - t
     model = sa.model
     stack_after = sa.stack_after
-    n_polish = 0
+    n_polish = n_repair
+    t = time.monotonic()
     if opts.run_polish:
         polish = greedy_optimize(model, cfg, goal_names, opts.polish)
         model = polish.model
         stack_after = polish.stack_after
-        n_polish = polish.n_moves
+        n_polish += polish.n_moves
         for _ in range(max(opts.max_repair_rounds - 1, 0)):
             if float(stack_after.hard_violations) <= 0:
                 break
+            model, n_r = hard_repair(model, cfg, goal_names)
+            n_polish += n_r
             polish = greedy_optimize(model, cfg, goal_names, opts.polish)
-            if polish.n_moves == 0:
+            if polish.n_moves == 0 and n_r == 0:
                 break
             model = polish.model
             stack_after = polish.stack_after
             n_polish += polish.n_moves
+    phases["polish"] = time.monotonic() - t
+    t = time.monotonic()
     proposals = diff(m, model)
+    phases["diff"] = time.monotonic() - t
+    t = time.monotonic()
     verification = verify_optimization(
         m,
         model,
@@ -131,18 +155,20 @@ def optimize(
         proposals=proposals,
         require_hard_zero=opts.require_hard_zero,
         check_evacuation=opts.check_evacuation,
-        stack_before=sa.stack_before,
+        stack_before=stack_before,
         stack_after=stack_after,
     )
+    phases["verify"] = time.monotonic() - t
     return OptimizerResult(
         proposals=proposals,
-        stack_before=sa.stack_before,
+        stack_before=stack_before,
         stack_after=stack_after,
         verification=verification,
         model=model,
         wall_seconds=time.monotonic() - t0,
         n_sa_accepted=sa.n_accepted,
         n_polish_moves=n_polish,
+        phase_seconds=phases,
     )
 
 

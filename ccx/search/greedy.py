@@ -58,6 +58,11 @@ class GreedyOptions:
     p_biased_dest: float = 0.5
     p_evac: float = 0.3
     seed: int = 0
+    #: accept up to this many distinct-partition improving candidates per
+    #: iteration (composition is exact on state; the post-batch re-score
+    #: rolls back to single-move acceptance if the combined effect is a
+    #: lexicographic regression). 1 = reference-faithful one-move-at-a-time.
+    batch_moves: int = 8
 
 
 @dataclasses.dataclass
@@ -184,32 +189,59 @@ def greedy_optimize(
         )
         costs_np = np.asarray(costs)
         feas_np = np.asarray(feas)
+        ps_np = np.asarray(ps)
 
-        # lexicographic argmin among feasible strict improvements
-        best_i, best_v = -1, cur
-        for i in range(opts.n_candidates):
-            if not feas_np[i]:
-                continue
-            if _lex_better(costs_np[i], best_v):
-                best_i, best_v = i, costs_np[i]
-
-        if best_i < 0:
+        # feasible strict improvements vs the current vector, best first
+        improving = [
+            i for i in range(opts.n_candidates)
+            if feas_np[i] and _lex_better(costs_np[i], cur)
+        ]
+        if not improving:
             stale += 1
             if stale >= opts.patience:
                 break
             continue
         stale = 0
-        state = _apply_move(
-            state,
-            m,
-            ps[best_i],
-            news[0][best_i],
-            news[1][best_i],
-            news[2][best_i],
-            parts[best_i],
-        )
-        cur = best_v
-        n_moves += 1
+        improving.sort(key=lambda i: tuple(costs_np[i]))
+
+        # take up to batch_moves candidates on distinct partitions; state
+        # composition is exact (agg re-derived per apply; part_sums composed
+        # from per-candidate deltas), only the predicted vector is stale
+        taken: list[int] = []
+        seen_p: set[int] = set()
+        for i in improving:
+            p = int(ps_np[i])
+            if p in seen_p:
+                continue
+            seen_p.add(p)
+            taken.append(i)
+            if len(taken) >= max(opts.batch_moves, 1):
+                break
+
+        prev_state, prev_cur = state, cur
+        orig_part = state.part_sums
+        for i in taken:
+            part_corr = state.part_sums + (parts[i] - orig_part)
+            state = _apply_move(
+                state, m, ps[i], news[0][i], news[1][i], news[2][i], part_corr
+            )
+        if len(taken) == 1:
+            cur = costs_np[taken[0]]
+        else:
+            cur = np.asarray(_eval_vector(
+                state.agg, state.part_sums, m, goal_names=goal_names, cfg=cfg
+            ))
+            if not _lex_better(cur, prev_cur):
+                # interacting moves regressed: fall back to the single best
+                state, cur = prev_state, prev_cur
+                i = taken[0]
+                state = _apply_move(
+                    state, m, ps[i], news[0][i], news[1][i], news[2][i],
+                    parts[i],
+                )
+                cur = costs_np[i]
+                taken = taken[:1]
+        n_moves += len(taken)
 
     result_model = with_placement(m, state)
     stack_after = evaluate_stack(result_model, cfg, goal_names)

@@ -1,0 +1,205 @@
+"""Vectorized hard-goal repair sweeps.
+
+Parity/motivation: the reference optimizes goals *sequentially* —
+``RackAwareGoal.optimize`` walks every violating replica and relocates it
+before any balancing goal runs (SURVEY.md C16, call stack 3.2). Stochastic
+search discovers those same repairs one accepted move at a time, which is
+hopeless when a snapshot starts with thousands of violations (B5: ~10k
+rack offenders). This module is the TPU-native form of the reference's
+per-goal repair pass: ONE jitted sweep selects, for **every** violating
+partition at once,
+
+* the offending slot — a replica on a dead broker/disk, a duplicate broker,
+  or (when the stack contains a rack goal) a rack-duplicate replica — and
+* a destination broker on an unused rack with the most capacity headroom
+  (noise-perturbed so simultaneous choosers spread out),
+
+then applies all moves with one scatter. A handful of sweeps reaches
+hard-feasibility; the annealer then only has to *balance* (soft goals),
+which is what Metropolis search is actually good at.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccx.goals.base import GoalConfig
+from ccx.model.tensor_model import TensorClusterModel, build_model
+from ccx.search.annealer import RACK_TARGET_GOALS, allows_inter_broker
+
+
+@functools.partial(jax.jit, static_argnames=("target_rack",))
+def _sweep(
+    m: TensorClusterModel,
+    assignment: jnp.ndarray,   # int32[P, R]
+    leader_slot: jnp.ndarray,  # int32[P]
+    replica_disk: jnp.ndarray,  # int32[P, R]
+    key: jnp.ndarray,
+    *,
+    target_rack: bool,
+):
+    P, R, B, K = m.P, m.R, m.B, m.num_racks
+    pvalid = m.partition_valid
+    valid = (assignment >= 0) & pvalid[:, None]
+    safe_b = jnp.clip(assignment, 0, B - 1)
+    alive_b = m.broker_alive & m.broker_valid
+    recv_ok = alive_b & ~m.broker_excl_replicas
+
+    # --- offender selection -------------------------------------------------
+    on_dead = valid & ~alive_b[safe_b]
+    safe_d = jnp.clip(replica_disk, 0, m.D - 1)
+    on_dead_disk = valid & (replica_disk >= 0) & ~m.disk_alive[safe_b, safe_d]
+
+    # duplicate broker within the replica set (slot j duplicates some k<j)
+    a_keyed = jnp.where(valid, assignment, -1 - jnp.arange(R, dtype=jnp.int32)[None, :])
+    dup_broker = jnp.any(
+        (a_keyed[:, :, None] == a_keyed[:, None, :])
+        & (jnp.arange(R)[None, :, None] > jnp.arange(R)[None, None, :]),
+        axis=2,
+    )
+
+    racks = jnp.where(valid, m.broker_rack[safe_b], -1 - jnp.arange(R)[None, :])
+    dup_rack = jnp.any(
+        (racks[:, :, None] == racks[:, None, :])
+        & (jnp.arange(R)[None, :, None] > jnp.arange(R)[None, None, :]),
+        axis=2,
+    )
+
+    score = (
+        3.0 * on_dead
+        + 2.5 * on_dead_disk
+        + 2.0 * dup_broker
+        + (1.0 * dup_rack if target_rack else 0.0)
+    )
+    slot = jnp.argmax(score, axis=1)                       # int[P]
+    has_offender = jnp.max(score, axis=1) > 0.0
+    off_is_disk_only = (
+        jnp.take_along_axis(on_dead_disk, slot[:, None], 1)[:, 0]
+        & ~jnp.take_along_axis(on_dead, slot[:, None], 1)[:, 0]
+        & ~jnp.take_along_axis(dup_broker, slot[:, None], 1)[:, 0]
+        & (
+            ~jnp.take_along_axis(dup_rack, slot[:, None], 1)[:, 0]
+            if target_rack
+            else jnp.ones_like(slot, bool)
+        )
+    )
+
+    # --- destination choice -------------------------------------------------
+    # brokers already hosting the partition (excluding the offender slot)
+    keep = valid & (jnp.arange(R)[None, :] != slot[:, None])
+    in_part = jnp.zeros((P, B), bool)
+    rows = jnp.repeat(jnp.arange(P)[:, None], R, 1)
+    in_part = in_part.at[rows, safe_b].max(keep)
+
+    used_rack = jnp.zeros((P, K), bool)
+    rack_idx = jnp.clip(racks, 0, K - 1)
+    used_rack = used_rack.at[rows, rack_idx].max(keep & (racks >= 0))
+
+    allowed_base = recv_ok[None, :] & ~in_part
+    rack_free = ~used_rack[:, jnp.clip(m.broker_rack, 0, K - 1)]  # [P, B]
+    allowed_rack = allowed_base & rack_free
+    use_rack_constraint = jnp.any(allowed_rack, axis=1, keepdims=True)
+    allowed = jnp.where(use_rack_constraint, allowed_rack, allowed_base)
+
+    # headroom score: spare disk+replica capacity, noise-spread
+    from ccx.model.aggregates import broker_aggregates
+
+    agg = broker_aggregates(
+        m.replace(
+            assignment=assignment, leader_slot=leader_slot,
+            replica_disk=replica_disk,
+        )
+    )
+    disk_cap = jnp.maximum(m.broker_capacity[3], 1e-9)
+    headroom = 1.0 - agg.broker_load[3] / disk_cap
+    count_head = 1.0 - agg.replica_count / jnp.maximum(
+        jnp.max(agg.replica_count), 1.0
+    )
+    base_score = headroom + 0.5 * count_head
+    noise = jax.random.uniform(key, (P, B)) * 0.35
+    dest_score = jnp.where(allowed, base_score[None, :] + noise, -jnp.inf)
+    dest = jnp.argmax(dest_score, axis=1).astype(jnp.int32)   # int[P]
+    dest_found = jnp.isfinite(jnp.max(dest_score, axis=1))
+
+    # --- disk-only offenders move disks, not brokers ------------------------
+    # choose the least-loaded alive disk on the *current* broker
+    cur_b = jnp.take_along_axis(safe_b, slot[:, None], 1)[:, 0]
+    disk_ok = m.disk_alive[cur_b]                             # [P, D]
+    disk_load = agg.disk_load[cur_b] / jnp.maximum(m.disk_capacity[cur_b], 1e-9)
+    disk_score = jnp.where(disk_ok, -disk_load, -jnp.inf)
+    best_disk = jnp.argmax(disk_score, axis=1).astype(jnp.int32)
+    disk_found = jnp.isfinite(jnp.max(disk_score, axis=1))
+
+    # --- apply --------------------------------------------------------------
+    do_move = pvalid & has_offender & dest_found & ~off_is_disk_only
+    do_disk = pvalid & has_offender & off_is_disk_only & disk_found
+    pidx = jnp.arange(P)
+    new_assignment = assignment.at[pidx, slot].set(
+        jnp.where(do_move, dest, jnp.take_along_axis(assignment, slot[:, None], 1)[:, 0])
+    )
+    new_disk_val = jnp.where(
+        do_move,
+        0,
+        jnp.where(
+            do_disk, best_disk,
+            jnp.take_along_axis(replica_disk, slot[:, None], 1)[:, 0],
+        ),
+    )
+    new_replica_disk = replica_disk.at[pidx, slot].set(new_disk_val)
+    n_moved = jnp.sum(do_move) + jnp.sum(do_disk)
+    return new_assignment, new_replica_disk, n_moved
+
+
+def _leader_fix(m: TensorClusterModel, assignment, leader_slot):
+    """Point leaders at an alive, non-excluded replica where possible."""
+    valid = (assignment >= 0) & m.partition_valid[:, None]
+    safe_b = jnp.clip(assignment, 0, m.B - 1)
+    lead_ok = (
+        m.broker_alive & m.broker_valid & ~m.broker_excl_leadership
+    )[safe_b] & valid
+    cur_ok = jnp.take_along_axis(lead_ok, leader_slot[:, None], 1)[:, 0]
+    first_ok = jnp.argmax(lead_ok, axis=1).astype(jnp.int32)
+    any_ok = jnp.any(lead_ok, axis=1)
+    return jnp.where(cur_ok | ~any_ok, leader_slot, first_ok)
+
+
+def hard_repair(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...],
+    max_sweeps: int = 8,
+    seed: int = 17,
+) -> tuple[TensorClusterModel, int]:
+    """Sweep until no targetable hard offenders remain (or max_sweeps).
+
+    Returns (repaired model, total moves). Only runs the placement sweep for
+    stacks that allow inter-broker movement; leader placement is fixed in
+    all cases.
+    """
+    target_rack = bool(RACK_TARGET_GOALS & set(goal_names))
+    assignment = m.assignment
+    leader_slot = m.leader_slot
+    replica_disk = m.replica_disk
+    total = 0
+    if allows_inter_broker(goal_names):
+        key = jax.random.PRNGKey(seed)
+        for i in range(max_sweeps):
+            key, sub = jax.random.split(key)
+            assignment, replica_disk, n = _sweep(
+                m, assignment, leader_slot, replica_disk, sub,
+                target_rack=target_rack,
+            )
+            n = int(n)
+            total += n
+            if n == 0:
+                break
+    leader_slot = _leader_fix(m, assignment, leader_slot)
+    out = m.replace(
+        assignment=assignment, leader_slot=leader_slot,
+        replica_disk=replica_disk,
+    )
+    return out, total

@@ -1,0 +1,110 @@
+"""Hard-goal repair sweep tests (ccx/search/repair.py)."""
+
+import numpy as np
+import pytest
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, evaluate_stack
+from ccx.model.fixtures import RandomClusterSpec, random_cluster
+from ccx.model.tensor_model import build_model
+from ccx.search.repair import hard_repair
+from ccx.common.resources import NUM_RESOURCES
+
+
+def stack_v(m, names=DEFAULT_GOAL_ORDER):
+    s = evaluate_stack(m, GoalConfig(), names)
+    return {n: v for n, (v, _) in s.by_name().items()}
+
+
+def test_repair_fixes_rack_violations_in_few_sweeps():
+    # 3 racks, all replicas stacked onto rack-0 brokers
+    B, P, R = 9, 60, 3
+    rng = np.random.default_rng(0)
+    rack0 = [0, 3, 6]
+    assignment = np.array(
+        [rng.choice(rack0, size=R, replace=False) for _ in range(P)], np.int32
+    )
+    m = build_model(
+        assignment=assignment,
+        leader_load=np.ones((NUM_RESOURCES, P), np.float32),
+        follower_load=np.ones((NUM_RESOURCES, P), np.float32) * 0.5,
+        broker_capacity=np.full((NUM_RESOURCES, B), 1e6, np.float32),
+        broker_rack=np.arange(B, dtype=np.int32) % 3,
+    )
+    before = stack_v(m)
+    assert before["RackAwareGoal"] > 0
+    fixed, n = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
+    after = stack_v(fixed)
+    assert after["RackAwareGoal"] == 0
+    assert after["StructuralFeasibility"] == 0
+    assert n >= before["RackAwareGoal"]
+
+
+def test_repair_evacuates_dead_brokers_and_disks():
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=8, n_racks=4, n_topics=4, n_partitions=64, seed=3,
+        n_dead_brokers=2,
+    ))
+    before = stack_v(m)
+    assert before["StructuralFeasibility"] > 0
+    fixed, n = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
+    after = stack_v(fixed)
+    assert after["StructuralFeasibility"] == 0
+    # dead brokers hold nothing afterwards
+    a = np.asarray(fixed.assignment)
+    alive = np.asarray(fixed.broker_alive & fixed.broker_valid)
+    hosted = a[np.asarray(fixed.partition_valid)]
+    hosted = hosted[hosted >= 0]
+    assert alive[hosted].all()
+
+
+def test_repair_respects_receive_exclusions():
+    B, P, R = 6, 30, 2
+    rng = np.random.default_rng(1)
+    assignment = np.array(
+        [[0, 1] for _ in range(P)], np.int32
+    )
+    excl = np.zeros(B, bool)
+    excl[[2, 3]] = True
+    alive = np.ones(B, bool)
+    alive[0] = False  # force evacuation off broker 0
+    m = build_model(
+        assignment=assignment,
+        leader_load=np.ones((NUM_RESOURCES, P), np.float32),
+        follower_load=np.ones((NUM_RESOURCES, P), np.float32) * 0.5,
+        broker_capacity=np.full((NUM_RESOURCES, B), 1e6, np.float32),
+        broker_rack=np.arange(B, dtype=np.int32) % 3,
+        broker_alive=alive,
+        broker_excl_replicas=excl,
+    )
+    fixed, n = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
+    a = np.asarray(fixed.assignment)[:P]
+    assert (a != 0).all()          # evacuated
+    assert not np.isin(a, [2, 3]).any()  # exclusions honored
+    assert stack_v(fixed)["StructuralFeasibility"] == 0
+
+
+def test_repair_idempotent_on_feasible_cluster():
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=6, n_racks=3, n_topics=3, n_partitions=32, seed=4
+    ))
+    fixed1, _ = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
+    assert stack_v(fixed1)["RackAwareGoal"] == 0
+    fixed2, n2 = hard_repair(fixed1, GoalConfig(), DEFAULT_GOAL_ORDER)
+    assert n2 == 0
+    np.testing.assert_array_equal(
+        np.asarray(fixed2.assignment), np.asarray(fixed1.assignment)
+    )
+
+
+def test_repair_scales_to_b5_style_violations():
+    """A B5-shaped (smaller) cluster with thousands of rack offenders is
+    fully repaired in a few sweeps — the scenario SA alone cannot fix."""
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=100, n_racks=10, n_topics=50, n_partitions=5000, seed=5
+    ))
+    before = stack_v(m)
+    fixed, n = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
+    after = stack_v(fixed)
+    assert after["RackAwareGoal"] == 0, before["RackAwareGoal"]
+    assert after["StructuralFeasibility"] == 0
