@@ -15,22 +15,70 @@ generation and not the first-call XLA compile (a resident sidecar serves
 every request from the jit cache; compile time is reported separately on
 stderr).
 
+Fail-loudly contract (a timed-out driver run must still leave diagnostics):
+* a seconds-scale B1 smoke runs FIRST — if the device is wedged, the smoke
+  never finishes and the tail says so, distinguishing "device wedged" from
+  "my program is slow";
+* every phase entry/exit is flushed to stderr with elapsed time;
+* SIGTERM/SIGINT/atexit dump a partial-result JSON line (phase timings +
+  last phase entered) so rc=124 still leaves a breadcrumb trail.
+
 Env knobs: CCX_BENCH=B1..B5 selects the config; CCX_BENCH_CHAINS /
-CCX_BENCH_STEPS override SA effort.
+CCX_BENCH_STEPS override SA effort; CCX_BENCH_SKIP_SMOKE=1 skips the smoke.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import signal
 import sys
 import time
 
+T_START = time.monotonic()
+_state: dict = {"phase": "startup", "phases": {}, "done": False, "name": None}
 
-def main() -> None:
-    t_start = time.monotonic()
-    name = os.environ.get("CCX_BENCH", "B5")
 
+def log(msg: str) -> None:
+    print(f"[bench +{time.monotonic() - T_START:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def enter_phase(phase: str) -> None:
+    now = time.monotonic()
+    prev = _state.get("phase")
+    if prev and prev in _state.get("_enter_t", {}):
+        _state["phases"][prev] = round(now - _state["_enter_t"][prev], 2)
+    _state.setdefault("_enter_t", {})[phase] = now
+    _state["phase"] = phase
+    log(f"phase: {phase}")
+
+
+def _partial_dump(reason: str) -> None:
+    if _state.get("done"):
+        return
+    payload = {
+        "metric": f"{_state.get('name') or '?'} PARTIAL ({reason})",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": None,
+        "partial": True,
+        "last_phase": _state.get("phase"),
+        "phase_seconds": _state.get("phases"),
+        "elapsed_s": round(time.monotonic() - T_START, 1),
+    }
+    print(json.dumps(payload), flush=True)
+    log(f"PARTIAL DUMP ({reason}): last phase={_state.get('phase')}")
+
+
+def _on_signal(signum, frame):
+    _partial_dump(f"signal {signal.Signals(signum).name}")
+    # re-raise default behaviour so the exit code reflects the signal
+    signal.signal(signum, signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def run_config(name: str, *, smoke: bool = False) -> dict:
     from ccx.goals.base import GoalConfig
     from ccx.goals.stack import DEFAULT_GOAL_ORDER
     from ccx.model.fixtures import bench_spec, random_cluster
@@ -38,12 +86,12 @@ def main() -> None:
     from ccx.search.annealer import AnnealOptions
     from ccx.search.greedy import GreedyOptions
 
+    tag = "smoke " if smoke else ""
     spec = bench_spec(name)
     m = random_cluster(spec)
-    print(
-        f"[bench] {name}: brokers={spec.n_brokers} partitions={spec.n_partitions}"
-        f" padded P={m.P} B={m.B} T={m.num_topics}",
-        file=sys.stderr,
+    log(
+        f"{tag}{name}: brokers={spec.n_brokers} partitions={spec.n_partitions}"
+        f" padded P={m.P} B={m.B} T={m.num_topics}"
     )
 
     goal_names = (
@@ -51,54 +99,96 @@ def main() -> None:
         if name == "B1"
         else DEFAULT_GOAL_ORDER
     )
-    n_chains = int(os.environ.get("CCX_BENCH_CHAINS", "32"))
-    n_steps = int(os.environ.get("CCX_BENCH_STEPS", "3000"))
+    if smoke:
+        n_chains, n_steps, polish_iters = 8, 100, 10
+    else:
+        n_chains = int(os.environ.get("CCX_BENCH_CHAINS", "32"))
+        n_steps = int(os.environ.get("CCX_BENCH_STEPS", "3000"))
+        polish_iters = int(os.environ.get("CCX_BENCH_POLISH_ITERS", "150"))
     opts = OptimizeOptions(
         anneal=AnnealOptions(n_chains=n_chains, n_steps=n_steps, seed=42),
-        polish=GreedyOptions(n_candidates=256, max_iters=150, patience=4),
+        polish=GreedyOptions(n_candidates=256, max_iters=polish_iters, patience=4),
     )
     cfg = GoalConfig()
 
-    # Warm the jit cache (the resident-sidecar steady state), then measure.
-    t0 = time.monotonic()
-    res = optimize(m, cfg, goal_names, opts)
-    t_cold = time.monotonic() - t0
+    def cb(phase: str) -> None:
+        enter_phase(f"{tag}{name}:{phase}")
 
+    # Warm the jit cache (the resident-sidecar steady state), then measure.
+    enter_phase(f"{tag}{name}:cold-run")
     t0 = time.monotonic()
-    res = optimize(m, cfg, goal_names, opts)
+    res = optimize(m, cfg, goal_names, opts, progress_cb=cb)
+    t_cold = time.monotonic() - t0
+    log(f"{tag}{name} cold={t_cold:.2f}s phases=" + " ".join(
+        f"{k}={v:.2f}s" for k, v in res.phase_seconds.items()))
+
+    enter_phase(f"{tag}{name}:warm-run")
+    t0 = time.monotonic()
+    res = optimize(m, cfg, goal_names, opts, progress_cb=cb)
     t_warm = time.monotonic() - t0
 
     before = res.stack_before.by_name()
     after = res.stack_after.by_name()
-    print(
-        f"[bench] phases: "
-        + " ".join(f"{k}={v:.2f}s" for k, v in res.phase_seconds.items()),
-        file=sys.stderr,
-    )
-    print(
-        f"[bench] cold={t_cold:.2f}s warm={t_warm:.2f}s"
+    log(f"{tag}{name} warm phases: " + " ".join(
+        f"{k}={v:.2f}s" for k, v in res.phase_seconds.items()))
+    log(
+        f"{tag}{name} cold={t_cold:.2f}s warm={t_warm:.2f}s"
         f" proposals={len(res.proposals)}"
         f" verified={res.verification.ok}"
         f" hard_before={float(res.stack_before.hard_cost):.1f}"
         f" hard_after={float(res.stack_after.hard_cost):.1f}"
         f" soft_before={float(res.stack_before.soft_scalar):.4f}"
-        f" soft_after={float(res.stack_after.soft_scalar):.4f}",
-        file=sys.stderr,
+        f" soft_after={float(res.stack_after.soft_scalar):.4f}"
     )
-    for goal in after:
-        vb, cb = before[goal]
-        va, ca = after[goal]
-        print(f"[bench]   {goal}: v {vb:.0f}->{va:.0f} c {cb:.4f}->{ca:.4f}", file=sys.stderr)
-    print(f"[bench] total harness time {time.monotonic() - t_start:.1f}s", file=sys.stderr)
+    if not smoke:
+        for goal in after:
+            vb, cb_ = before[goal]
+            va, ca = after[goal]
+            log(f"  {goal}: v {vb:.0f}->{va:.0f} c {cb_:.4f}->{ca:.4f}")
+    return {
+        "cold": t_cold,
+        "warm": t_warm,
+        "verified": bool(res.verification.ok),
+        "proposals": len(res.proposals),
+    }
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    atexit.register(lambda: _partial_dump("atexit"))
+
+    name = os.environ.get("CCX_BENCH", "B5")
+    _state["name"] = name
+
+    enter_phase("jax-init")
+    import jax
+
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    # Smoke: tiny B1 in seconds. If the device is wedged this is where the
+    # run dies, and the breadcrumb says so.
+    if os.environ.get("CCX_BENCH_SKIP_SMOKE") != "1":
+        enter_phase("smoke")
+        smoke = run_config("B1", smoke=True)
+        log(f"smoke OK: cold={smoke['cold']:.2f}s warm={smoke['warm']:.2f}s — device is alive")
+
+    r = run_config(name)
+    enter_phase("report")
+    log(f"total harness time {time.monotonic() - T_START:.1f}s")
 
     target_s = 5.0
+    _state["done"] = True
     print(
         json.dumps(
             {
                 "metric": f"{name} full-goal-stack rebalance proposal wall-clock (warm)",
-                "value": round(t_warm, 3),
+                "value": round(r["warm"], 3),
                 "unit": "s",
-                "vs_baseline": round(target_s / max(t_warm, 1e-9), 3),
+                "vs_baseline": round(target_s / max(r["warm"], 1e-9), 3),
+                "verified": r["verified"],
+                "proposals": r["proposals"],
+                "cold_s": round(r["cold"], 3),
             }
         )
     )

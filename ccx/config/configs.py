@@ -278,8 +278,10 @@ def anomaly_detector_config_def() -> ConfigDef:
     d.define("topic.anomaly.finder.class", Type.CLASS,
              "ccx.detector.detectors.TopicReplicationFactorAnomalyFinder",
              Importance.LOW, "TopicAnomalyFinder SPI.")
-    d.define("target.topic.replication.factor", Type.INT, 3, Importance.LOW,
-             "Desired RF for topic-anomaly detection.", at_least(1))
+    d.define("target.topic.replication.factor", Type.INT, 0, Importance.LOW,
+             "Desired RF for topic-anomaly detection; 0 disables the finder "
+             "(ref: the RF finder is opt-in — an uninvited RF 'fix' can make "
+             "rack-awareness infeasible).", at_least(0))
     d.define("maintenance.event.reader.class", Type.CLASS,
              "ccx.detector.detectors.NoopMaintenanceEventReader",
              Importance.LOW, "MaintenanceEventReader SPI.")

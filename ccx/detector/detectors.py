@@ -198,7 +198,7 @@ class TopicReplicationFactorAnomalyFinder:
     from `target.topic.replication.factor`."""
 
     def __init__(self, config=None) -> None:
-        self.target_rf = 3
+        self.target_rf = 0
         if config is not None:
             self.configure(config)
 
@@ -206,6 +206,8 @@ class TopicReplicationFactorAnomalyFinder:
         self.target_rf = config["target.topic.replication.factor"]
 
     def find(self, metadata, now_ms: int) -> list[Anomaly]:
+        if self.target_rf <= 0:  # opt-in: no configured target, no anomalies
+            return []
         bad: dict[str, int] = {}
         for topic in metadata.topics():
             rfs = {len(p.replicas) for p in metadata.partitions_of(topic)}

@@ -24,12 +24,17 @@ import enum
 import threading
 import time as _time
 
+import logging
+
 from ccx.common.exceptions import OngoingExecutionException
 from ccx.common.metadata import ClusterMetadata
+from ccx.common.metrics import REGISTRY
 from ccx.executor.admin import THROTTLE_CONFIG, AdminApi
 from ccx.executor.execution_task import TaskState, TaskType
 from ccx.executor.strategy import build_strategy_chain
 from ccx.executor.task_manager import ExecutionCaps, ExecutionTaskManager
+
+LOG = logging.getLogger(__name__)
 from ccx.proposals import ExecutionProposal
 
 
@@ -299,15 +304,20 @@ class Executor:
                 t.transition(TaskState.IN_PROGRESS, now)
             self.admin.alter_replica_log_dirs(moves)
             # Poll log-dir state until the batch settles (disk moves take
-            # real time on real clusters); tasks still unfinished at the
-            # timeout are DEAD.
-            deadline = self.clock() + self.config[
+            # real time on real clusters). The alerting threshold only
+            # *alerts* (ref: task.execution.alerting.threshold.ms triggers a
+            # metric/log, never kills the task — the log-dir move may still
+            # complete); DEAD only on real failure signals: partition gone
+            # or destination broker dead.
+            alert_at = self.clock() + self.config[
                 "task.execution.alerting.threshold.ms"
             ]
+            alerted: set = set()
             remaining = list(batch)
             while remaining:
                 self.waiter(self.poll_interval_ms)
                 metadata = self.admin.describe_cluster()
+                alive = metadata.alive_broker_ids()
                 pidx = {p.tp: p for p in metadata.partitions}
                 now = self.clock()
                 still = []
@@ -322,11 +332,19 @@ class Executor:
                         want.get(b, d) == d
                         for b, d in zip(cur.replicas, cur.replica_dirs)
                     )
+                    broker_dead = any(b not in alive for b in want)
                     if done:
                         t.transition(TaskState.COMPLETED, now)
-                    elif cur is None or now >= deadline:
+                    elif cur is None or broker_dead:
                         t.transition(TaskState.DEAD, now)
                     else:
+                        if now >= alert_at and t.tp not in alerted:
+                            alerted.add(t.tp)
+                            REGISTRY.counter("executor.slow-task-alerts").inc()
+                            LOG.warning(
+                                "intra-broker move %s exceeded alerting "
+                                "threshold; still polling", t.tp,
+                            )
                         still.append(t)
                 remaining = still
                 if self._stop_requested.is_set():

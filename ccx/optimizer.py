@@ -104,6 +104,7 @@ def optimize(
     cfg: GoalConfig = GoalConfig(),
     goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
     opts: OptimizeOptions = OptimizeOptions(),
+    progress_cb=None,
 ) -> OptimizerResult:
     """Full-stack proposal computation (reference call stack 3.2, L3a part).
 
@@ -112,20 +113,30 @@ def optimize(
     exactly — the analogue of the hard goals' own optimize() passes; (2)
     batched SA balances the soft goals without breaking hard ones; (3) a
     greedy polish + repair loop cleans up residuals.
+
+    ``progress_cb(phase: str)`` is invoked as each phase *starts* — the
+    analogue of the reference's OperationProgress steps; bench/servlet use it
+    so a timed-out run still shows which phase it died in.
     """
     t0 = time.monotonic()
     phases: dict[str, float] = {}
+
+    def _enter(name: str) -> float:
+        if progress_cb is not None:
+            progress_cb(name)
+        return time.monotonic()
+
     stack_before = evaluate_stack(m, cfg, goal_names)
-    t = time.monotonic()
+    t = _enter("repair")
     repaired, n_repair = hard_repair(m, cfg, goal_names)
     phases["repair"] = time.monotonic() - t
-    t = time.monotonic()
+    t = _enter("anneal")
     sa = anneal(repaired, cfg, goal_names, opts.anneal)
     phases["anneal"] = time.monotonic() - t
     model = sa.model
     stack_after = sa.stack_after
     n_polish = n_repair
-    t = time.monotonic()
+    t = _enter("polish")
     if opts.run_polish:
         polish = greedy_optimize(model, cfg, goal_names, opts.polish)
         model = polish.model
@@ -143,10 +154,10 @@ def optimize(
             stack_after = polish.stack_after
             n_polish += polish.n_moves
     phases["polish"] = time.monotonic() - t
-    t = time.monotonic()
+    t = _enter("diff")
     proposals = diff(m, model)
     phases["diff"] = time.monotonic() - t
-    t = time.monotonic()
+    t = _enter("verify")
     verification = verify_optimization(
         m,
         model,

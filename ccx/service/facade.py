@@ -19,7 +19,10 @@ import time as _time
 
 import logging
 
-from ccx.common.exceptions import UserRequestException
+from ccx.common.exceptions import (
+    OptimizationFailureException,
+    UserRequestException,
+)
 from ccx.common.metrics import REGISTRY
 
 #: the reference's separate operations log (SURVEY.md §5.1: log4j
@@ -206,6 +209,32 @@ class CruiseControl:
         out["reason"] = reason
         out["provisionStatus"] = self.provisioner.rightsize(res.model).to_json()
         if not dryrun and res.proposals:
+            # Never hand unverified proposals to the executor (ref: the
+            # GoalOptimizer raises OptimizationFailureException instead of
+            # executing). This is the only gate between the self-healing
+            # auto-fix path (dryrun=False, no human in the loop) and the
+            # cluster, so a broken optimization must fail loudly here.
+            if not res.verification.ok:
+                oplog.error(
+                    "refusing to execute unverified proposals uuid=%s: %s",
+                    uuid, "; ".join(res.verification.failures),
+                )
+                raise OptimizationFailureException(
+                    "optimization result failed verification: "
+                    + "; ".join(res.verification.failures)
+                )
+            if res.verification.infeasible:
+                oplog.error(
+                    "refusing to execute infeasible optimization uuid=%s: %s",
+                    uuid, res.verification.infeasible,
+                )
+                raise OptimizationFailureException(
+                    "hard goals unsatisfiable for this cluster: "
+                    + "; ".join(
+                        f"{g}: {why}"
+                        for g, why in res.verification.infeasible.items()
+                    )
+                )
             if progress:
                 progress.step(f"Executing {len(res.proposals)} proposals")
             self.executor.execute_proposals(
@@ -546,11 +575,13 @@ class CruiseControl:
             for i, info in enumerate(metadata.partitions):
                 if not rx.fullmatch(info.tp.topic):
                     valid[i] = False
-        order = np.argsort(-lead[res] * valid)[:max_entries]
+        # Filter to valid partitions first, then sort + slice — slicing
+        # before the validity filter would return fewer than max_entries
+        # when zero-load valid partitions tie with masked-out ones.
+        valid_idx = np.nonzero(valid)[0]
+        order = valid_idx[np.argsort(-lead[res][valid_idx])][:max_entries]
         records = []
         for p in order:
-            if not valid[p]:
-                continue
             info = metadata.partitions[int(p)]
             records.append(
                 {

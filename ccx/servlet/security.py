@@ -22,6 +22,7 @@ from ccx.servlet.endpoints import GET_ENDPOINTS, EndPoint
 ROLE_VIEWER = "VIEWER"
 ROLE_USER = "USER"
 ROLE_ADMIN = "ADMIN"
+ALL_ROLES = frozenset({ROLE_VIEWER, ROLE_USER, ROLE_ADMIN})
 
 #: minimum role per endpoint class (ref permissions endpoint semantics)
 _VIEWER_OK = frozenset(
@@ -98,9 +99,32 @@ class BasicSecurityProvider(SecurityProvider):
                 if not line or line.startswith("#"):
                     continue
                 user, _, rest = line.partition(":")
-                parts = [p.strip() for p in rest.split(",")]
-                password, roles = parts[0], {r.upper() for r in parts[1:]}
+                password, roles = self._split_password_roles(rest.strip())
                 self._users[user.strip()] = (password, roles or {ROLE_VIEWER})
+
+    @staticmethod
+    def _split_password_roles(rest: str) -> tuple[str, set[str]]:
+        """``password,role1,role2`` — the password may contain commas.
+
+        Quoted passwords (Jetty-style ``"pass,word",ADMIN``) are taken
+        verbatim; otherwise role names are parsed from the *end* (known role
+        tokens only) so a comma inside the password is never silently
+        truncated into bogus roles.
+        """
+        if rest.startswith('"'):
+            end = rest.find('"', 1)
+            if end > 0:
+                password = rest[1:end]
+                tail = rest[end + 1 :].lstrip(", ")
+                roles = {r.strip().upper() for r in tail.split(",") if r.strip()}
+                return password, roles
+        parts = [p.strip() for p in rest.split(",")]
+        n = len(parts)
+        while n > 1 and parts[n - 1].upper() in ALL_ROLES:
+            n -= 1
+        password = ",".join(parts[:n])
+        roles = {p.upper() for p in parts[n:]}
+        return password, roles
 
     def authenticate(self, headers) -> AuthResult:
         auth = headers.get("authorization", "")

@@ -87,6 +87,21 @@ class CruiseControlApp:
             info = self.user_tasks.get(task_id)
             if info is None:
                 raise UserRequestException(f"Unknown User-Task-ID {task_id}")
+            # Replay is only valid against the endpoint the task was created
+            # for (authorization above was checked against the *requested*
+            # endpoint, so an endpoint mismatch would leak another verb's
+            # result past the role check — ref UserTaskManager matches the
+            # request URL when resuming) and only for the originating client.
+            if info.endpoint != endpoint.value.upper():
+                raise UserRequestException(
+                    f"User-Task-ID {task_id} belongs to endpoint "
+                    f"{info.endpoint}, not {endpoint.value.upper()}"
+                )
+            if info.client_id and client and info.client_id != client:
+                raise UserRequestException(
+                    f"User-Task-ID {task_id} was created by a different "
+                    "client"
+                )
             return self._task_response(info)
 
         # --- two-step review (C33) -----------------------------------------

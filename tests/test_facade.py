@@ -236,6 +236,7 @@ def test_user_task_manager_lifecycle():
         utm.submit("STATE", slow)
     gate.set()
     assert t1.future.result(timeout=5) == {"ok": True}
+    assert t2.future.result(timeout=5) == {"ok": True}
     assert t1.state == TaskState.COMPLETED
     assert utm.get(t1.task_id) is t1
     assert len(utm.tasks()) == 2
@@ -266,3 +267,47 @@ def test_operation_progress_steps():
     steps = p.to_json()
     assert [s["step"] for s in steps] == ["a", "b"]
     assert all("timeToFinishSec" in s for s in steps)
+
+
+def test_unverified_proposals_never_executed(tmp_path):
+    """ADVICE r1 (high): _finish must refuse to execute when verification
+    failed (ref: OptimizationFailureException instead of executing) — the
+    self-healing path runs through here with no human in the loop."""
+    import pytest
+
+    from ccx.common.exceptions import OptimizationFailureException
+
+    cc, sim, clock = make_cc(tmp_path, sim_cluster(skewed=True))
+    model, metadata, gen = cc._model()
+    res = cc._run_optimizer(
+        model, cc._resolve_goals(None, False), cc._optimize_options(), None
+    )
+    assert res.proposals
+    res.verification.ok = False
+    res.verification.failures = ["synthetic: replication factor changed"]
+    with pytest.raises(OptimizationFailureException):
+        cc._finish(res, metadata, dryrun=False, reason="t", uuid="u1")
+    assert not cc.executor.has_ongoing_execution
+
+    res.verification.ok = True
+    res.verification.failures = []
+    res.verification.infeasible = {"RackAwareGoal": "rf > racks"}
+    with pytest.raises(OptimizationFailureException):
+        cc._finish(res, metadata, dryrun=False, reason="t", uuid="u2")
+    assert not cc.executor.has_ongoing_execution
+    # dryrun with failed verification is still reportable (no execution)
+    res.verification.ok = False
+    out = cc._finish(res, metadata, dryrun=True, reason="t", uuid="u3")
+    assert out["dryRun"] and "executionStarted" not in out
+
+
+def test_partition_load_max_entries_with_zero_load_ties(tmp_path):
+    """ADVICE r1 (low): truncation must happen after validity filtering so
+    zero-load valid partitions are not crowded out by masked ones."""
+    cc, sim, clock = make_cc(tmp_path)
+    out = cc.partition_load(max_entries=5)
+    assert len(out["records"]) == 5
+    total = cc.partition_load(max_entries=10_000)
+    n_valid = len(total["records"])
+    out = cc.partition_load(max_entries=n_valid)
+    assert len(out["records"]) == n_valid

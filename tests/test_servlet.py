@@ -386,3 +386,50 @@ def test_http_auth_enforced(tmp_path):
     finally:
         app.stop()
         cc.shutdown()
+
+
+def test_basic_security_comma_password(tmp_path):
+    """Passwords containing commas must not be truncated into bogus roles
+    (ref Jetty credentials: user: password,role1,role2 with quoting)."""
+    creds = tmp_path / "creds"
+    creds.write_text(
+        "carol: pa,ss,ADMIN\n"
+        'dave: "quo,ted,USER",USER\n'
+        "eve: plain\n"
+    )
+    p = BasicSecurityProvider(str(creds))
+
+    def hdr(user, pw):
+        tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+        return {"authorization": f"Basic {tok}"}
+
+    ok = p.authenticate(hdr("carol", "pa,ss"))
+    assert ok.ok and ok.roles == {"ADMIN"}
+    # the truncated password must NOT authenticate
+    assert not p.authenticate(hdr("carol", "pa")).ok
+    ok = p.authenticate(hdr("dave", "quo,ted,USER"))
+    assert ok.ok and ok.roles == {"USER"}
+    ok = p.authenticate(hdr("eve", "plain"))
+    assert ok.ok and ok.roles == {"VIEWER"}
+
+
+def test_user_task_replay_endpoint_mismatch(server):
+    """A task id may only replay against its own endpoint — presenting
+    another endpoint's UUID must 400, not leak the other task's result."""
+    request(server, "GET", "/kafkacruisecontrol/proposals")
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/user_tasks")
+    task_id = next(
+        t["UserTaskId"] for t in body["userTasks"]
+        if t["Endpoint"] == "PROPOSALS"
+    )
+    status, body, _ = request(
+        server, "GET", "/kafkacruisecontrol/state",
+        headers={"User-Task-ID": task_id},
+    )
+    assert status == 400
+    # replay against the matching endpoint still works
+    status, body, _ = request(
+        server, "GET", "/kafkacruisecontrol/proposals",
+        headers={"User-Task-ID": task_id},
+    )
+    assert status == 200
