@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from ccx.common.resources import Resource
 from ccx.goals.base import GoalConfig, GoalResult, register_goal, result
 from ccx.goals import partition_terms as pt
+from ccx.goals import topic_terms as tt
 from ccx.model.aggregates import BrokerAggregates
 from ccx.model.tensor_model import TensorClusterModel
 
@@ -109,12 +110,9 @@ def replica_capacity(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConf
 @register_goal("MinTopicLeadersPerBrokerGoal", hard=True)
 def min_topic_leaders(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
     """Each alive broker hosts >= k leaders of each flagged topic (ref:
-    MinTopicLeadersPerBrokerGoal over `topics.with.min.leaders.per.broker`)."""
-    alive = _alive(m) & ~m.broker_excl_leadership
-    k = cfg.min_topic_leaders_per_broker
-    deficit = jnp.maximum(k - agg.topic_leader_count, 0)  # [T, B]
-    deficit = jnp.where(m.topic_min_leaders[:, None] & alive[None, :], deficit, 0)
-    n = jnp.sum(deficit).astype(jnp.float32)
+    MinTopicLeadersPerBrokerGoal over `topics.with.min.leaders.per.broker`).
+    Row math shared with incremental search via ccx.goals.topic_terms."""
+    n = jnp.sum(tt.mtl_row(m, cfg, m.topic_min_leaders, agg.topic_leader_count))
     return result(n, n)
 
 
@@ -178,18 +176,14 @@ def leader_replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cf
 
 @register_goal("TopicReplicaDistributionGoal", hard=False)
 def topic_replica_distribution(m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig) -> GoalResult:
-    alive = _alive(m)
-    n_alive = _n_alive(m)
-    totals = jnp.sum(jnp.where(alive[None, :], agg.topic_replica_count, 0), axis=1)  # [T]
-    avg = totals.astype(jnp.float32) / n_alive
-    t = cfg.topic_replica_balance_threshold
-    upper = jnp.ceil(avg * t)[:, None]
-    lower = jnp.floor(avg * (2.0 - t))[:, None]
-    counts = agg.topic_replica_count.astype(jnp.float32)
-    pen = jnp.maximum(counts - upper, 0.0) + jnp.maximum(lower - counts, 0.0)
-    pen = jnp.where(alive[None, :], pen, 0.0)
-    n = jnp.sum(pen > 0).astype(jnp.float32)
-    return result(n, jnp.sum(pen) / _safe(jnp.mean(jnp.maximum(avg, 1.0))))
+    """Per-topic replica counts within a band around each topic's alive-broker
+    average (ref: TopicReplicaDistributionGoal). Row math shared with
+    incremental search via ccx.goals.topic_terms."""
+    pen_sums, offenders = tt.trd_row_pen(m, cfg, agg.topic_replica_count)
+    totals = tt.trd_row_total(m, agg.topic_replica_count)
+    return result(
+        jnp.sum(offenders), jnp.sum(pen_sums) / tt.trd_normalizer(m, totals)
+    )
 
 
 @register_goal("LeaderBytesInDistributionGoal", hard=False)

@@ -17,6 +17,9 @@ that exactly (SURVEY.md section 7.4), so the rebuild uses:
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from flax import struct
 
@@ -122,17 +125,7 @@ def scalar_cost(costs: jnp.ndarray, hard_mask: tuple[bool, ...]) -> jnp.ndarray:
     return jnp.sum(costs * soft_weights(hard_mask))
 
 
-def evaluate_stack(
-    m: TensorClusterModel,
-    cfg: GoalConfig,
-    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
-    agg: BrokerAggregates | None = None,
-) -> StackResult:
-    """Score one model state against an ordered goal stack (jit-safe; the
-    goal list and config are static, so each (stack, cfg) pair compiles once
-    and is then vmappable over candidate batches)."""
-    if agg is None:
-        agg = broker_aggregates(m)
+def _evaluate(m, agg, cfg, goal_names) -> StackResult:
     violations, costs, hard_mask = [], [], []
     for name in goal_names:
         spec = GOAL_REGISTRY[name]
@@ -146,3 +139,27 @@ def evaluate_stack(
         violations=jnp.stack(violations),
         costs=jnp.stack(costs),
     )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "goal_names"))
+def _evaluate_no_agg(m, *, cfg, goal_names) -> StackResult:
+    return _evaluate(m, broker_aggregates(m), cfg, goal_names)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "goal_names"))
+def _evaluate_with_agg(m, agg, *, cfg, goal_names) -> StackResult:
+    return _evaluate(m, agg, cfg, goal_names)
+
+
+def evaluate_stack(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    agg: BrokerAggregates | None = None,
+) -> StackResult:
+    """Score one model state against an ordered goal stack. Runs as ONE
+    compiled XLA program per (stack, cfg, shapes) — eager per-op dispatch is
+    prohibitive on a remote-tunneled TPU device."""
+    if agg is None:
+        return _evaluate_no_agg(m, cfg=cfg, goal_names=tuple(goal_names))
+    return _evaluate_with_agg(m, agg, cfg=cfg, goal_names=tuple(goal_names))

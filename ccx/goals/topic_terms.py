@@ -1,0 +1,91 @@
+"""Per-topic-row goal contributions for the two [T, B]-shaped goals.
+
+``MinTopicLeadersPerBrokerGoal`` and ``TopicReplicaDistributionGoal``
+(reference ``analyzer/goals/{MinTopicLeadersPerBrokerGoal,
+TopicReplicaDistributionGoal}.java``, SURVEY.md C16/C17) score the
+(topic, broker) count matrices. Materializing per-candidate copies of those
+[T, B] aggregates was the round-1 bottleneck (candidate scoring moved ~0.5 GB
+per batch at B5 scale); the fix is the same factoring as
+``ccx.goals.partition_terms``: the penalty math lives in *row* functions over
+one topic's [B] count row, so
+
+* the full kernels (ccx.goals.kernels) vmap them over all T rows, and
+* incremental search (ccx.search) re-scores only the single row a move
+  touches — a move on partition p can only change topic(p)'s counts *and*
+  that topic's alive-broker total, so every other row's contribution (and
+  band) is provably unchanged,
+
+from one implementation, so incremental sums can never drift from the full
+evaluation semantics. All raw sums are integer-valued (counts and integer
+band edges), hence exactly representable in float32 — incremental search can
+add/subtract row deltas thousands of times with zero drift.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ccx.goals.base import GoalConfig
+from ccx.model.tensor_model import TensorClusterModel
+
+#: Goals whose contribution search maintains via topic-row deltas.
+TOPIC_GOALS: tuple[str, ...] = (
+    "MinTopicLeadersPerBrokerGoal",
+    "TopicReplicaDistributionGoal",
+)
+
+
+def mtl_row(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    flagged: jnp.ndarray,   # bool[...] — topic is in the min-leaders set
+    tlc_row: jnp.ndarray,   # int32[..., B] — topic_leader_count row(s)
+) -> jnp.ndarray:
+    """float32[...] — raw leader deficit of one (or a batch of) topic row(s):
+    sum over eligible brokers of max(k - leaders, 0)."""
+    alive = m.broker_valid & m.broker_alive & ~m.broker_excl_leadership
+    k = cfg.min_topic_leaders_per_broker
+    deficit = jnp.maximum(k - tlc_row, 0)
+    deficit = jnp.where(flagged[..., None] & alive, deficit, 0)
+    return jnp.sum(deficit, axis=-1).astype(jnp.float32)
+
+
+def trd_row_total(m: TensorClusterModel, trc_row: jnp.ndarray) -> jnp.ndarray:
+    """float32[...] — alive-broker replica total of one topic row."""
+    alive = m.broker_valid & m.broker_alive
+    return jnp.sum(jnp.where(alive, trc_row, 0), axis=-1).astype(jnp.float32)
+
+
+def trd_row_pen(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    trc_row: jnp.ndarray,   # int32[..., B]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(raw_pen, n_offenders) float32[...] for one (or a batch of) topic
+    row(s). Band edges are ceil/floor of avg*threshold, so the raw penalty is
+    integer-valued."""
+    alive = m.broker_valid & m.broker_alive
+    n_alive = jnp.maximum(jnp.sum(alive), 1).astype(jnp.float32)
+    total = trd_row_total(m, trc_row)
+    avg = total / n_alive
+    t = cfg.topic_replica_balance_threshold
+    upper = jnp.ceil(avg * t)[..., None]
+    lower = jnp.floor(avg * (2.0 - t))[..., None]
+    counts = trc_row.astype(jnp.float32)
+    pen = jnp.maximum(counts - upper, 0.0) + jnp.maximum(lower - counts, 0.0)
+    pen = jnp.where(alive, pen, 0.0)
+    return jnp.sum(pen, axis=-1), jnp.sum(pen > 0, axis=-1).astype(jnp.float32)
+
+
+def trd_normalizer(
+    m: TensorClusterModel, topic_totals: jnp.ndarray
+) -> jnp.ndarray:
+    """Normalizer of the TopicReplicaDistribution cost: mean over topics of
+    max(avg_replicas_per_alive_broker, 1) — identical to the full kernel's
+    ``_safe(mean(maximum(avg, 1.0)))``."""
+    n_alive = jnp.maximum(jnp.sum(m.broker_valid & m.broker_alive), 1).astype(
+        jnp.float32
+    )
+    avg = topic_totals / n_alive
+    norm = jnp.mean(jnp.maximum(avg, 1.0))
+    return jnp.where(norm > 0, norm, 1.0)

@@ -1,0 +1,158 @@
+"""ClusterModelStats — summary statistics of a cluster model state.
+
+Parity: ``model/ClusterModelStats.java`` (SURVEY.md C4) is the stats block
+the reference's soft goals, tests and operators score against: per-resource
+utilization mean/st.dev/min/max over alive brokers, replica / leader-replica
+/ topic-replica distribution stats, and potential nw-out. Upstream attaches
+it to ``OptimizerResult`` (per-goal stats deltas) and the ``load`` endpoint;
+so does this module (ccx.optimizer.OptimizerResult.to_json,
+ccx.service.facade.load).
+
+The JSON shape mirrors upstream's ``ClusterModelStats.getJsonStructure``:
+``{"metadata": {brokers, replicas, topics}, "statistics": {AVG, STD, MIN,
+MAX}}`` with the eight upstream metric keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ccx.common.resources import Resource
+from ccx.model.aggregates import BrokerAggregates, broker_aggregates
+from ccx.model.tensor_model import TensorClusterModel
+
+#: Upstream stat keys, in upstream order.
+STAT_KEYS = (
+    "disk",
+    "cpu",
+    "networkInbound",
+    "networkOutbound",
+    "potentialNwOut",
+    "replicas",
+    "leaderReplicas",
+    "topicReplicas",
+)
+
+_RESOURCE_KEYS = {
+    "cpu": Resource.CPU,
+    "networkInbound": Resource.NW_IN,
+    "networkOutbound": Resource.NW_OUT,
+    "disk": Resource.DISK,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterModelStats:
+    """Summary stats over alive brokers (ref: model/ClusterModelStats.java)."""
+
+    n_brokers: int
+    n_replicas: int
+    n_topics: int
+    n_partitions: int
+    avg: dict[str, float]
+    std: dict[str, float]
+    min: dict[str, float]
+    max: dict[str, float]
+
+    def to_json(self) -> dict:
+        return {
+            "metadata": {
+                "brokers": self.n_brokers,
+                "replicas": self.n_replicas,
+                "topics": self.n_topics,
+                "partitions": self.n_partitions,
+            },
+            "statistics": {
+                "AVG": dict(self.avg),
+                "STD": dict(self.std),
+                "MIN": dict(self.min),
+                "MAX": dict(self.max),
+            },
+        }
+
+
+def _dist(values: np.ndarray) -> tuple[float, float, float, float]:
+    if values.size == 0:
+        return 0.0, 0.0, 0.0, 0.0
+    return (
+        float(values.mean()),
+        float(values.std()),
+        float(values.min()),
+        float(values.max()),
+    )
+
+
+def cluster_model_stats(
+    m: TensorClusterModel, agg: BrokerAggregates | None = None
+) -> ClusterModelStats:
+    """Compute the stats block from a model state (one aggregate pass)."""
+    if agg is None:
+        import jax
+
+        agg = jax.jit(broker_aggregates)(m)
+    alive = np.asarray(m.broker_valid & m.broker_alive)
+    loads = np.asarray(agg.broker_load)              # [RES, B]
+    repl = np.asarray(agg.replica_count)
+    lead = np.asarray(agg.leader_count)
+    pot = np.asarray(agg.potential_nw_out)
+    trc = np.asarray(agg.topic_replica_count)        # [T, B]
+
+    avg: dict[str, float] = {}
+    std: dict[str, float] = {}
+    mn: dict[str, float] = {}
+    mx: dict[str, float] = {}
+
+    for key, res in _RESOURCE_KEYS.items():
+        avg[key], std[key], mn[key], mx[key] = _dist(loads[res][alive])
+    avg["potentialNwOut"], std["potentialNwOut"], mn["potentialNwOut"], mx["potentialNwOut"] = _dist(pot[alive])
+    avg["replicas"], std["replicas"], mn["replicas"], mx["replicas"] = _dist(
+        repl[alive].astype(np.float64)
+    )
+    (
+        avg["leaderReplicas"],
+        std["leaderReplicas"],
+        mn["leaderReplicas"],
+        mx["leaderReplicas"],
+    ) = _dist(lead[alive].astype(np.float64))
+
+    # Topic-replica distribution: per-topic stats across alive brokers,
+    # averaged over topics that have replicas (upstream scores the per-topic
+    # spread; empty/padding topics carry no signal).
+    cells = trc[:, alive].astype(np.float64)         # [T, B_alive]
+    has = cells.sum(axis=1) > 0
+    if has.any() and cells.shape[1] > 0:
+        per_topic = cells[has]
+        avg["topicReplicas"] = float(per_topic.mean(axis=1).mean())
+        std["topicReplicas"] = float(per_topic.std(axis=1).mean())
+        mn["topicReplicas"] = float(per_topic.min(axis=1).mean())
+        mx["topicReplicas"] = float(per_topic.max(axis=1).mean())
+    else:
+        avg["topicReplicas"] = std["topicReplicas"] = 0.0
+        mn["topicReplicas"] = mx["topicReplicas"] = 0.0
+
+    return ClusterModelStats(
+        n_brokers=int(alive.sum()),
+        n_replicas=int(np.asarray(m.n_replicas)),
+        n_topics=int(has.sum()) if cells.shape[1] > 0 else 0,
+        n_partitions=int(np.asarray(m.n_partitions)),
+        avg=avg,
+        std=std,
+        min=mn,
+        max=mx,
+    )
+
+
+def balancedness_score(stats: ClusterModelStats) -> float:
+    """[0, 100] balancedness summary (ref: OptimizerResult's on-demand
+    balancedness score): 100 when every tracked distribution has zero spread;
+    decays with the mean coefficient of variation across stat keys."""
+    cvs = []
+    for key in STAT_KEYS:
+        a = stats.avg[key]
+        if a > 1e-12:
+            cvs.append(stats.std[key] / a)
+    if not cvs:
+        return 100.0
+    return float(100.0 / (1.0 + float(np.mean(cvs))))

@@ -23,6 +23,7 @@ from ccx.goals.stack import (
     INTRA_BROKER_GOAL_ORDER,
     StackResult,
 )
+from ccx.model.stats import ClusterModelStats, balancedness_score, cluster_model_stats
 from ccx.model.tensor_model import TensorClusterModel
 from ccx.proposals import ExecutionProposal, diff
 from ccx.goals.stack import evaluate_stack
@@ -45,6 +46,25 @@ class OptimizerResult:
     n_sa_accepted: int
     n_polish_moves: int
     phase_seconds: dict = dataclasses.field(default_factory=dict)
+    #: input placement, kept so the ClusterModelStats blocks (ref
+    #: model/ClusterModelStats.java, SURVEY.md C4) can be derived lazily —
+    #: computing them costs an aggregate pass + host transfer, which must not
+    #: tax callers (bench hot path) that never read the stats.
+    input_model: TensorClusterModel | None = None
+
+    @property
+    def stats_before(self) -> ClusterModelStats | None:
+        if self.input_model is None:
+            return None
+        if not hasattr(self, "_stats_before"):
+            self._stats_before = cluster_model_stats(self.input_model)
+        return self._stats_before
+
+    @property
+    def stats_after(self) -> ClusterModelStats | None:
+        if not hasattr(self, "_stats_after"):
+            self._stats_after = cluster_model_stats(self.model)
+        return self._stats_after
 
     @property
     def num_replica_movements(self) -> int:
@@ -81,6 +101,22 @@ class OptimizerResult:
             "verificationFailures": self.verification.failures,
             "optimizationFailures": self.verification.infeasible,
             "wallSeconds": self.wall_seconds,
+            **(
+                {
+                    "clusterModelStats": {
+                        "before": self.stats_before.to_json(),
+                        "after": self.stats_after.to_json(),
+                    },
+                    "onDemandBalancednessScoreBefore": balancedness_score(
+                        self.stats_before
+                    ),
+                    "onDemandBalancednessScoreAfter": balancedness_score(
+                        self.stats_after
+                    ),
+                }
+                if self.stats_before is not None and self.stats_after is not None
+                else {}
+            ),
         }
 
 
@@ -180,6 +216,7 @@ def optimize(
         n_sa_accepted=sa.n_accepted,
         n_polish_moves=n_polish,
         phase_seconds=phases,
+        input_model=m,
     )
 
 

@@ -91,20 +91,33 @@ def diff(before: TensorClusterModel, after: TensorClusterModel) -> list[Executio
     changed = pvalid & (
         np.any(a0 != a1, axis=1) | (l0 != l1) | np.any(d0 != d1, axis=1)
     )
+    ps = np.nonzero(changed)[0]
+    # Bulk-convert to Python scalars once — per-element numpy indexing is
+    # ~100x slower and B5-scale diffs cover ~10^5 partitions.
+    rows = zip(
+        ps.tolist(),
+        topics[ps].tolist(),
+        a0[ps].tolist(),
+        a1[ps].tolist(),
+        l0[ps].tolist(),
+        l1[ps].tolist(),
+        d0[ps].tolist(),
+        d1[ps].tolist(),
+    )
     out: list[ExecutionProposal] = []
-    for p in np.nonzero(changed)[0]:
-        old_r = tuple(int(b) for b in a0[p] if b >= 0)
-        new_r = tuple(int(b) for b in a1[p] if b >= 0)
+    for p, t, r0, r1, s0, s1, k0, k1 in rows:
+        old_r = tuple(b for b in r0 if b >= 0)
+        new_r = tuple(b for b in r1 if b >= 0)
         out.append(
             ExecutionProposal(
-                partition=int(p),
-                topic=int(topics[p]),
+                partition=p,
+                topic=t,
                 old_replicas=old_r,
                 new_replicas=new_r,
-                old_leader=int(a0[p, l0[p]]) if old_r else -1,
-                new_leader=int(a1[p, l1[p]]) if new_r else -1,
-                old_disks=tuple(int(d) for d, b in zip(d0[p], a0[p]) if b >= 0),
-                new_disks=tuple(int(d) for d, b in zip(d1[p], a1[p]) if b >= 0),
+                old_leader=r0[s0] if old_r else -1,
+                new_leader=r1[s1] if new_r else -1,
+                old_disks=tuple(d for d, b in zip(k0, r0) if b >= 0),
+                new_disks=tuple(d for d, b in zip(k1, r1) if b >= 0),
             )
         )
     return out

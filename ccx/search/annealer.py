@@ -3,19 +3,23 @@
 This is the TPU-native replacement for ``GoalOptimizer``'s greedy walk
 (SURVEY.md C14, call stack 3.2 hot loop #1): instead of one thread mutating
 one ClusterModel via per-goal ``rebalanceForBroker`` loops, K independent
-chains each propose one move per step — the reference's ``ActionType``
-vocabulary (SURVEY.md C20): INTER_BROKER_REPLICA_MOVEMENT,
+chains each propose ``moves_per_step`` moves per scan step — the reference's
+``ActionType`` vocabulary (SURVEY.md C20): INTER_BROKER_REPLICA_MOVEMENT,
 LEADERSHIP_MOVEMENT, INTRA_BROKER_REPLICA_MOVEMENT — score the full goal
-stack from incrementally-updated aggregates, and accept by Metropolis on the
-lexicographic (hard, soft) cost. The whole search is one ``lax.scan`` of a
-vmapped step: chains are the embarrassingly-parallel batch axis
-(the descendant of `num.proposal.precompute.threads`, SURVEY.md section 2.5).
+stack from incrementally-updated aggregates (O(R) per move, ccx.search.state)
+and accept on the **full per-goal cost vector**. The whole search is one
+``lax.scan`` of a vmapped step: chains are the embarrassingly-parallel batch
+axis (the descendant of ``num.proposal.precompute.threads``, SURVEY.md §2.5).
 
-Acceptance semantics mirror the reference's hard/soft split: a move that
-raises hard-goal cost is never accepted (`actionAcceptance` veto); within
-equal hard cost, soft cost follows Metropolis with a geometric temperature
-schedule; hard-goal *reductions* are always accepted (self-healing: replicas
-evacuate dead brokers because those moves strictly drop hard cost).
+Acceptance semantics mirror the reference's sequential-goal priority exactly
+where it matters (``actionAcceptance`` veto, SURVEY.md §7.4):
+
+* a move that raises any *hard* goal's cost is never accepted;
+* a strict lexicographic improvement of the cost vector is always accepted —
+  including one whose only effect is on the lowest-priority tier, which a
+  tier-weighted float32 scalar would be blind to;
+* otherwise Metropolis on the tier-weighted soft delta with a geometric
+  temperature schedule provides uphill exploration.
 """
 
 from __future__ import annotations
@@ -27,15 +31,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ccx.goals.base import GoalConfig
-from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack, soft_weights
 from ccx.model.tensor_model import TensorClusterModel
 from ccx.search.state import (
     SearchState,
+    apply_move,
     init_search_state,
-    make_cost_fn,
-    partition_row_sums,
-    scatter_partition,
+    make_move_scorer,
     with_placement,
 )
 
@@ -49,6 +52,9 @@ MOVE_DISK = 2         # INTRA_BROKER_REPLICA_MOVEMENT (JBOD)
 class AnnealOptions:
     n_chains: int = 64
     n_steps: int = 3000
+    #: proposals per chain per scan step (sequential, exact composition) —
+    #: raise so churn scales with partition count without growing the scan
+    moves_per_step: int = 1
     t0: float = 0.3          # initial temperature (soft-cost units)
     t1: float = 1e-4         # final temperature
     p_leadership: float = 0.15
@@ -324,6 +330,36 @@ def propose_move(
     return p, (old_assign, old_leader, old_disk), (new_assign, new_leader, new_disk), feasible
 
 
+def goal_tols(cost_vec: jnp.ndarray) -> jnp.ndarray:
+    """Per-goal significance tolerance for vector comparisons. Partition and
+    topic sums are exact integers (tolerance only guards true float goals
+    like capacity hinges); relative term keeps incremental drift on large
+    costs from reading as a change."""
+    return 1e-6 + 1e-6 * jnp.abs(cost_vec)
+
+
+def lex_accept(
+    cur_vec: jnp.ndarray,
+    new_vec: jnp.ndarray,
+    hard_arr: jnp.ndarray,    # bool[G]
+    weights: jnp.ndarray,     # f32[G] tier weights (soft goals)
+    temperature: jnp.ndarray,
+    key: jnp.ndarray,
+) -> jnp.ndarray:
+    """Vector-lexicographic SA acceptance (see module docstring)."""
+    d = new_vec - cur_vec
+    tol = goal_tols(cur_vec)
+    sig = jnp.abs(d) > tol
+    any_sig = jnp.any(sig)
+    first = jnp.argmax(sig)
+    lex_lt = any_sig & (d[first] < 0)
+    hard_up = jnp.any(sig & hard_arr & (d > 0))
+    soft_d = jnp.sum(jnp.where(hard_arr, 0.0, d * weights))
+    u = jax.random.uniform(key, minval=1e-12, maxval=1.0)
+    metropolis = jnp.log(u) < (-soft_d / jnp.maximum(temperature, 1e-30))
+    return ~hard_up & (lex_lt | ~any_sig | metropolis)
+
+
 def _anneal_step(
     state: SearchState,
     temperature: jnp.ndarray,
@@ -332,63 +368,27 @@ def _anneal_step(
     n_evac: jnp.ndarray,
     *,
     m: TensorClusterModel,
-    cost_fn,
+    scorer,
     pp: ProposalParams,
+    hard_arr: jnp.ndarray,
+    weights: jnp.ndarray,
+    moves_per_step: int,
 ) -> SearchState:
-    """One proposed move on one chain (vmapped over chains by the caller)."""
-    key = jax.random.fold_in(state.key, step_idx)
-    k_prop, k_acc = jax.random.split(key)
-    p, old, new, feasible = propose_move(k_prop, state, m, pp, evac, n_evac)
-    (old_assign, old_leader, old_disk) = old
-    (new_assign, new_leader, new_disk) = new
+    """``moves_per_step`` sequential proposals on one chain (vmapped over
+    chains by the caller). Sequential composition inside the step is exact:
+    each proposal scores against the state left by the previous one."""
 
-    # --- incremental aggregates + per-partition sums -----------------------
-    one_f, one_i = jnp.float32(1.0), jnp.int32(1)
-    agg1 = scatter_partition(
-        state.agg, m, p, old_assign, old_leader, old_disk, -one_f, -one_i
-    )
-    agg2 = scatter_partition(
-        agg1, m, p, new_assign, new_leader, new_disk, one_f, one_i
-    )
-    old_rows = partition_row_sums(m, p, old_assign, old_leader, old_disk)
-    new_rows = partition_row_sums(m, p, new_assign, new_leader, new_disk)
-    part_new = state.part_sums - old_rows + new_rows
+    def inner(i, ss: SearchState) -> SearchState:
+        key = jax.random.fold_in(ss.key, step_idx * moves_per_step + i)
+        k_prop, k_acc = jax.random.split(key)
+        p, old, new, feasible = propose_move(k_prop, ss, m, pp, evac, n_evac)
+        delta = scorer(ss, p, old, new)
+        accept = feasible & lex_accept(
+            ss.cost_vec, delta.cost_vec, hard_arr, weights, temperature, k_acc
+        )
+        return apply_move(ss, m, p, old, new, delta, accept)
 
-    hard_new, soft_new = cost_fn(agg2, part_new)
-
-    # --- lexicographic Metropolis acceptance -------------------------------
-    d_hard = hard_new - state.hard_cost
-    d_soft = soft_new - state.soft_cost
-    # relative tolerance: incremental float drift on large hard costs must not
-    # read as a hard-goal regression and stall soft optimization
-    tol = 1e-5 * (1.0 + jnp.abs(state.hard_cost))
-    u = jax.random.uniform(k_acc, minval=1e-12, maxval=1.0)
-    metropolis = jnp.log(u) < (-d_soft / jnp.maximum(temperature, 1e-30))
-    accept = feasible & (
-        (d_hard < -tol) | ((jnp.abs(d_hard) <= tol) & ((d_soft <= 0.0) | metropolis))
-    )
-
-    af, ai = accept.astype(jnp.float32), accept.astype(jnp.int32)
-    rf, ri = 1.0 - af, 1 - ai
-    # revert the scatter if rejected (sparse — avoids a full-array select)
-    agg3 = scatter_partition(agg2, m, p, new_assign, new_leader, new_disk, -rf, -ri)
-    agg4 = scatter_partition(agg3, m, p, old_assign, old_leader, old_disk, rf, ri)
-
-    sel_assign = jnp.where(accept, new_assign, old_assign)
-    sel_leader = jnp.where(accept, new_leader, old_leader)
-    sel_disk = jnp.where(accept, new_disk, old_disk)
-
-    return SearchState(
-        assignment=state.assignment.at[p].set(sel_assign),
-        leader_slot=state.leader_slot.at[p].set(sel_leader),
-        replica_disk=state.replica_disk.at[p].set(sel_disk),
-        agg=agg4,
-        part_sums=jnp.where(accept, part_new, state.part_sums),
-        hard_cost=jnp.where(accept, hard_new, state.hard_cost),
-        soft_cost=jnp.where(accept, soft_new, state.soft_cost),
-        key=state.key,
-        n_accepted=state.n_accepted + ai,
-    )
+    return jax.lax.fori_loop(0, moves_per_step, inner, state)
 
 
 @functools.partial(
@@ -406,9 +406,12 @@ def _run_chains(
     p_real: int,
     b_real: int,
 ) -> SearchState:
-    cost_fn = make_cost_fn(m, goal_names, cfg)
+    scorer = make_move_scorer(m, goal_names, cfg)
     state0 = init_search_state(m, cfg, goal_names, keys[0])
     states = jax.vmap(lambda k: state0.replace(key=k))(keys)
+    hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
+    hard_arr = jnp.asarray(hard_mask)
+    weights = soft_weights(hard_mask)
 
     n = max(opts.n_steps, 1)
     decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
@@ -423,7 +426,15 @@ def _run_chains(
         target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
         allow_inter=allows_inter_broker(goal_names),
     )
-    step = functools.partial(_anneal_step, m=m, cost_fn=cost_fn, pp=pp)
+    step = functools.partial(
+        _anneal_step,
+        m=m,
+        scorer=scorer,
+        pp=pp,
+        hard_arr=hard_arr,
+        weights=weights,
+        moves_per_step=max(opts.moves_per_step, 1),
+    )
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
         temp = opts.t0 * decay**t
@@ -436,6 +447,12 @@ def _run_chains(
     return states
 
 
+def best_chain_index(cost_vecs: np.ndarray) -> int:
+    """Lexicographic argmin across chains (host-side, tiny array)."""
+    order = sorted(range(cost_vecs.shape[0]), key=lambda i: tuple(cost_vecs[i]))
+    return int(order[0])
+
+
 def anneal(
     m: TensorClusterModel,
     cfg: GoalConfig = GoalConfig(),
@@ -445,20 +462,23 @@ def anneal(
 ) -> AnnealResult:
     """Run batched SA and return the best chain's placement as a new model.
 
-    Chains only ever accept hard-cost-non-increasing moves, and the
-    temperature schedule ends near zero, so each chain's final state is its
-    best reachable local optimum; the winner is the lexicographic argmin
-    across chains. The returned model's stack scores are re-evaluated from
-    scratch (incremental float drift cannot leak into reported results).
+    Chains never accept hard-cost-increasing moves, and the temperature
+    schedule ends near zero, so each chain's final state is its best
+    reachable local optimum; the winner is the lexicographic argmin of the
+    full cost vector across chains. The returned model's stack scores are
+    re-evaluated from scratch (incremental float drift cannot leak into
+    reported results).
 
     With ``mesh`` (a jax.sharding.Mesh), chains are sharded across every mesh
     device — pure data parallelism over the batch axis (ccx.parallel); the
     model and evacuation list are replicated. ``opts.n_chains`` must divide
-    evenly by the mesh size.
+    evenly by the mesh size. Partition-axis sharding of the model inside the
+    search lives in ccx.parallel (sharded stack evaluation; sharded search).
     """
     stack_before = evaluate_stack(m, cfg, goal_names)
-    p_real = int(np.asarray(m.n_partitions))
-    b_real = int(np.asarray(jnp.max(jnp.where(m.broker_valid, jnp.arange(m.B), -1)))) + 1
+    p_real = int(np.asarray(m.partition_valid).sum())
+    bv = np.asarray(m.broker_valid)
+    b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
     evac, n_evac = hot_partition_list(m, goal_names)
 
     keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
@@ -481,11 +501,7 @@ def anneal(
         p_real=p_real, b_real=b_real,
     )
 
-    hard = np.asarray(states.hard_cost)
-    soft = np.asarray(states.soft_cost)
-    cand = hard <= hard.min() + 1e-6
-    best = int(np.argmin(np.where(cand, soft, np.inf)))
-
+    best = best_chain_index(np.asarray(states.cost_vec))
     pick = jax.tree.map(lambda a: a[best], states)
     result_model = with_placement(m, pick)
     stack_after = evaluate_stack(result_model, cfg, goal_names)

@@ -6,8 +6,7 @@ accepts it (``actionAcceptance``, SURVEY.md call stack 3.2 hot loop #1).
 That is exactly lexicographic ordering on the per-goal cost vector: a move
 is an improvement iff it strictly reduces some goal's cost without raising
 any higher-priority goal's. This module implements that acceptance rule
-directly — batched candidate scoring on device (vmapped incremental
-evaluation), lexicographic selection on host — and serves as
+directly and serves as
 
 * the correctness oracle the annealer's results are score-compared against
   (SURVEY.md section 4 "score-parity vs a slow Python greedy oracle"), and
@@ -15,6 +14,15 @@ evaluation), lexicographic selection on host — and serves as
   fixes residual hard violations and low-tier regressions (e.g. preferred
   leadership) without breaking higher-priority goals, mirroring the
   reference's sequential re-optimization.
+
+The whole loop runs ON DEVICE as one jitted ``lax.while_loop``: each
+iteration vmaps ``n_candidates`` proposals, scores each in O(R) via the
+incremental move scorer (ccx.search.state — no per-candidate aggregate
+copies), selects the lexicographic argmin on device, applies it, and
+early-exits after ``patience`` consecutive iterations with no improving
+candidate. Round 1's host-driven loop paid one device round-trip + a
+~0.5 GB/batch aggregate materialization *per iteration* (~3.5 s/iter at B5
+scale); this version's per-iteration cost is a few MB of [B]-level traffic.
 """
 
 from __future__ import annotations
@@ -33,15 +41,15 @@ from ccx.search.annealer import (
     RACK_TARGET_GOALS,
     ProposalParams,
     allows_inter_broker,
+    goal_tols,
     hot_partition_list,
     propose_move,
 )
 from ccx.search.state import (
     SearchState,
+    apply_move,
     init_search_state,
-    make_goal_vector_fn,
-    partition_row_sums,
-    scatter_partition,
+    make_move_scorer,
     with_placement,
 )
 
@@ -58,11 +66,6 @@ class GreedyOptions:
     p_biased_dest: float = 0.5
     p_evac: float = 0.3
     seed: int = 0
-    #: accept up to this many distinct-partition improving candidates per
-    #: iteration (composition is exact on state; the post-batch re-score
-    #: rolls back to single-move acceptance if the combined effect is a
-    #: lexicographic regression). 1 = reference-faithful one-move-at-a-time.
-    batch_moves: int = 8
 
 
 @dataclasses.dataclass
@@ -74,75 +77,81 @@ class GreedyResult:
     n_iters: int
 
 
-@functools.partial(jax.jit, static_argnames=("goal_names", "cfg", "pp"))
-def _score_candidates(
-    state: SearchState,
-    key: jnp.ndarray,
+def _lex_lt_batch(costs: jnp.ndarray, cur: jnp.ndarray) -> jnp.ndarray:
+    """bool[N] — candidate vector lexicographically < current (with per-goal
+    tolerance): the first significantly-changed goal improved."""
+    d = costs - cur[None, :]
+    tol = goal_tols(cur)[None, :]
+    sig = jnp.abs(d) > tol
+    any_sig = jnp.any(sig, axis=1)
+    first = jnp.argmax(sig, axis=1)
+    d_first = jnp.take_along_axis(d, first[:, None], axis=1)[:, 0]
+    return any_sig & (d_first < 0)
+
+
+def _lex_argmin(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Index of the lexicographically-smallest masked row of costs[N, G]
+    (on device; G is static and small, so the column loop unrolls)."""
+    alive = mask
+    G = costs.shape[1]
+    for g in range(G):
+        col = jnp.where(alive, costs[:, g], jnp.inf)
+        mn = jnp.min(col)
+        tol = 1e-6 + 1e-6 * jnp.abs(mn)
+        alive = alive & (col <= mn + tol)
+    return jnp.argmax(alive)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("goal_names", "cfg", "pp", "opts")
+)
+def _greedy_loop(
     m: TensorClusterModel,
+    state0: SearchState,
     evac: jnp.ndarray,
     n_evac: jnp.ndarray,
+    key0: jnp.ndarray,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
     pp: ProposalParams,
+    opts: GreedyOptions,
 ):
-    """Score n_candidates random moves; return per-candidate goal-cost
-    vectors plus the move payloads (rows are applied host-side)."""
-    vector_fn = make_goal_vector_fn(m, goal_names, cfg)
+    scorer = make_move_scorer(m, goal_names, cfg)
+    N = opts.n_candidates
 
-    def one(k):
-        p, old, new, feasible = propose_move(k, state, m, pp, evac, n_evac)
-        agg1 = scatter_partition(state.agg, m, p, *old, jnp.float32(-1), jnp.int32(-1))
-        agg2 = scatter_partition(agg1, m, p, *new, jnp.float32(1), jnp.int32(1))
-        part = state.part_sums - partition_row_sums(m, p, *old) + partition_row_sums(
-            m, p, *new
+    def cond(carry):
+        _, it, stale, _ = carry
+        return (it < opts.max_iters) & (stale < opts.patience)
+
+    def body(carry):
+        ss, it, stale, moves = carry
+        keys = jax.random.split(jax.random.fold_in(key0, it), N)
+
+        def one(k):
+            p, old, new, feasible = propose_move(k, ss, m, pp, evac, n_evac)
+            delta = scorer(ss, p, old, new)
+            return p, old, new, feasible, delta
+
+        ps, olds, news, feas, deltas = jax.vmap(one)(keys)
+        better = feas & _lex_lt_batch(deltas.cost_vec, ss.cost_vec)
+        any_better = jnp.any(better)
+        best = _lex_argmin(deltas.cost_vec, better)
+
+        pick = lambda tree: jax.tree.map(lambda a: a[best], tree)  # noqa: E731
+        ss = apply_move(
+            ss, m, ps[best], pick(olds), pick(news), pick(deltas), any_better
         )
-        costs = vector_fn(agg2, part)
-        return p, new, feasible, costs, part
+        it = it + 1
+        stale = jnp.where(any_better, 0, stale + 1)
+        moves = moves + any_better.astype(jnp.int32)
+        return ss, it, stale, moves
 
-    return jax.vmap(one)(key)
-
-
-@functools.partial(jax.jit, static_argnames=("goal_names", "cfg"))
-def _eval_vector(agg, part_sums, m, *, goal_names, cfg):
-    """Goal-cost vector of the current state (module-level jit so repeated
-    greedy_optimize calls share the compile cache)."""
-    return make_goal_vector_fn(m, goal_names, cfg)(agg, part_sums)
-
-
-@functools.partial(jax.jit, static_argnames=())
-def _apply_move(
-    state: SearchState,
-    m: TensorClusterModel,
-    p: jnp.ndarray,
-    new_assign: jnp.ndarray,
-    new_leader: jnp.ndarray,
-    new_disk: jnp.ndarray,
-    part_sums: jnp.ndarray,
-) -> SearchState:
-    old = (state.assignment[p], state.leader_slot[p], state.replica_disk[p])
-    agg1 = scatter_partition(state.agg, m, p, *old, jnp.float32(-1), jnp.int32(-1))
-    agg2 = scatter_partition(
-        agg1, m, p, new_assign, new_leader, new_disk, jnp.float32(1), jnp.int32(1)
+    zero = jnp.asarray(0, jnp.int32)
+    state, n_iters, _, n_moves = jax.lax.while_loop(
+        cond, body, (state0, zero, zero, zero)
     )
-    return state.replace(
-        assignment=state.assignment.at[p].set(new_assign),
-        leader_slot=state.leader_slot.at[p].set(new_leader),
-        replica_disk=state.replica_disk.at[p].set(new_disk),
-        agg=agg2,
-        part_sums=part_sums,
-        n_accepted=state.n_accepted + 1,
-    )
-
-
-def _lex_better(cand: np.ndarray, cur: np.ndarray, tol: float = 1e-6) -> bool:
-    """cand < cur lexicographically (with tolerance)."""
-    for i in range(cur.shape[0]):
-        if cand[i] < cur[i] - tol:
-            return True
-        if cand[i] > cur[i] + tol:
-            return False
-    return False
+    return state, n_iters, n_moves
 
 
 def greedy_optimize(
@@ -153,10 +162,9 @@ def greedy_optimize(
 ) -> GreedyResult:
     """Hill-climb the lexicographic goal-cost vector to a local optimum."""
     stack_before = evaluate_stack(m, cfg, goal_names)
-    p_real = int(np.asarray(m.n_partitions))
-    b_real = (
-        int(np.asarray(jnp.max(jnp.where(m.broker_valid, jnp.arange(m.B), -1)))) + 1
-    )
+    p_real = int(np.asarray(m.partition_valid).sum())
+    bv = np.asarray(m.broker_valid)
+    b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
     pp = ProposalParams(
         p_real=p_real,
         b_real=b_real,
@@ -169,79 +177,18 @@ def greedy_optimize(
     )
 
     evac_np, n_evac_i = hot_partition_list(m, goal_names)
-    evac = jnp.asarray(evac_np)
-    n_evac = jnp.asarray(n_evac_i, jnp.int32)
-
-    state = init_search_state(m, cfg, goal_names, jax.random.PRNGKey(opts.seed))
-    cur = np.asarray(
-        _eval_vector(state.agg, state.part_sums, m, goal_names=goal_names, cfg=cfg)
+    state0 = init_search_state(m, cfg, goal_names, jax.random.PRNGKey(opts.seed))
+    state, n_iters, n_moves = _greedy_loop(
+        m,
+        state0,
+        jnp.asarray(evac_np),
+        jnp.asarray(n_evac_i, jnp.int32),
+        jax.random.PRNGKey(opts.seed + 1),
+        goal_names=goal_names,
+        cfg=cfg,
+        pp=pp,
+        opts=opts,
     )
-
-    key = jax.random.PRNGKey(opts.seed + 1)
-    n_moves = 0
-    stale = 0
-    it = 0
-    for it in range(opts.max_iters):
-        key, sub = jax.random.split(key)
-        ks = jax.random.split(sub, opts.n_candidates)
-        ps, news, feas, costs, parts = _score_candidates(
-            state, ks, m, evac, n_evac, goal_names=goal_names, cfg=cfg, pp=pp
-        )
-        costs_np = np.asarray(costs)
-        feas_np = np.asarray(feas)
-        ps_np = np.asarray(ps)
-
-        # feasible strict improvements vs the current vector, best first
-        improving = [
-            i for i in range(opts.n_candidates)
-            if feas_np[i] and _lex_better(costs_np[i], cur)
-        ]
-        if not improving:
-            stale += 1
-            if stale >= opts.patience:
-                break
-            continue
-        stale = 0
-        improving.sort(key=lambda i: tuple(costs_np[i]))
-
-        # take up to batch_moves candidates on distinct partitions; state
-        # composition is exact (agg re-derived per apply; part_sums composed
-        # from per-candidate deltas), only the predicted vector is stale
-        taken: list[int] = []
-        seen_p: set[int] = set()
-        for i in improving:
-            p = int(ps_np[i])
-            if p in seen_p:
-                continue
-            seen_p.add(p)
-            taken.append(i)
-            if len(taken) >= max(opts.batch_moves, 1):
-                break
-
-        prev_state, prev_cur = state, cur
-        orig_part = state.part_sums
-        for i in taken:
-            part_corr = state.part_sums + (parts[i] - orig_part)
-            state = _apply_move(
-                state, m, ps[i], news[0][i], news[1][i], news[2][i], part_corr
-            )
-        if len(taken) == 1:
-            cur = costs_np[taken[0]]
-        else:
-            cur = np.asarray(_eval_vector(
-                state.agg, state.part_sums, m, goal_names=goal_names, cfg=cfg
-            ))
-            if not _lex_better(cur, prev_cur):
-                # interacting moves regressed: fall back to the single best
-                state, cur = prev_state, prev_cur
-                i = taken[0]
-                state = _apply_move(
-                    state, m, ps[i], news[0][i], news[1][i], news[2][i],
-                    parts[i],
-                )
-                cur = costs_np[i]
-                taken = taken[:1]
-        n_moves += len(taken)
 
     result_model = with_placement(m, state)
     stack_after = evaluate_stack(result_model, cfg, goal_names)
@@ -249,6 +196,6 @@ def greedy_optimize(
         model=result_model,
         stack_before=stack_before,
         stack_after=stack_after,
-        n_moves=n_moves,
-        n_iters=it + 1,
+        n_moves=int(np.asarray(n_moves)),
+        n_iters=int(np.asarray(n_iters)),
     )

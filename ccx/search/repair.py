@@ -154,6 +154,7 @@ def _sweep(
     return new_assignment, new_replica_disk, n_moved
 
 
+@jax.jit
 def _leader_fix(m: TensorClusterModel, assignment, leader_slot):
     """Point leaders at an alive, non-excluded replica where possible."""
     valid = (assignment >= 0) & m.partition_valid[:, None]

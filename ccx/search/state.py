@@ -1,17 +1,31 @@
-"""Per-chain search state with O(R) incremental aggregate maintenance.
+"""Per-chain search state with O(R)-per-move incremental cost maintenance.
 
 The expensive part of scoring a candidate move is the broker aggregates
 (``ccx.model.aggregates``: one full pass is O(P*R)). A move only changes one
-partition's contribution, so search maintains the aggregates incrementally:
-*un-scatter* the partition's old contribution, *scatter* its new one — O(R)
-scatter-adds — then score the goal stack from the updated aggregates
-(O(B*RES + T*B)). This is the TPU-native analogue of the reference's
-``ClusterModel.relocateReplica``/``transferLeadership`` in-place load
-bookkeeping (SURVEY.md C1).
+partition's contribution, so search maintains everything incrementally — the
+TPU-native analogue of the reference's ``ClusterModel.relocateReplica`` /
+``transferLeadership`` in-place load bookkeeping (SURVEY.md C1):
 
-The four per-partition goals (ccx.goals.partition_terms.PARTITION_GOALS) are
-maintained as running sums the same way: subtract the old row's contribution,
-add the new row's.
+* **[B]-level aggregates** (broker_load, replica/leader counts, potential
+  nw-out, leader bytes-in, disk_load) — O(R) scatter-adds per move; goal
+  kernels re-score them in O(B) per candidate (small).
+* **[T, B] topic count matrices** — sparse cell updates only. Candidate
+  scoring NEVER materializes a per-candidate copy (the round-1 bottleneck:
+  ~0.5 GB of traffic per 256-candidate batch at B5 scale). Instead the two
+  topic goals' contributions are carried as exact scalar accumulators,
+  re-scored per move from only the ONE topic row the move touches
+  (``ccx.goals.topic_terms`` row functions — shared with the full kernels).
+* **per-partition goal sums** (``ccx.goals.partition_terms``) — row deltas.
+* **the full per-goal cost vector** — assembled exactly per candidate, so
+  acceptance can compare lexicographically (no tier-weight float32 blindness
+  for low tiers).
+
+Exactness: every accumulator (partition sums, topic deficit/penalty sums,
+topic totals) is integer-valued and therefore exact in float32 under
+incremental +/- updates; float drift is confined to broker_load-style sums,
+whose goal costs are recomputed (not accumulated) each move. Rejected moves
+apply all updates with weight 0 — a bit-exact no-op, so state never drifts on
+rejection.
 """
 
 from __future__ import annotations
@@ -22,6 +36,7 @@ from flax import struct
 
 from ccx.common.resources import Resource
 from ccx.goals import partition_terms as pt
+from ccx.goals import topic_terms as tt
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import soft_weights
 from ccx.model.aggregates import BrokerAggregates, broker_aggregates
@@ -32,30 +47,60 @@ from ccx.model.tensor_model import TensorClusterModel
 class SearchState:
     """Dynamic per-chain state. The static cluster attributes (loads,
     capacities, racks, masks) live in the TensorClusterModel the search was
-    started from; only placement changes during search."""
+    started from; only placement (and derived bookkeeping) changes."""
 
     assignment: jnp.ndarray    # int32[P, R]
     leader_slot: jnp.ndarray   # int32[P]
     replica_disk: jnp.ndarray  # int32[P, R]
     agg: BrokerAggregates
-    part_sums: jnp.ndarray     # float32[len(PARTITION_GOALS)]
-    hard_cost: jnp.ndarray     # f32 scalar
-    soft_cost: jnp.ndarray     # f32 scalar
+    part_sums: jnp.ndarray     # float32[len(pt.PARTITION_GOALS)] (exact ints)
+    topic_totals: jnp.ndarray  # float32[T] alive-broker replica totals (exact)
+    mtl_sum: jnp.ndarray       # f32 scalar — raw MinTopicLeaders deficit
+    trd_sum: jnp.ndarray       # f32 scalar — raw TopicReplicaDistribution pen
+    cost_vec: jnp.ndarray      # f32[G] — per-goal costs, priority order
     key: jnp.ndarray           # PRNG key
     n_accepted: jnp.ndarray    # int32 scalar
+    hard_mask: tuple[bool, ...] = struct.field(pytree_node=False)
+
+    @property
+    def hard_cost(self) -> jnp.ndarray:
+        mask = jnp.asarray(self.hard_mask)
+        return jnp.sum(jnp.where(mask, self.cost_vec, 0.0))
+
+    @property
+    def soft_cost(self) -> jnp.ndarray:
+        mask = jnp.asarray(self.hard_mask)
+        return jnp.sum(
+            jnp.where(mask, 0.0, self.cost_vec * soft_weights(self.hard_mask))
+        )
 
 
-def scatter_partition(
+@struct.dataclass
+class MoveDelta:
+    """Everything needed to accept a scored candidate move exactly."""
+
+    cost_vec: jnp.ndarray   # f32[G] — candidate state's full cost vector
+    part_sums: jnp.ndarray  # f32[4] — candidate partition-goal sums
+    d_mtl: jnp.ndarray      # f32 — raw MinTopicLeaders deficit delta
+    d_trd: jnp.ndarray      # f32 — raw TopicReplicaDistribution pen delta
+    d_total: jnp.ndarray    # f32 — topic(p) alive-replica-total delta
+
+
+def _scatter_broker_fields(
     agg: BrokerAggregates,
     m: TensorClusterModel,
-    p: jnp.ndarray,            # int32 scalar — partition index
-    assign_row: jnp.ndarray,   # int32[R]
-    leader_slot_p: jnp.ndarray,  # int32 scalar
-    disk_row: jnp.ndarray,     # int32[R]
-    w_f: jnp.ndarray,          # f32 scalar weight (+1 add, -1 remove, 0 no-op)
-    w_i: jnp.ndarray,          # int32 scalar weight
+    p: jnp.ndarray,
+    assign_row: jnp.ndarray,
+    leader_slot_p: jnp.ndarray,
+    disk_row: jnp.ndarray,
+    w_f: jnp.ndarray,
+    w_i: jnp.ndarray,
 ) -> BrokerAggregates:
-    """Scatter-add one partition's contribution (times weight) into agg."""
+    """Scatter-add one partition's contribution (times weight) into the
+    [B]-level aggregate fields, leaving the [T, B] matrices untouched —
+    candidate scoring updates only the cheap-to-copy [B]-level fields and
+    scores the topic goals from row deltas instead. Weight 0 is a bit-exact
+    no-op, which is how rejected moves avoid drift."""
     R = assign_row.shape[0]
     valid = (assign_row >= 0) & m.partition_valid[p]
     b = jnp.clip(assign_row, 0, m.B - 1)
@@ -71,12 +116,10 @@ def scatter_partition(
     vi = valid.astype(jnp.int32)
     li = is_lead.astype(jnp.int32)
     lf = is_lead.astype(jnp.float32)
-
-    t = m.partition_topic[p]
     d = jnp.clip(disk_row, 0, m.D - 1)
     disk_ok = valid & (disk_row >= 0)
 
-    return BrokerAggregates(
+    return agg.replace(
         broker_load=agg.broker_load.at[:, b].add(w_f * slot_load),
         replica_count=agg.replica_count.at[b].add(w_i * vi),
         leader_count=agg.leader_count.at[b].add(w_i * li),
@@ -86,12 +129,62 @@ def scatter_partition(
         leader_bytes_in=agg.leader_bytes_in.at[b].add(
             w_f * lead_load[Resource.NW_IN] * lf
         ),
-        topic_replica_count=agg.topic_replica_count.at[t, b].add(w_i * vi),
-        topic_leader_count=agg.topic_leader_count.at[t, b].add(w_i * li),
         disk_load=agg.disk_load.at[b, d].add(
             w_f * slot_load[Resource.DISK] * disk_ok.astype(jnp.float32)
         ),
     )
+
+
+def scatter_partition(
+    agg: BrokerAggregates,
+    m: TensorClusterModel,
+    p: jnp.ndarray,            # int32 scalar — partition index
+    assign_row: jnp.ndarray,   # int32[R]
+    leader_slot_p: jnp.ndarray,  # int32 scalar
+    disk_row: jnp.ndarray,     # int32[R]
+    w_f: jnp.ndarray,          # f32 scalar weight (+1 add, -1 remove, 0 no-op)
+    w_i: jnp.ndarray,          # int32 scalar weight
+) -> BrokerAggregates:
+    """Full weighted scatter: the [B]-level fields plus the sparse [T, B]
+    topic count cells. All updates touch <= 2R cells per array."""
+    R = assign_row.shape[0]
+    valid = (assign_row >= 0) & m.partition_valid[p]
+    b = jnp.clip(assign_row, 0, m.B - 1)
+    is_lead = (jnp.arange(R) == leader_slot_p) & valid
+    vi = valid.astype(jnp.int32)
+    li = is_lead.astype(jnp.int32)
+    t = m.partition_topic[p]
+
+    agg = _scatter_broker_fields(
+        agg, m, p, assign_row, leader_slot_p, disk_row, w_f, w_i
+    )
+    return agg.replace(
+        topic_replica_count=agg.topic_replica_count.at[t, b].add(w_i * vi),
+        topic_leader_count=agg.topic_leader_count.at[t, b].add(w_i * li),
+    )
+
+
+def topic_row_delta(
+    m: TensorClusterModel,
+    p: jnp.ndarray,
+    old: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    new: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(d_replica_count, d_leader_count) int32[B] — the move's delta to
+    topic(p)'s count rows."""
+    R = old[0].shape[0]
+
+    def contrib(assign_row, leader_slot_p, w):
+        valid = (assign_row >= 0) & m.partition_valid[p]
+        b = jnp.clip(assign_row, 0, m.B - 1)
+        is_lead = (jnp.arange(R) == leader_slot_p) & valid
+        drc = jnp.zeros(m.B, jnp.int32).at[b].add(w * valid.astype(jnp.int32))
+        dlc = jnp.zeros(m.B, jnp.int32).at[b].add(w * is_lead.astype(jnp.int32))
+        return drc, dlc
+
+    drc_o, dlc_o = contrib(old[0], old[1], -1)
+    drc_n, dlc_n = contrib(new[0], new[1], 1)
+    return drc_o + drc_n, dlc_o + dlc_n
 
 
 def partition_row_sums(
@@ -111,20 +204,14 @@ def partition_row_sums(
     )
 
 
-def make_goal_vector_fn(
-    m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig
-):
-    """Build ``(agg, part_sums) -> costs f32[G]`` in goal-priority order.
+#: KafkaAssignerEvenRackAwareGoal (SURVEY.md C19) decomposes into the
+#: incrementally-maintained RackAwareGoal sum + an aggregate-side
+#: leader-evenness term, so it is searchable without its own slot.
+DECOMPOSED = {"KafkaAssignerEvenRackAwareGoal"}
 
-    Aggregate-based goals are the registered kernels evaluated against the
-    *static* model attributes + the live aggregates; per-partition goals read
-    the incrementally-maintained sums.
-    """
+
+def check_searchable(goal_names: tuple[str, ...]) -> None:
     part_idx = {n: i for i, n in enumerate(pt.PARTITION_GOALS)}
-    # KafkaAssignerEvenRackAwareGoal (SURVEY.md C19) decomposes into the
-    # incrementally-maintained RackAwareGoal sum + an aggregate-side
-    # leader-evenness term, so it is searchable without its own slot.
-    DECOMPOSED = {"KafkaAssignerEvenRackAwareGoal"}
     for name in goal_names:
         if (
             GOAL_REGISTRY[name].placement_dependent
@@ -137,8 +224,39 @@ def make_goal_vector_fn(
                 "(add it to partition_terms.PARTITION_GOALS or evaluate "
                 "it via evaluate_stack only)"
             )
-    def vector_fn(agg: BrokerAggregates, part_sums: jnp.ndarray) -> jnp.ndarray:
-        # PreferredLeaderElectionGoal's kernel cost is violations/n_partitions;
+
+
+def _kaera_evenness(m: TensorClusterModel, leader_count: jnp.ndarray) -> jnp.ndarray:
+    """Leader-evenness half of KafkaAssignerEvenRackAwareGoal's cost (same
+    math as the full kernel in ccx.goals.kernels)."""
+    alive = m.broker_valid & m.broker_alive
+    n_alive = jnp.maximum(jnp.sum(alive).astype(jnp.float32), 1.0)
+    avg = jnp.sum(leader_count).astype(jnp.float32) / n_alive
+    upper = jnp.ceil(avg)
+    over = jnp.where(alive, jnp.maximum(leader_count - upper, 0.0), 0.0)
+    return jnp.sum(over) / jnp.maximum(avg, 1e-9)
+
+
+def make_cost_vector_fn(
+    m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig
+):
+    """Build ``(agg, part_sums, mtl_sum, trd_sum, trd_norm) -> costs f32[G]``.
+
+    Topic-goal entries come from the exact scalar accumulators; every other
+    aggregate goal re-scores its kernel against the (cheap) [B]-level fields.
+    The [T, B] matrices inside ``agg`` are never read here.
+    """
+    check_searchable(goal_names)
+    part_idx = {n: i for i, n in enumerate(pt.PARTITION_GOALS)}
+
+    def vector_fn(
+        agg: BrokerAggregates,
+        part_sums: jnp.ndarray,
+        mtl_sum: jnp.ndarray,
+        trd_sum: jnp.ndarray,
+        trd_norm: jnp.ndarray,
+    ) -> jnp.ndarray:
+        # PreferredLeaderElectionGoal's kernel cost is violations/n_leaders;
         # the leader total from agg equals the valid-partition count and stays
         # correct under partition-axis sharding (psum'd agg, ccx.parallel).
         inv_np = 1.0 / jnp.maximum(
@@ -150,18 +268,13 @@ def make_goal_vector_fn(
                 c = part_sums[part_idx[name]]
                 if name == "PreferredLeaderElectionGoal":
                     c = c * inv_np
+            elif name == "MinTopicLeadersPerBrokerGoal":
+                c = mtl_sum
+            elif name == "TopicReplicaDistributionGoal":
+                c = trd_sum / trd_norm
             elif name == "KafkaAssignerEvenRackAwareGoal":
-                # rack part from the incremental sum; leader-evenness from
-                # the live aggregates (same math as the full kernel)
-                alive = m.broker_valid & m.broker_alive
-                n_alive = jnp.maximum(jnp.sum(alive).astype(jnp.float32), 1.0)
-                avg = jnp.sum(agg.leader_count).astype(jnp.float32) / n_alive
-                upper = jnp.ceil(avg)
-                over = jnp.where(
-                    alive, jnp.maximum(agg.leader_count - upper, 0.0), 0.0
-                )
-                c = part_sums[part_idx["RackAwareGoal"]] + jnp.sum(over) / (
-                    jnp.maximum(avg, 1e-9)
+                c = part_sums[part_idx["RackAwareGoal"]] + _kaera_evenness(
+                    m, agg.leader_count
                 )
             else:
                 c = GOAL_REGISTRY[name].fn(m, agg, cfg).cost
@@ -171,24 +284,115 @@ def make_goal_vector_fn(
     return vector_fn
 
 
-def make_cost_fn(m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig):
-    """Build ``(agg, part_sums) -> (hard_cost, soft_cost)`` for a goal stack.
+def make_move_scorer(
+    m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig
+):
+    """Build ``score(state, p, old_rows, new_rows) -> MoveDelta``.
 
-    Priority semantics follow ccx.goals.stack: hard goals sum into hard_cost,
-    soft goals are tier-weighted into soft_cost (SURVEY.md section 7.4).
+    Per move this touches: O(R) scatter cells on the [B]-level aggregates,
+    ONE [B] row of each [T, B] matrix (gathered, never copied per candidate),
+    and O(B) kernel re-scores — independent of P and T.
     """
-    hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
-    weights = soft_weights(hard_mask)
-    vector_fn = make_goal_vector_fn(m, goal_names, cfg)
+    vector_fn = make_cost_vector_fn(m, goal_names, cfg)
+    needs_topic = bool(
+        set(goal_names) & {"MinTopicLeadersPerBrokerGoal", "TopicReplicaDistributionGoal"}
+    )
+    T = m.num_topics
 
-    def cost_fn(agg: BrokerAggregates, part_sums: jnp.ndarray):
-        cv = vector_fn(agg, part_sums)
-        hmask = jnp.asarray(hard_mask)
-        hard = jnp.sum(jnp.where(hmask, cv, 0.0))
-        soft = jnp.sum(jnp.where(hmask, 0.0, cv * weights))
-        return hard, soft
+    def score(
+        state: SearchState,
+        p: jnp.ndarray,
+        old: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+        new: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    ) -> MoveDelta:
+        agg1 = _scatter_broker_fields(
+            state.agg, m, p, *old, jnp.float32(-1), jnp.int32(-1)
+        )
+        agg2 = _scatter_broker_fields(agg1, m, p, *new, jnp.float32(1), jnp.int32(1))
+        part_new = (
+            state.part_sums
+            - partition_row_sums(m, p, *old)
+            + partition_row_sums(m, p, *new)
+        )
 
-    return cost_fn
+        zero = jnp.float32(0.0)
+        if needs_topic:
+            t = m.partition_topic[p]
+            drc, dlc = topic_row_delta(m, p, old, new)
+            trc_row = state.agg.topic_replica_count[t]
+            tlc_row = state.agg.topic_leader_count[t]
+            new_trc = trc_row + drc
+            new_tlc = tlc_row + dlc
+            flagged = m.topic_min_leaders[t]
+            d_mtl = tt.mtl_row(m, cfg, flagged, new_tlc) - tt.mtl_row(
+                m, cfg, flagged, tlc_row
+            )
+            pen_new, _ = tt.trd_row_pen(m, cfg, new_trc)
+            pen_old, _ = tt.trd_row_pen(m, cfg, trc_row)
+            d_trd = pen_new - pen_old
+            total_old = tt.trd_row_total(m, trc_row)
+            total_new = tt.trd_row_total(m, new_trc)
+            d_total = total_new - total_old
+            # normalizer shift: only topic t's avg term changes
+            n_alive = jnp.maximum(
+                jnp.sum(m.broker_valid & m.broker_alive), 1
+            ).astype(jnp.float32)
+            norm_old = tt.trd_normalizer(m, state.topic_totals)
+            norm_new = norm_old + (
+                jnp.maximum(total_new / n_alive, 1.0)
+                - jnp.maximum(total_old / n_alive, 1.0)
+            ) / jnp.float32(T)
+            norm_new = jnp.where(norm_new > 0, norm_new, 1.0)
+        else:
+            d_mtl = d_trd = d_total = zero
+            norm_new = jnp.float32(1.0)
+
+        cost_vec = vector_fn(
+            agg2, part_new, state.mtl_sum + d_mtl, state.trd_sum + d_trd, norm_new
+        )
+        return MoveDelta(
+            cost_vec=cost_vec,
+            part_sums=part_new,
+            d_mtl=d_mtl,
+            d_trd=d_trd,
+            d_total=d_total,
+        )
+
+    return score
+
+
+def apply_move(
+    state: SearchState,
+    m: TensorClusterModel,
+    p: jnp.ndarray,
+    old: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    new: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    delta: MoveDelta,
+    accept: jnp.ndarray,   # bool scalar
+) -> SearchState:
+    """Apply a scored move iff ``accept`` — reject is a bit-exact no-op
+    (all scatters run with weight 0; integer accumulators add 0)."""
+    af = accept.astype(jnp.float32)
+    ai = accept.astype(jnp.int32)
+    agg = scatter_partition(state.agg, m, p, *old, -af, -ai)
+    agg = scatter_partition(agg, m, p, *new, af, ai)
+    t = m.partition_topic[p]
+
+    def sel(n, o):
+        return jnp.where(accept, n, o)
+
+    return state.replace(
+        assignment=state.assignment.at[p].set(sel(new[0], old[0])),
+        leader_slot=state.leader_slot.at[p].set(sel(new[1], old[1])),
+        replica_disk=state.replica_disk.at[p].set(sel(new[2], old[2])),
+        agg=agg,
+        part_sums=sel(delta.part_sums, state.part_sums),
+        topic_totals=state.topic_totals.at[t].add(af * delta.d_total),
+        mtl_sum=state.mtl_sum + af * delta.d_mtl,
+        trd_sum=state.trd_sum + af * delta.d_trd,
+        cost_vec=sel(delta.cost_vec, state.cost_vec),
+        n_accepted=state.n_accepted + ai,
+    )
 
 
 def init_search_state(
@@ -197,22 +401,34 @@ def init_search_state(
     goal_names: tuple[str, ...],
     key: jnp.ndarray,
 ) -> SearchState:
-    """Full (non-incremental) evaluation of the starting state."""
+    """Full (non-incremental) evaluation of the starting state. The cost
+    vector is assembled through the same row functions the incremental path
+    uses, so deltas can never drift from the initial evaluation semantics."""
     agg = broker_aggregates(m)
     part_sums = pt.partition_sums(
         m, m.assignment, m.leader_slot, m.replica_disk, m.partition_valid
     )
-    hard, soft = make_cost_fn(m, goal_names, cfg)(agg, part_sums)
+    mtl_sum = jnp.sum(tt.mtl_row(m, cfg, m.topic_min_leaders, agg.topic_leader_count))
+    pen, _ = tt.trd_row_pen(m, cfg, agg.topic_replica_count)
+    trd_sum = jnp.sum(pen)
+    topic_totals = tt.trd_row_total(m, agg.topic_replica_count)
+    trd_norm = tt.trd_normalizer(m, topic_totals)
+    cost_vec = make_cost_vector_fn(m, goal_names, cfg)(
+        agg, part_sums, mtl_sum, trd_sum, trd_norm
+    )
     return SearchState(
         assignment=m.assignment,
         leader_slot=m.leader_slot,
         replica_disk=m.replica_disk,
         agg=agg,
         part_sums=part_sums,
-        hard_cost=hard,
-        soft_cost=soft,
+        topic_totals=topic_totals,
+        mtl_sum=mtl_sum,
+        trd_sum=trd_sum,
+        cost_vec=cost_vec,
         key=key,
         n_accepted=jnp.asarray(0, jnp.int32),
+        hard_mask=tuple(GOAL_REGISTRY[n].hard for n in goal_names),
     )
 
 

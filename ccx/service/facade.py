@@ -522,9 +522,11 @@ class CruiseControl:
         }
 
     def load(self) -> dict:
-        """Ref LOAD endpoint: per-broker resource utilization."""
+        """Ref LOAD endpoint: per-broker resource utilization + the
+        ClusterModelStats block (SURVEY.md C4)."""
         model, metadata, gen = self._model()
         from ccx.model.aggregates import broker_aggregates
+        from ccx.model.stats import cluster_model_stats
         import numpy as np
 
         agg = broker_aggregates(model)
@@ -548,7 +550,11 @@ class CruiseControl:
                     ),
                 }
             )
-        return {"brokers": out, "modelGeneration": str(gen)}
+        return {
+            "brokers": out,
+            "modelGeneration": str(gen),
+            **cluster_model_stats(model, agg).to_json(),
+        }
 
     def partition_load(self, max_entries: int = 100, resource: str = "CPU",
                        topic: str = "") -> dict:

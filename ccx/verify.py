@@ -51,12 +51,14 @@ def verify_model_consistency(m: TensorClusterModel) -> list[str]:
     if refs.size and not bvalid[refs].all():
         failures.append("replica assigned to an invalid (padding) broker")
 
-    # distinct brokers within each replica set
-    for p in np.nonzero(pvalid)[0]:
-        row = a[p][a[p] >= 0]
-        if len(set(row.tolist())) != len(row):
-            failures.append(f"partition {p}: duplicate broker in replica set")
-            break
+    # distinct brokers within each replica set (vectorized: key invalid slots
+    # to unique negatives, sort rows, look for equal neighbours)
+    keyed = np.where(a >= 0, a, -1 - np.arange(m.R)[None, :])
+    srt = np.sort(keyed, axis=1)
+    dup_rows = pvalid & np.any((srt[:, 1:] == srt[:, :-1]) & (srt[:, 1:] >= 0), axis=1)
+    if dup_rows.any():
+        p = int(np.nonzero(dup_rows)[0][0])
+        failures.append(f"partition {p}: duplicate broker in replica set")
 
     # leader slot points at a live replica slot
     lp = leader[pvalid]
@@ -163,21 +165,40 @@ def _verify_proposals(
     l1 = np.asarray(after.leader_slot)
     d0 = np.asarray(before.replica_disk)
     d1 = np.asarray(after.replica_disk)
-    by_p = {pr.partition: pr for pr in proposals}
-    for pr in proposals:
-        p = pr.partition
-        if tuple(b for b in a0[p] if b >= 0) != pr.old_replicas:
-            failures.append(f"proposal {p}: old replicas mismatch")
-        if tuple(b for b in a1[p] if b >= 0) != pr.new_replicas:
-            failures.append(f"proposal {p}: new replicas mismatch")
+
+    # Vectorized replica-list comparison: replica slots are left-packed
+    # (absent slots trail as -1), so a proposal's padded replica list must
+    # equal the assignment row verbatim.
+    n = len(proposals)
+    R = a0.shape[1]
+    idx = np.empty(n, np.int64)
+    oldr = np.full((n, R), -1, np.int32)
+    newr = np.full((n, R), -1, np.int32)
+    for i, pr in enumerate(proposals):
+        idx[i] = pr.partition
+        oldr[i, : len(pr.old_replicas)] = pr.old_replicas
+        newr[i, : len(pr.new_replicas)] = pr.new_replicas
+    bad_old = np.any(a0[idx] != oldr, axis=1)
+    bad_new = np.any(a1[idx] != newr, axis=1)
+    if bad_old.any():
+        failures.append(
+            f"proposal {int(idx[np.nonzero(bad_old)[0][0]])}: old replicas mismatch"
+        )
+    if bad_new.any():
+        failures.append(
+            f"proposal {int(idx[np.nonzero(bad_new)[0][0]])}: new replicas mismatch"
+        )
 
     # every changed partition must be covered by a proposal
     pvalid = np.asarray(before.partition_valid)
     changed = pvalid & (
         np.any(a0 != a1, axis=1) | (l0 != l1) | np.any(d0 != d1, axis=1)
     )
-    for p in np.nonzero(changed)[0]:
-        if int(p) not in by_p:
-            failures.append(f"changed partition {p} missing from proposals")
-            break
+    covered = np.zeros(changed.shape[0], bool)
+    covered[idx] = True
+    missing = changed & ~covered
+    if missing.any():
+        failures.append(
+            f"changed partition {int(np.nonzero(missing)[0][0])} missing from proposals"
+        )
     return failures

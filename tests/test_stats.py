@@ -1,0 +1,104 @@
+"""ClusterModelStats tests — hand-computed fixture values (SURVEY.md C4).
+
+Fixture: ccx.model.fixtures.small_deterministic —
+  partitions A-0 (brokers 0,1; leader 0), A-1 (1,2; leader 1),
+             B-0 (0,1,2; leader 0)
+  leader CPU [20, 10, 5]; follower CPU is half; follower NW_OUT is 0.
+Per-broker derived by hand:
+  CPU load:        b0 = 20+5 = 25, b1 = 10+10+2.5 = 22.5, b2 = 5+2.5 = 7.5
+  replicas:        [2, 3, 2];  leaders: [2, 1, 0]
+  potential nwOut: b0 = 80+10 = 90, b1 = 80+40+10 = 130, b2 = 40+10 = 50
+  topic counts:    A -> [1, 2, 1], B -> [1, 1, 1]
+"""
+
+import numpy as np
+import pytest
+
+from ccx.model.fixtures import small_deterministic
+from ccx.model.stats import STAT_KEYS, balancedness_score, cluster_model_stats
+
+
+@pytest.fixture(scope="module")
+def stats():
+    return cluster_model_stats(small_deterministic())
+
+
+def test_metadata(stats):
+    assert stats.n_brokers == 3
+    assert stats.n_replicas == 7
+    assert stats.n_topics == 2
+    assert stats.n_partitions == 3
+
+
+def test_cpu_stats(stats):
+    cpu = np.array([25.0, 22.5, 7.5])
+    np.testing.assert_allclose(stats.avg["cpu"], cpu.mean(), rtol=1e-6)
+    np.testing.assert_allclose(stats.std["cpu"], cpu.std(), rtol=1e-6)
+    np.testing.assert_allclose(stats.min["cpu"], 7.5, rtol=1e-6)
+    np.testing.assert_allclose(stats.max["cpu"], 25.0, rtol=1e-6)
+
+
+def test_replica_distribution_stats(stats):
+    repl = np.array([2.0, 3.0, 2.0])
+    np.testing.assert_allclose(stats.avg["replicas"], repl.mean(), rtol=1e-6)
+    np.testing.assert_allclose(stats.std["replicas"], repl.std(), rtol=1e-6)
+    lead = np.array([2.0, 1.0, 0.0])
+    np.testing.assert_allclose(stats.avg["leaderReplicas"], lead.mean(), rtol=1e-6)
+    np.testing.assert_allclose(stats.std["leaderReplicas"], lead.std(), rtol=1e-6)
+
+
+def test_potential_nw_out_stats(stats):
+    pot = np.array([90.0, 130.0, 50.0])
+    np.testing.assert_allclose(stats.avg["potentialNwOut"], pot.mean(), rtol=1e-6)
+    np.testing.assert_allclose(stats.std["potentialNwOut"], pot.std(), rtol=1e-6)
+
+
+def test_topic_replica_stats(stats):
+    # per-topic across brokers: A=[1,2,1] (std 0.4714), B=[1,1,1] (std 0)
+    a = np.array([1.0, 2.0, 1.0])
+    np.testing.assert_allclose(
+        stats.avg["topicReplicas"], (a.mean() + 1.0) / 2, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        stats.std["topicReplicas"], a.std() / 2, rtol=1e-6
+    )
+
+
+def test_json_shape(stats):
+    j = stats.to_json()
+    assert set(j) == {"metadata", "statistics"}
+    for block in ("AVG", "STD", "MIN", "MAX"):
+        assert set(j["statistics"][block]) == set(STAT_KEYS)
+
+
+def test_balancedness_score_bounds(stats):
+    s = balancedness_score(stats)
+    assert 0.0 < s < 100.0
+
+
+def test_optimizer_result_carries_stats():
+    from ccx.goals.base import GoalConfig
+    from ccx.model.fixtures import RandomClusterSpec, random_cluster
+    from ccx.optimizer import OptimizeOptions, optimize
+    from ccx.search.annealer import AnnealOptions
+    from ccx.search.greedy import GreedyOptions
+
+    m = random_cluster(
+        RandomClusterSpec(n_brokers=6, n_racks=3, n_topics=4, n_partitions=48, seed=7)
+    )
+    res = optimize(
+        m,
+        GoalConfig(),
+        ("StructuralFeasibility", "RackAwareGoal", "ReplicaDistributionGoal"),
+        OptimizeOptions(
+            anneal=AnnealOptions(n_chains=4, n_steps=300, seed=1),
+            polish=GreedyOptions(n_candidates=64, max_iters=20, patience=4),
+        ),
+    )
+    j = res.to_json()
+    assert "clusterModelStats" in j
+    before = j["clusterModelStats"]["before"]["statistics"]
+    after = j["clusterModelStats"]["after"]["statistics"]
+    assert before["STD"]["replicas"] >= after["STD"]["replicas"] - 1e-9
+    assert 0 < j["onDemandBalancednessScoreBefore"] <= 100
+    assert 0 < j["onDemandBalancednessScoreAfter"] <= 100
