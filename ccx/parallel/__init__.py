@@ -3,6 +3,7 @@ from ccx.parallel.sharding import (
     model_pspecs,
     replicate,
     shard_model,
+    sharded_anneal,
     sharded_stack_eval,
 )
 
@@ -11,5 +12,6 @@ __all__ = [
     "model_pspecs",
     "replicate",
     "shard_model",
+    "sharded_anneal",
     "sharded_stack_eval",
 ]

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ccx.goals import partition_terms as pt
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult
-from ccx.model.aggregates import broker_aggregates
+from ccx.model.aggregates import BrokerAggregates, broker_aggregates
 from ccx.model.tensor_model import TensorClusterModel
 
 CHAINS_AXIS = "chains"
@@ -114,19 +114,18 @@ def sharded_stack_eval(
     aggregates and per-partition goal sums; one ``psum`` over the ``parts``
     axis yields globals; goal kernels then score the (replicated) broker-axis
     state. Numerically identical to ``ccx.goals.stack.evaluate_stack`` up to
-    float reduction order.
+    float reduction order. Accepts every searchable stack, including the
+    kafka-assigner mode's decomposed KafkaAssignerEvenRackAwareGoal
+    (SURVEY.md C19) — same decomposition as ccx.search.state.
     """
     if mesh is None:
         mesh = make_mesh()
+    from ccx.search.state import check_searchable
+
     specs = model_pspecs(m)
     hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
     part_idx = {n: i for i, n in enumerate(pt.PARTITION_GOALS)}
-    for name in goal_names:
-        if GOAL_REGISTRY[name].placement_dependent and name not in part_idx:
-            raise ValueError(
-                f"goal {name} reads per-partition placement and has no "
-                "partition_terms row function; it cannot be shard-evaluated"
-            )
+    check_searchable(goal_names)
 
     def body(m_local: TensorClusterModel):
         agg = jax.tree.map(
@@ -150,6 +149,20 @@ def sharded_stack_eval(
             if name in part_idx:
                 v = psums[part_idx[name]]
                 c = v * inv_np if name == "PreferredLeaderElectionGoal" else v
+            elif name == "KafkaAssignerEvenRackAwareGoal":
+                # rack half from the psummed row sums; leader-evenness half
+                # from the (already global) aggregates — the full kernel's
+                # math on sharded inputs (ccx.search.state decomposition)
+                alive = m_local.broker_valid & m_local.broker_alive
+                n_alive = jnp.maximum(jnp.sum(alive).astype(jnp.float32), 1.0)
+                avg = jnp.sum(agg.leader_count).astype(jnp.float32) / n_alive
+                upper = jnp.ceil(avg)
+                over = jnp.where(
+                    alive, jnp.maximum(agg.leader_count - upper, 0.0), 0.0
+                )
+                rack = psums[part_idx["RackAwareGoal"]]
+                v = rack + jnp.sum(over > 0).astype(jnp.float32)
+                c = rack + jnp.sum(over) / jnp.maximum(avg, 1e-9)
             else:
                 r = GOAL_REGISTRY[name].fn(m_local, agg, cfg)
                 v, c = r.violations, r.cost
@@ -166,4 +179,265 @@ def sharded_stack_eval(
         hard_mask=hard_mask,
         violations=violations,
         costs=costs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Partition-axis-sharded simulated annealing
+# ---------------------------------------------------------------------------
+
+def _mask_view(view, owned):
+    """Zero a PartitionView's contribution on non-owner shards so a psum
+    reconstructs the owner's values."""
+
+    def mask(x):
+        if x.dtype == jnp.bool_:
+            return x & owned
+        return x * owned.astype(x.dtype)
+
+    return jax.tree.map(mask, view)
+
+
+def _psum_tree(tree, axis):
+    def red(x):
+        if x.dtype == jnp.bool_:
+            return jax.lax.psum(x.astype(jnp.int32), axis) > 0
+        return jax.lax.psum(x, axis)
+
+    return jax.tree.map(red, tree)
+
+
+def sharded_anneal(
+    m: TensorClusterModel,
+    cfg: GoalConfig = GoalConfig(),
+    goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
+    opts=None,
+    mesh: Mesh | None = None,
+):
+    """Batched SA with the model's partition axis sharded inside the search
+    (SURVEY.md section 5.7, the long-context analogue): model tensors stay
+    sharded over ``parts`` for the whole run — they are never replicated —
+    while chains ride the ``chains`` axis as data parallelism.
+
+    Per proposal, the shard owning the drawn partition gathers its
+    PartitionView locally and one ``psum`` over ICI broadcasts it (O(R)
+    scalars — the only per-step collective); every shard then scores and
+    accepts identically (replicated RNG), and only the owner writes the
+    placement row. Aggregates/accumulators are replicated per chain and
+    updated identically everywhere, so no resynchronization is ever needed.
+
+    Semantics match ``ccx.search.anneal`` (same RNG stream, same acceptance
+    rule); results can differ only by float reduction order in the initial
+    psummed aggregates.
+    """
+    import dataclasses as _dc
+
+    from ccx.goals.stack import evaluate_stack, soft_weights
+    from ccx.search.annealer import (
+        RACK_TARGET_GOALS,
+        AnnealOptions,
+        AnnealResult,
+        ProposalParams,
+        _anneal_step,
+        allows_inter_broker,
+        best_chain_index,
+        hot_partition_list,
+    )
+    from ccx.search.state import (
+        PartitionView,
+        SearchState,
+        make_cost_vector_fn,
+        make_move_scorer,
+        with_placement,
+    )
+    from ccx.goals import topic_terms as tt_
+
+    if opts is None:
+        opts = AnnealOptions()
+    if mesh is None:
+        mesh = make_mesh()
+    n_parts = mesh.shape[PARTS_AXIS]
+    n_chain_ranks = mesh.shape[CHAINS_AXIS]
+    if m.P % n_parts:
+        raise ValueError(f"padded P={m.P} not divisible by parts={n_parts}")
+    if opts.n_chains % n_chain_ranks:
+        raise ValueError(
+            f"n_chains={opts.n_chains} not divisible by chains axis "
+            f"{n_chain_ranks}"
+        )
+
+    stack_before = evaluate_stack(m, cfg, goal_names)
+    p_real = int(np.asarray(m.partition_valid).sum())
+    bv = np.asarray(m.broker_valid)
+    b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
+    evac_np, n_evac_i = hot_partition_list(m, goal_names)
+
+    hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
+    pp = ProposalParams(
+        p_real=p_real,
+        b_real=b_real,
+        p_leadership=opts.p_leadership,
+        p_disk=opts.p_disk,
+        p_biased_dest=opts.p_biased_dest,
+        p_evac=opts.p_evac,
+        target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
+        allow_inter=allows_inter_broker(goal_names),
+    )
+
+    m_sharded = shard_model(m, mesh)
+    keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
+    keys = jax.device_put(keys, NamedSharding(mesh, P(CHAINS_AXIS, None)))
+    evac = jax.device_put(jnp.asarray(evac_np), NamedSharding(mesh, P()))
+    n_evac = jax.device_put(
+        jnp.asarray(n_evac_i, jnp.int32), NamedSharding(mesh, P())
+    )
+
+    mspecs = model_pspecs(m)
+    state_specs = SearchState(
+        assignment=P(CHAINS_AXIS, PARTS_AXIS, None),
+        leader_slot=P(CHAINS_AXIS, PARTS_AXIS),
+        replica_disk=P(CHAINS_AXIS, PARTS_AXIS, None),
+        agg=BrokerAggregates(
+            broker_load=P(CHAINS_AXIS, None, None),
+            replica_count=P(CHAINS_AXIS, None),
+            leader_count=P(CHAINS_AXIS, None),
+            potential_nw_out=P(CHAINS_AXIS, None),
+            leader_bytes_in=P(CHAINS_AXIS, None),
+            topic_replica_count=P(CHAINS_AXIS, None, None),
+            topic_leader_count=P(CHAINS_AXIS, None, None),
+            disk_load=P(CHAINS_AXIS, None, None),
+        ),
+        part_sums=P(CHAINS_AXIS, None),
+        topic_totals=P(CHAINS_AXIS, None),
+        mtl_sum=P(CHAINS_AXIS),
+        trd_sum=P(CHAINS_AXIS),
+        cost_vec=P(CHAINS_AXIS, None),
+        key=P(CHAINS_AXIS, None),
+        n_accepted=P(CHAINS_AXIS),
+        hard_mask=hard_mask,
+    )
+
+    import functools as _ft
+
+    @_ft.partial(jax.jit, static_argnames=())
+    def run(m_s, keys_s, evac_s, n_evac_s):
+        def body(m_local, keys_local, evac_l, n_evac_l):
+            P_local = m_local.assignment.shape[0]
+            offset = jax.lax.axis_index(PARTS_AXIS) * P_local
+
+            # ---- init: partial sums + psum -> replicated bookkeeping ------
+            agg = _psum_tree(broker_aggregates(m_local), PARTS_AXIS)
+            part_sums = jax.lax.psum(
+                pt.partition_sums(
+                    m_local,
+                    m_local.assignment,
+                    m_local.leader_slot,
+                    m_local.replica_disk,
+                    m_local.partition_valid,
+                ),
+                PARTS_AXIS,
+            )
+            mtl_sum = jnp.sum(
+                tt_.mtl_row(
+                    m_local, cfg, m_local.topic_min_leaders, agg.topic_leader_count
+                )
+            )
+            pen, _ = tt_.trd_row_pen(m_local, cfg, agg.topic_replica_count)
+            trd_sum = jnp.sum(pen)
+            topic_totals = tt_.trd_row_total(m_local, agg.topic_replica_count)
+            trd_norm = tt_.trd_normalizer(m_local, topic_totals)
+            cost_vec = make_cost_vector_fn(m_local, goal_names, cfg)(
+                agg, part_sums, mtl_sum, trd_sum, trd_norm
+            )
+            state0 = SearchState(
+                assignment=m_local.assignment,
+                leader_slot=m_local.leader_slot,
+                replica_disk=m_local.replica_disk,
+                agg=agg,
+                part_sums=part_sums,
+                topic_totals=topic_totals,
+                mtl_sum=mtl_sum,
+                trd_sum=trd_sum,
+                cost_vec=cost_vec,
+                key=keys_local[0],
+                n_accepted=jnp.asarray(0, jnp.int32),
+                hard_mask=hard_mask,
+            )
+            states = jax.vmap(lambda k: state0.replace(key=k))(keys_local)
+
+            # ---- sharding hooks ------------------------------------------
+            def gather(ss, _m, p):
+                li = jnp.clip(p - offset, 0, P_local - 1)
+                owned = (p >= offset) & (p < offset + P_local)
+                view_local = PartitionView(
+                    pvalid=m_local.partition_valid[li] & owned,
+                    immovable=m_local.partition_immovable[li] & owned,
+                    topic=m_local.partition_topic[li],
+                    lead_load=jax.lax.dynamic_slice_in_dim(
+                        m_local.leader_load, li, 1, axis=1
+                    )[:, 0],
+                    foll_load=jax.lax.dynamic_slice_in_dim(
+                        m_local.follower_load, li, 1, axis=1
+                    )[:, 0],
+                    assign=ss.assignment[li],
+                    leader=ss.leader_slot[li],
+                    disk=ss.replica_disk[li],
+                )
+                return _psum_tree(_mask_view(view_local, owned), PARTS_AXIS)
+
+            def locate(p):
+                owned = (p >= offset) & (p < offset + P_local)
+                return jnp.clip(p - offset, 0, P_local - 1), owned
+
+            scorer = make_move_scorer(m_local, goal_names, cfg)
+            hard_arr = jnp.asarray(hard_mask)
+            weights = soft_weights(hard_mask)
+            n = max(opts.n_steps, 1)
+            decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
+            step = _ft.partial(
+                _anneal_step,
+                m=m_local,
+                scorer=scorer,
+                pp=pp,
+                hard_arr=hard_arr,
+                weights=weights,
+                moves_per_step=max(opts.moves_per_step, 1),
+                gather=gather,
+                locate=locate,
+            )
+
+            def scan_body(ss, t):
+                temp = opts.t0 * decay**t
+                ss = jax.vmap(step, in_axes=(0, None, None, None, None))(
+                    ss, temp, t, evac_l, n_evac_l
+                )
+                return ss, None
+
+            states, _ = jax.lax.scan(scan_body, states, jnp.arange(n))
+            return states
+
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(mspecs, P(CHAINS_AXIS, None), P(), P()),
+            out_specs=state_specs,
+            # the scan carry mixes axis-invariant init values with
+            # axis-varying updates; skip the varying-manual-axes check
+            check_vma=False,
+        )(m_s, keys_s, evac_s, n_evac_s)
+
+    states = run(m_sharded, keys, evac, n_evac)
+
+    best = best_chain_index(np.asarray(states.cost_vec))
+    pick = jax.tree.map(lambda a: a[best], states)
+    result_model = with_placement(m_sharded, pick)
+    stack_after = evaluate_stack(result_model, cfg, goal_names)
+    return AnnealResult(
+        model=result_model,
+        stack_before=stack_before,
+        stack_after=stack_after,
+        n_accepted=int(np.asarray(pick.n_accepted)),
+        n_chains=opts.n_chains,
+        n_steps=opts.n_steps,
+        best_chain=best,
     )

@@ -37,6 +37,7 @@ from ccx.model.tensor_model import TensorClusterModel
 from ccx.search.state import (
     SearchState,
     apply_move,
+    gather_view,
     init_search_state,
     make_move_scorer,
     with_placement,
@@ -180,15 +181,22 @@ def propose_move(
     pp: ProposalParams,
     evac: jnp.ndarray | None = None,
     n_evac: jnp.ndarray | None = None,
+    gather=None,
 ):
-    """Draw one candidate move: returns (p, old rows, new rows, feasible).
+    """Draw one candidate move: returns (p, view, old rows, new rows,
+    feasible).
 
     Feasibility masking mirrors the reference's per-goal requirements checks
     (never *create* structural violations): destination must be alive, valid,
     not replica-excluded, not already hosting the partition; leadership may
     only land on alive, non-leadership-excluded brokers; excluded
     (immovable) partitions are untouchable (OptimizationOptions,
-    SURVEY.md C20)."""
+    SURVEY.md C20).
+
+    ``gather(state, p) -> PartitionView`` overrides the local view gather —
+    the partition-axis-sharded search (ccx.parallel) supplies an owner-gather
+    + psum; the RNG draws are replicated, so every shard proposes the same
+    move."""
     R, B, D = m.R, m.B, m.D
     k_kind, k_p, k_r, k_dst, k_dstu, k_disk, k_bias, k_ev, k_evi = (
         jax.random.split(key, 9)
@@ -209,9 +217,10 @@ def propose_move(
         p = jnp.where(use_evac, evac[ei], p)
     r = jax.random.randint(k_r, (), 0, R)
 
-    old_assign = state.assignment[p]          # [R]
-    old_leader = state.leader_slot[p]
-    old_disk = state.replica_disk[p]          # [R]
+    view = (gather or gather_view)(state, m, p)
+    old_assign = view.assign                  # [R]
+    old_leader = view.leader
+    old_disk = view.disk                      # [R]
 
     # On a hot-list draw, target the offending slot. A replica on a dead
     # *broker* can only be healed by relocation; a replica on a dead *disk*
@@ -254,7 +263,7 @@ def propose_move(
 
     src = old_assign[r]
     slot_valid = src >= 0
-    movable = m.partition_valid[p] & ~m.partition_immovable[p]
+    movable = view.pvalid & ~view.immovable
 
     # --- destination broker: headroom-weighted or uniform ------------------
     alive_ok = m.broker_valid & m.broker_alive & ~m.broker_excl_replicas
@@ -327,7 +336,13 @@ def propose_move(
         old_disk.at[r].set(jnp.where(D > 1, dst_disk, 0)),
         jnp.where(disk_ok, old_disk.at[r].set(disk_new), old_disk),
     )
-    return p, (old_assign, old_leader, old_disk), (new_assign, new_leader, new_disk), feasible
+    return (
+        p,
+        view,
+        (old_assign, old_leader, old_disk),
+        (new_assign, new_leader, new_disk),
+        feasible,
+    )
 
 
 def goal_tols(cost_vec: jnp.ndarray) -> jnp.ndarray:
@@ -373,20 +388,30 @@ def _anneal_step(
     hard_arr: jnp.ndarray,
     weights: jnp.ndarray,
     moves_per_step: int,
+    gather=None,
+    locate=None,
 ) -> SearchState:
     """``moves_per_step`` sequential proposals on one chain (vmapped over
     chains by the caller). Sequential composition inside the step is exact:
-    each proposal scores against the state left by the previous one."""
+    each proposal scores against the state left by the previous one.
+
+    ``gather``/``locate`` are the partition-axis-sharding hooks
+    (ccx.parallel): ``gather(state, p)`` produces the PartitionView (owner
+    gather + psum), ``locate(p) -> (local_index, owned)`` maps the global
+    partition id onto this shard's slice."""
 
     def inner(i, ss: SearchState) -> SearchState:
         key = jax.random.fold_in(ss.key, step_idx * moves_per_step + i)
         k_prop, k_acc = jax.random.split(key)
-        p, old, new, feasible = propose_move(k_prop, ss, m, pp, evac, n_evac)
-        delta = scorer(ss, p, old, new)
+        p, view, old, new, feasible = propose_move(
+            k_prop, ss, m, pp, evac, n_evac, gather=gather
+        )
+        delta = scorer(ss, view, old, new)
         accept = feasible & lex_accept(
             ss.cost_vec, delta.cost_vec, hard_arr, weights, temperature, k_acc
         )
-        return apply_move(ss, m, p, old, new, delta, accept)
+        p_idx, owned = locate(p) if locate is not None else (p, True)
+        return apply_move(ss, m, p_idx, view, old, new, delta, accept, owned)
 
     return jax.lax.fori_loop(0, moves_per_step, inner, state)
 

@@ -129,18 +129,19 @@ def _greedy_loop(
         keys = jax.random.split(jax.random.fold_in(key0, it), N)
 
         def one(k):
-            p, old, new, feasible = propose_move(k, ss, m, pp, evac, n_evac)
-            delta = scorer(ss, p, old, new)
-            return p, old, new, feasible, delta
+            p, view, old, new, feasible = propose_move(k, ss, m, pp, evac, n_evac)
+            delta = scorer(ss, view, old, new)
+            return p, view, old, new, feasible, delta
 
-        ps, olds, news, feas, deltas = jax.vmap(one)(keys)
+        ps, views, olds, news, feas, deltas = jax.vmap(one)(keys)
         better = feas & _lex_lt_batch(deltas.cost_vec, ss.cost_vec)
         any_better = jnp.any(better)
         best = _lex_argmin(deltas.cost_vec, better)
 
         pick = lambda tree: jax.tree.map(lambda a: a[best], tree)  # noqa: E731
         ss = apply_move(
-            ss, m, ps[best], pick(olds), pick(news), pick(deltas), any_better
+            ss, m, ps[best], pick(views), pick(olds), pick(news), pick(deltas),
+            any_better,
         )
         it = it + 1
         stale = jnp.where(any_better, 0, stale + 1)

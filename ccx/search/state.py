@@ -86,10 +86,45 @@ class MoveDelta:
     d_total: jnp.ndarray    # f32 — topic(p) alive-replica-total delta
 
 
+@struct.dataclass
+class PartitionView:
+    """Every per-partition datum one move needs, gathered into O(R) scalars.
+
+    This is the sharding seam (SURVEY.md section 5.7): search logic consumes
+    a PartitionView instead of indexing the [P]-axis arrays directly, so the
+    partition axis can live sharded across a device mesh — the shard owning
+    partition p gathers its view locally and a psum broadcasts it
+    (ccx.parallel), while the unsharded path is a plain local gather.
+    """
+
+    pvalid: jnp.ndarray     # bool scalar
+    immovable: jnp.ndarray  # bool scalar
+    topic: jnp.ndarray      # int32 scalar
+    lead_load: jnp.ndarray  # f32[RES] — leader-role load of partition p
+    foll_load: jnp.ndarray  # f32[RES]
+    assign: jnp.ndarray     # int32[R] — current row in the search state
+    leader: jnp.ndarray     # int32 scalar
+    disk: jnp.ndarray       # int32[R]
+
+
+def gather_view(state: SearchState, m: TensorClusterModel, p: jnp.ndarray) -> PartitionView:
+    """Local (unsharded) gather of partition p's view."""
+    return PartitionView(
+        pvalid=m.partition_valid[p],
+        immovable=m.partition_immovable[p],
+        topic=m.partition_topic[p],
+        lead_load=jax.lax.dynamic_slice_in_dim(m.leader_load, p, 1, axis=1)[:, 0],
+        foll_load=jax.lax.dynamic_slice_in_dim(m.follower_load, p, 1, axis=1)[:, 0],
+        assign=state.assignment[p],
+        leader=state.leader_slot[p],
+        disk=state.replica_disk[p],
+    )
+
+
 def _scatter_broker_fields(
     agg: BrokerAggregates,
     m: TensorClusterModel,
-    p: jnp.ndarray,
+    view: PartitionView,
     assign_row: jnp.ndarray,
     leader_slot_p: jnp.ndarray,
     disk_row: jnp.ndarray,
@@ -102,12 +137,12 @@ def _scatter_broker_fields(
     scores the topic goals from row deltas instead. Weight 0 is a bit-exact
     no-op, which is how rejected moves avoid drift."""
     R = assign_row.shape[0]
-    valid = (assign_row >= 0) & m.partition_valid[p]
+    valid = (assign_row >= 0) & view.pvalid
     b = jnp.clip(assign_row, 0, m.B - 1)
     is_lead = (jnp.arange(R) == leader_slot_p) & valid
 
-    lead_load = jax.lax.dynamic_slice_in_dim(m.leader_load, p, 1, axis=1)[:, 0]
-    foll_load = jax.lax.dynamic_slice_in_dim(m.follower_load, p, 1, axis=1)[:, 0]
+    lead_load = view.lead_load
+    foll_load = view.foll_load
     # [RES, R] role-resolved slot loads, zeroed for invalid slots
     slot_load = jnp.where(is_lead[None, :], lead_load[:, None], foll_load[:, None])
     slot_load = jnp.where(valid[None, :], slot_load, 0.0)
@@ -138,7 +173,7 @@ def _scatter_broker_fields(
 def scatter_partition(
     agg: BrokerAggregates,
     m: TensorClusterModel,
-    p: jnp.ndarray,            # int32 scalar — partition index
+    view: PartitionView,
     assign_row: jnp.ndarray,   # int32[R]
     leader_slot_p: jnp.ndarray,  # int32 scalar
     disk_row: jnp.ndarray,     # int32[R]
@@ -148,15 +183,15 @@ def scatter_partition(
     """Full weighted scatter: the [B]-level fields plus the sparse [T, B]
     topic count cells. All updates touch <= 2R cells per array."""
     R = assign_row.shape[0]
-    valid = (assign_row >= 0) & m.partition_valid[p]
+    valid = (assign_row >= 0) & view.pvalid
     b = jnp.clip(assign_row, 0, m.B - 1)
     is_lead = (jnp.arange(R) == leader_slot_p) & valid
     vi = valid.astype(jnp.int32)
     li = is_lead.astype(jnp.int32)
-    t = m.partition_topic[p]
+    t = view.topic
 
     agg = _scatter_broker_fields(
-        agg, m, p, assign_row, leader_slot_p, disk_row, w_f, w_i
+        agg, m, view, assign_row, leader_slot_p, disk_row, w_f, w_i
     )
     return agg.replace(
         topic_replica_count=agg.topic_replica_count.at[t, b].add(w_i * vi),
@@ -166,7 +201,7 @@ def scatter_partition(
 
 def topic_row_delta(
     m: TensorClusterModel,
-    p: jnp.ndarray,
+    view: PartitionView,
     old: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     new: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -175,7 +210,7 @@ def topic_row_delta(
     R = old[0].shape[0]
 
     def contrib(assign_row, leader_slot_p, w):
-        valid = (assign_row >= 0) & m.partition_valid[p]
+        valid = (assign_row >= 0) & view.pvalid
         b = jnp.clip(assign_row, 0, m.B - 1)
         is_lead = (jnp.arange(R) == leader_slot_p) & valid
         drc = jnp.zeros(m.B, jnp.int32).at[b].add(w * valid.astype(jnp.int32))
@@ -189,7 +224,7 @@ def topic_row_delta(
 
 def partition_row_sums(
     m: TensorClusterModel,
-    p: jnp.ndarray,
+    view: PartitionView,
     assign_row: jnp.ndarray,
     leader_slot_p: jnp.ndarray,
     disk_row: jnp.ndarray,
@@ -200,7 +235,7 @@ def partition_row_sums(
         assign_row[None, :],
         leader_slot_p[None],
         disk_row[None, :],
-        m.partition_valid[p][None],
+        view.pvalid[None],
     )
 
 
@@ -301,24 +336,24 @@ def make_move_scorer(
 
     def score(
         state: SearchState,
-        p: jnp.ndarray,
+        view: PartitionView,
         old: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
         new: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     ) -> MoveDelta:
         agg1 = _scatter_broker_fields(
-            state.agg, m, p, *old, jnp.float32(-1), jnp.int32(-1)
+            state.agg, m, view, *old, jnp.float32(-1), jnp.int32(-1)
         )
-        agg2 = _scatter_broker_fields(agg1, m, p, *new, jnp.float32(1), jnp.int32(1))
+        agg2 = _scatter_broker_fields(agg1, m, view, *new, jnp.float32(1), jnp.int32(1))
         part_new = (
             state.part_sums
-            - partition_row_sums(m, p, *old)
-            + partition_row_sums(m, p, *new)
+            - partition_row_sums(m, view, *old)
+            + partition_row_sums(m, view, *new)
         )
 
         zero = jnp.float32(0.0)
         if needs_topic:
-            t = m.partition_topic[p]
-            drc, dlc = topic_row_delta(m, p, old, new)
+            t = view.topic
+            drc, dlc = topic_row_delta(m, view, old, new)
             trc_row = state.agg.topic_replica_count[t]
             tlc_row = state.agg.topic_leader_count[t]
             new_trc = trc_row + drc
@@ -365,26 +400,44 @@ def apply_move(
     state: SearchState,
     m: TensorClusterModel,
     p: jnp.ndarray,
+    view: PartitionView,
     old: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     new: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     delta: MoveDelta,
-    accept: jnp.ndarray,   # bool scalar
+    accept: jnp.ndarray,        # bool scalar
+    owned: jnp.ndarray | bool = True,
 ) -> SearchState:
     """Apply a scored move iff ``accept`` — reject is a bit-exact no-op
-    (all scatters run with weight 0; integer accumulators add 0)."""
+    (all scatters run with weight 0; integer accumulators add 0).
+
+    ``p`` indexes this state's [P]-axis arrays (a *local* index when the
+    partition axis is sharded); ``owned`` gates the row writes so only the
+    shard owning the partition mutates placement, while the replicated
+    aggregates/accumulators are updated identically on every shard."""
     af = accept.astype(jnp.float32)
     ai = accept.astype(jnp.int32)
-    agg = scatter_partition(state.agg, m, p, *old, -af, -ai)
-    agg = scatter_partition(agg, m, p, *new, af, ai)
-    t = m.partition_topic[p]
+    agg = scatter_partition(state.agg, m, view, *old, -af, -ai)
+    agg = scatter_partition(agg, m, view, *new, af, ai)
+    t = view.topic
+    owned = jnp.asarray(owned)
 
     def sel(n, o):
         return jnp.where(accept, n, o)
 
+    def sel_row(n, cur):
+        # non-owners write their own current row back (bit-exact no-op)
+        return jnp.where(accept & owned, n, cur)
+
     return state.replace(
-        assignment=state.assignment.at[p].set(sel(new[0], old[0])),
-        leader_slot=state.leader_slot.at[p].set(sel(new[1], old[1])),
-        replica_disk=state.replica_disk.at[p].set(sel(new[2], old[2])),
+        assignment=state.assignment.at[p].set(
+            sel_row(new[0], state.assignment[p])
+        ),
+        leader_slot=state.leader_slot.at[p].set(
+            sel_row(new[1], state.leader_slot[p])
+        ),
+        replica_disk=state.replica_disk.at[p].set(
+            sel_row(new[2], state.replica_disk[p])
+        ),
         agg=agg,
         part_sums=sel(delta.part_sums, state.part_sums),
         topic_totals=state.topic_totals.at[t].add(af * delta.d_total),

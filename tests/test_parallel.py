@@ -7,7 +7,12 @@ import pytest
 from ccx.goals.base import GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, evaluate_stack
 from ccx.model.fixtures import RandomClusterSpec, random_cluster
-from ccx.parallel.sharding import make_mesh, shard_model, sharded_stack_eval
+from ccx.parallel.sharding import (
+    make_mesh,
+    shard_model,
+    sharded_anneal,
+    sharded_stack_eval,
+)
 from ccx.search.annealer import AnnealOptions, anneal
 
 
@@ -65,6 +70,63 @@ def test_sharded_anneal_matches_unsharded_semantics(model):
         float(a.stack_after.soft_scalar),
         float(b.stack_after.soft_scalar),
         rtol=1e-4,
+    )
+
+
+def test_sharded_anneal_partition_axis(model):
+    """The partition-axis-sharded search (SURVEY.md section 5.7): model
+    tensors are NOT replicated — they stay sharded over 'parts' through the
+    whole run — and the result matches the unsharded annealer, whose RNG
+    stream and acceptance rule it shares exactly."""
+    mesh = make_mesh(jax.devices(), parts=4)  # (chains=2, parts=4)
+    opts = AnnealOptions(n_chains=4, n_steps=150, seed=3)
+    rs = sharded_anneal(model, GoalConfig(), DEFAULT_GOAL_ORDER, opts, mesh)
+    ru = anneal(model, GoalConfig(), DEFAULT_GOAL_ORDER, opts)
+
+    # placement arrays of the result are sharded over the parts axis
+    spec = rs.model.assignment.sharding.spec
+    assert spec and spec[0] == "parts", spec
+    n_shards = len(
+        {s.index for s in rs.model.assignment.sharding.devices_indices_map(
+            rs.model.assignment.shape
+        ).values()}
+    )
+    assert n_shards == 4, "model must not be replicated across parts"
+
+    # identical chain programs -> identical placements (bit-exact RNG; the
+    # only float divergence is psum reduction order in the init aggregates)
+    np.testing.assert_array_equal(
+        np.asarray(rs.model.assignment), np.asarray(ru.model.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rs.model.leader_slot), np.asarray(ru.model.leader_slot)
+    )
+    np.testing.assert_allclose(
+        float(rs.stack_after.soft_scalar),
+        float(ru.stack_after.soft_scalar),
+        rtol=1e-4,
+    )
+
+
+def test_sharded_stack_eval_kafka_assigner(model):
+    """Kafka-assigner stacks evaluate sharded too (decomposed
+    KafkaAssignerEvenRackAwareGoal) — parity between both eval paths."""
+    stack = (
+        "StructuralFeasibility",
+        "KafkaAssignerEvenRackAwareGoal",
+        "KafkaAssignerDiskUsageDistributionGoal",
+    )
+    mesh = make_mesh(jax.devices())
+    local = evaluate_stack(model, GoalConfig(), stack)
+    sharded = sharded_stack_eval(
+        shard_model(model, mesh), GoalConfig(), stack, mesh=mesh
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.costs), np.asarray(local.costs), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.violations), np.asarray(local.violations),
+        rtol=1e-5, atol=1e-5,
     )
 
 
