@@ -229,7 +229,11 @@ def executor_config_def() -> ConfigDef:
     d.define("admin.client.class", Type.CLASS,
              "ccx.executor.admin.SimulatedAdminClient", Importance.HIGH,
              "AdminApi SPI implementation — the only component that writes "
-             "to the managed cluster (ref C28).")
+             "to the managed cluster (ref C28). Set to "
+             "ccx.executor.kafka_admin.KafkaAdminApi (requires kafka-python "
+             "+ bootstrap.servers) to drive a real cluster.")
+    d.define("admin.request.timeout.ms", Type.LONG, 30_000, Importance.LOW,
+             "Request timeout for the real-cluster admin client.", at_least(1))
     return d
 
 

@@ -139,6 +139,19 @@ class MetricSampleAggregator:
         with self._lock:
             return self._generation
 
+    def clear(self) -> None:
+        """Drop all windows and samples (ref bootstrap `clearmetrics`);
+        entity capacity is kept, the generation bumps."""
+        with self._lock:
+            self._sum[:] = 0.0
+            self._max[:] = -np.inf
+            self._latest[:] = 0.0
+            self._latest_t[:] = -1
+            self._count[:] = 0
+            self._base_window = None
+            self._first_window = None
+            self._generation += 1
+
     def ensure_entities(self, n: int) -> None:
         with self._lock:
             E = self.num_entities

@@ -35,19 +35,26 @@ from ccx.monitor.aggregator import (
 )
 from ccx.monitor.capacity import capacity_matrix, disk_capacity_matrix
 from ccx.monitor.metricdef import BROKER_METRIC_DEF, PARTITION_METRIC_DEF
-from ccx.monitor.model_utils import CpuEstimationParams, split_roles
+from ccx.monitor.model_utils import (
+    CpuEstimationParams,
+    LinearRegressionModelParameters,
+    split_roles,
+)
 from ccx.monitor.sampling.holders import samples_to_arrays
 from ccx.monitor.sampling.sampler import Samples
 
 
 class LoadMonitorState(enum.Enum):
-    """Ref C9 LoadMonitorTaskRunner state machine."""
+    """Ref C9 LoadMonitorTaskRunner state machine (incl. the legacy
+    BOOTSTRAPPING/TRAINING modes driven by the bootstrap/train endpoints)."""
 
     NOT_STARTED = "NOT_STARTED"
     LOADING = "LOADING"
     RUNNING = "RUNNING"
     SAMPLING = "SAMPLING"
     PAUSED = "PAUSED"
+    BOOTSTRAPPING = "BOOTSTRAPPING"
+    TRAINING = "TRAINING"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,6 +171,9 @@ class LoadMonitor:
         self._runner: threading.Thread | None = None
         self._stop = threading.Event()
         self._num_samples = 0
+        #: legacy linear-regression CPU-model training (ref C6; train verb)
+        self.lr_params = LinearRegressionModelParameters()
+        self._trained = False
 
     # ----- lifecycle (ref LoadMonitor.startUp / shutdown) -------------------
 
@@ -218,7 +228,9 @@ class LoadMonitor:
     def _sampling_loop(self) -> None:
         interval = self.config["metric.sampling.interval.ms"]
         while not self._stop.wait(interval / 1000.0):
-            if self._state is LoadMonitorState.PAUSED:
+            # skip while paused AND while a bootstrap/train replay owns the
+            # aggregators — concurrent ingestion would double-count windows
+            if self._state is not LoadMonitorState.RUNNING:
                 continue
             try:
                 self.sample_once()
@@ -230,7 +242,9 @@ class LoadMonitor:
     def sample_once(self, end_ms: int | None = None) -> int:
         """One fetch round over [last_sample, end); returns samples ingested."""
         with self._lock:
-            if self._state is LoadMonitorState.PAUSED:
+            if self._state not in (
+                LoadMonitorState.RUNNING, LoadMonitorState.LOADING
+            ):
                 return 0
             prev_state = self._state
             self._state = LoadMonitorState.SAMPLING
@@ -283,6 +297,94 @@ class LoadMonitor:
                 metrics = np.array([s.metrics for s in kept])
                 self.broker_aggregator.add_samples(ids, times, metrics, now_ms=now_ms)
         self._num_samples += len(samples.partition_samples) + len(samples.broker_samples)
+
+    def bootstrap(self, start_ms: int, end_ms: int,
+                  clear_metrics: bool = True) -> dict:
+        """Ref BOOTSTRAP endpoint / BOOTSTRAPPING state (SURVEY.md C9):
+        fetch a historical range window-by-window to (re)fill the
+        aggregators without waiting real time."""
+        window_ms = int(self.config["partition.metrics.window.ms"])
+        with self._lock:
+            if self._state is not LoadMonitorState.RUNNING:
+                raise RuntimeError(
+                    f"cannot bootstrap while monitor is {self._state.value}"
+                )
+            self._state = LoadMonitorState.BOOTSTRAPPING
+        try:
+            if clear_metrics:
+                self.partition_aggregator.clear()
+                self.broker_aggregator.clear()
+                self._num_samples = 0
+            metadata = self.admin.describe_cluster()
+            n = 0
+            t = int(start_ms)
+            while t < end_ms:
+                hi = min(t + window_ms, int(end_ms))
+                samples = self.fetcher_manager.fetch(metadata, t, hi)
+                self._ingest(samples, metadata, now_ms=hi)
+                self.sample_store.store_samples(samples)
+                n += len(samples.partition_samples) + len(samples.broker_samples)
+                t = hi
+            with self._lock:
+                self._last_sample_ms = max(self._last_sample_ms or 0, int(end_ms))
+            r = self.partition_aggregator.aggregate()
+            return {
+                "numSamples": n,
+                "numValidWindows": int(r.num_windows),
+                "validPartitionsRatio": r.valid_entity_ratio,
+            }
+        finally:
+            with self._lock:
+                # guarded restore (same pattern as sample_once): a concurrent
+                # pause must not be clobbered back to RUNNING
+                if self._state is LoadMonitorState.BOOTSTRAPPING:
+                    self._state = LoadMonitorState.RUNNING
+
+    def train(self, start_ms: int, end_ms: int) -> dict:
+        """Ref TRAIN endpoint / TRAINING state (SURVEY.md C6/C9): fit the
+        linear-regression CPU model from broker samples over a historical
+        range; once enough observations accumulate, the fitted coefficients
+        replace the static ``*.weight.for.cpu.util`` config estimates."""
+        with self._lock:
+            if self._state is not LoadMonitorState.RUNNING:
+                raise RuntimeError(
+                    f"cannot train while monitor is {self._state.value}"
+                )
+            self._state = LoadMonitorState.TRAINING
+        try:
+            metadata = self.admin.describe_cluster()
+            samples = self.fetcher_manager.fetch(
+                metadata, int(start_ms), int(end_ms)
+            )
+            cpu_id = BROKER_METRIC_DEF.metric_info("BROKER_CPU_UTIL").id
+            in_id = BROKER_METRIC_DEF.metric_info("ALL_TOPIC_BYTES_IN").id
+            out_id = BROKER_METRIC_DEF.metric_info("ALL_TOPIC_BYTES_OUT").id
+            if samples.broker_samples:
+                rows = np.array([s.metrics for s in samples.broker_samples])
+                self.lr_params.add_broker_samples(
+                    rows[:, None, :], cpu_id, in_id, out_id
+                )
+            out = {
+                "numTrainingSamples": self.lr_params.num_observations,
+                "trained": False,
+            }
+            if self.lr_params.trainable:
+                self.cpu_params = self.lr_params.to_params()
+                self._trained = True
+                out["trained"] = True
+                out["coefficients"] = {
+                    "leaderNetworkInboundWeightForCpuUtil":
+                        self.cpu_params.leader_nw_in_weight,
+                    "leaderNetworkOutboundWeightForCpuUtil":
+                        self.cpu_params.leader_nw_out_weight,
+                    "followerNetworkInboundWeightForCpuUtil":
+                        self.cpu_params.follower_nw_in_weight,
+                }
+            return out
+        finally:
+            with self._lock:
+                if self._state is LoadMonitorState.TRAINING:
+                    self._state = LoadMonitorState.RUNNING
 
     def pause_sampling(self, reason: str = "user request") -> None:
         with self._lock:
@@ -354,6 +456,8 @@ class LoadMonitor:
             "validPartitionsRatio": r.valid_entity_ratio,
             "numTotalSamples": self._num_samples,
             "modelGeneration": str(self.model_generation()),
+            "trained": self._trained,
+            "numTrainingSamples": self.lr_params.num_observations,
         }
 
 

@@ -94,6 +94,10 @@ class LinearRegressionModelParameters:
                 self.add_observation(row[cpu_id], row[in_id], row[out_id])
 
     @property
+    def num_observations(self) -> int:
+        return len(self._rows)
+
+    @property
     def trainable(self) -> bool:
         return len(self._rows) >= self.MIN_SAMPLES
 

@@ -230,8 +230,6 @@ def sharded_anneal(
     rule); results can differ only by float reduction order in the initial
     psummed aggregates.
     """
-    import dataclasses as _dc
-
     from ccx.goals.stack import evaluate_stack, soft_weights
     from ccx.search.annealer import (
         RACK_TARGET_GOALS,
@@ -248,6 +246,7 @@ def sharded_anneal(
         SearchState,
         make_cost_vector_fn,
         make_move_scorer,
+        make_swap_scorer,
         with_placement,
     )
     from ccx.goals import topic_terms as tt_
@@ -273,6 +272,7 @@ def sharded_anneal(
     evac_np, n_evac_i = hot_partition_list(m, goal_names)
 
     hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
+    allow_inter = allows_inter_broker(goal_names)
     pp = ProposalParams(
         p_real=p_real,
         b_real=b_real,
@@ -281,7 +281,8 @@ def sharded_anneal(
         p_biased_dest=opts.p_biased_dest,
         p_evac=opts.p_evac,
         target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
-        allow_inter=allows_inter_broker(goal_names),
+        allow_inter=allow_inter,
+        p_swap=opts.p_swap if allow_inter else 0.0,
     )
 
     m_sharded = shard_model(m, mesh)
@@ -402,6 +403,11 @@ def sharded_anneal(
                 hard_arr=hard_arr,
                 weights=weights,
                 moves_per_step=max(opts.moves_per_step, 1),
+                swap_scorer=(
+                    make_swap_scorer(m_local, goal_names, cfg)
+                    if pp.p_swap > 0
+                    else None
+                ),
                 gather=gather,
                 locate=locate,
             )

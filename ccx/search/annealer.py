@@ -37,9 +37,11 @@ from ccx.model.tensor_model import TensorClusterModel
 from ccx.search.state import (
     SearchState,
     apply_move,
+    apply_swap,
     gather_view,
     init_search_state,
     make_move_scorer,
+    make_swap_scorer,
     with_placement,
 )
 
@@ -47,6 +49,7 @@ from ccx.search.state import (
 MOVE_REPLICA = 0      # INTER_BROKER_REPLICA_MOVEMENT
 MOVE_LEADERSHIP = 1   # LEADERSHIP_MOVEMENT
 MOVE_DISK = 2         # INTRA_BROKER_REPLICA_MOVEMENT (JBOD)
+MOVE_SWAP = 3         # REPLICA_SWAP (two-partition exchange)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +70,10 @@ class AnnealOptions:
     #: probability of targeting the self-healing evacuation set (replicas on
     #: dead brokers/disks) when it is non-empty.
     p_evac: float = 0.3
+    #: probability a proposal is a two-partition REPLICA_SWAP — swaps cross
+    #: count-preserving barriers single moves cannot (ref ActionType,
+    #: SURVEY.md C20); 0 disables (intra-broker stacks set 0).
+    p_swap: float = 0.15
     seed: int = 0
 
 
@@ -108,6 +115,8 @@ class ProposalParams:
     #: False for intra-broker-only stacks: hot draws never force an
     #: inter-broker evacuation move.
     allow_inter: bool = True
+    #: REPLICA_SWAP share of proposals (0 disables the swap branch).
+    p_swap: float = 0.15
 
 
 RACK_TARGET_GOALS = frozenset(
@@ -345,6 +354,73 @@ def propose_move(
     )
 
 
+def propose_swap(
+    key: jnp.ndarray,
+    state: SearchState,
+    m: TensorClusterModel,
+    pp: ProposalParams,
+    gather=None,
+):
+    """Draw one candidate REPLICA_SWAP (ref ActionType, SURVEY.md C20): two
+    random replicas exchange brokers. Swaps preserve every broker's replica
+    count, so they reach load-balance states that single relocations cannot
+    without transiently violating the count-distribution band.
+
+    Returns (p1, view1, old1, new1, p2, view2, old2, new2, feasible)."""
+    R, B, D = m.R, m.B, m.D
+    k_p1, k_p2, k_r1, k_r2, k_d1, k_d2 = jax.random.split(key, 6)
+    p1 = jax.random.randint(k_p1, (), 0, pp.p_real)
+    p2 = jax.random.randint(k_p2, (), 0, pp.p_real)
+    g = gather or gather_view
+    view1 = g(state, m, p1)
+    view2 = g(state, m, p2)
+    r1 = jax.random.randint(k_r1, (), 0, R)
+    r2 = jax.random.randint(k_r2, (), 0, R)
+    x = view1.assign[r1]
+    y = view2.assign[r2]
+    sx = jnp.clip(x, 0, B - 1)
+    sy = jnp.clip(y, 0, B - 1)
+    recv_ok = m.broker_valid & m.broker_alive & ~m.broker_excl_replicas
+    lead1 = r1 == view1.leader
+    lead2 = r2 == view2.leader
+
+    ok = (
+        (p1 != p2)
+        & view1.pvalid
+        & view2.pvalid
+        & ~view1.immovable
+        & ~view2.immovable
+        & (x >= 0)
+        & (y >= 0)
+        & (x != y)
+        & recv_ok[sx]
+        & recv_ok[sy]
+        & ~jnp.any(view1.assign == y)
+        & ~jnp.any(view2.assign == x)
+        & ~(lead1 & m.broker_excl_leadership[sy])
+        & ~(lead2 & m.broker_excl_leadership[sx])
+    )
+
+    gd1 = -jnp.log(-jnp.log(jax.random.uniform(k_d1, (D,), minval=1e-12, maxval=1.0)))
+    gd2 = -jnp.log(-jnp.log(jax.random.uniform(k_d2, (D,), minval=1e-12, maxval=1.0)))
+    d1 = jnp.argmax(jnp.where(m.disk_alive[sy], gd1, -jnp.inf)).astype(jnp.int32)
+    d2 = jnp.argmax(jnp.where(m.disk_alive[sx], gd2, -jnp.inf)).astype(jnp.int32)
+
+    old1 = (view1.assign, view1.leader, view1.disk)
+    old2 = (view2.assign, view2.leader, view2.disk)
+    new1 = (
+        view1.assign.at[r1].set(y),
+        view1.leader,
+        view1.disk.at[r1].set(jnp.where(D > 1, d1, 0)),
+    )
+    new2 = (
+        view2.assign.at[r2].set(x),
+        view2.leader,
+        view2.disk.at[r2].set(jnp.where(D > 1, d2, 0)),
+    )
+    return p1, view1, old1, new1, p2, view2, old2, new2, ok
+
+
 def goal_tols(cost_vec: jnp.ndarray) -> jnp.ndarray:
     """Per-goal significance tolerance for vector comparisons. Partition and
     topic sums are exact integers (tolerance only guards true float goals
@@ -388,6 +464,7 @@ def _anneal_step(
     hard_arr: jnp.ndarray,
     weights: jnp.ndarray,
     moves_per_step: int,
+    swap_scorer=None,
     gather=None,
     locate=None,
 ) -> SearchState:
@@ -400,9 +477,7 @@ def _anneal_step(
     gather + psum), ``locate(p) -> (local_index, owned)`` maps the global
     partition id onto this shard's slice."""
 
-    def inner(i, ss: SearchState) -> SearchState:
-        key = jax.random.fold_in(ss.key, step_idx * moves_per_step + i)
-        k_prop, k_acc = jax.random.split(key)
+    def single(ss: SearchState, k_prop, k_acc) -> SearchState:
         p, view, old, new, feasible = propose_move(
             k_prop, ss, m, pp, evac, n_evac, gather=gather
         )
@@ -412,6 +487,36 @@ def _anneal_step(
         )
         p_idx, owned = locate(p) if locate is not None else (p, True)
         return apply_move(ss, m, p_idx, view, old, new, delta, accept, owned)
+
+    def swap(ss: SearchState, k_prop, k_acc) -> SearchState:
+        p1, v1, o1, n1, p2, v2, o2, n2, feasible = propose_swap(
+            k_prop, ss, m, pp, gather=gather
+        )
+        delta = swap_scorer(ss, v1, o1, n1, v2, o2, n2)
+        accept = feasible & lex_accept(
+            ss.cost_vec, delta.cost_vec, hard_arr, weights, temperature, k_acc
+        )
+        if locate is not None:
+            i1, own1 = locate(p1)
+            i2, own2 = locate(p2)
+        else:
+            i1, own1, i2, own2 = p1, True, p2, True
+        return apply_swap(
+            ss, m, i1, v1, o1, n1, i2, v2, o2, n2, delta, accept, own1, own2
+        )
+
+    def inner(i, ss: SearchState) -> SearchState:
+        key = jax.random.fold_in(ss.key, step_idx * moves_per_step + i)
+        k_sel, k_prop, k_acc = jax.random.split(key, 3)
+        if pp.p_swap <= 0.0 or swap_scorer is None:
+            return single(ss, k_prop, k_acc)
+        use_swap = jax.random.uniform(k_sel) < pp.p_swap
+        return jax.lax.cond(
+            use_swap,
+            lambda s: swap(s, k_prop, k_acc),
+            lambda s: single(s, k_prop, k_acc),
+            ss,
+        )
 
     return jax.lax.fori_loop(0, moves_per_step, inner, state)
 
@@ -441,6 +546,7 @@ def _run_chains(
     n = max(opts.n_steps, 1)
     decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
 
+    allow_inter = allows_inter_broker(goal_names)
     pp = ProposalParams(
         p_real=p_real,
         b_real=b_real,
@@ -449,7 +555,8 @@ def _run_chains(
         p_biased_dest=opts.p_biased_dest,
         p_evac=opts.p_evac,
         target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
-        allow_inter=allows_inter_broker(goal_names),
+        allow_inter=allow_inter,
+        p_swap=opts.p_swap if allow_inter else 0.0,
     )
     step = functools.partial(
         _anneal_step,
@@ -459,6 +566,9 @@ def _run_chains(
         hard_arr=hard_arr,
         weights=weights,
         moves_per_step=max(opts.moves_per_step, 1),
+        swap_scorer=(
+            make_swap_scorer(m, goal_names, cfg) if pp.p_swap > 0 else None
+        ),
     )
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:

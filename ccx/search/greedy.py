@@ -44,12 +44,15 @@ from ccx.search.annealer import (
     goal_tols,
     hot_partition_list,
     propose_move,
+    propose_swap,
 )
 from ccx.search.state import (
     SearchState,
     apply_move,
+    apply_swap,
     init_search_state,
     make_move_scorer,
+    make_swap_scorer,
     with_placement,
 )
 
@@ -65,6 +68,11 @@ class GreedyOptions:
     p_disk: float = 0.0
     p_biased_dest: float = 0.5
     p_evac: float = 0.3
+    #: fraction of candidates proposed as two-partition REPLICA_SWAPs —
+    #: swaps preserve replica counts, reaching load-balance states single
+    #: relocations cannot (ref ActionType, SURVEY.md C20); forced to 0 for
+    #: intra-broker stacks
+    swap_fraction: float = 0.25
     seed: int = 0
 
 
@@ -118,7 +126,9 @@ def _greedy_loop(
     opts: GreedyOptions,
 ):
     scorer = make_move_scorer(m, goal_names, cfg)
-    N = opts.n_candidates
+    n_swap = int(opts.n_candidates * opts.swap_fraction) if pp.p_swap > 0 else 0
+    n_single = max(opts.n_candidates - n_swap, 1)
+    swap_scorer = make_swap_scorer(m, goal_names, cfg) if n_swap else None
 
     def cond(carry):
         _, it, stale, _ = carry
@@ -126,23 +136,63 @@ def _greedy_loop(
 
     def body(carry):
         ss, it, stale, moves = carry
-        keys = jax.random.split(jax.random.fold_in(key0, it), N)
+        keys = jax.random.split(
+            jax.random.fold_in(key0, it), n_single + max(n_swap, 1)
+        )
 
         def one(k):
             p, view, old, new, feasible = propose_move(k, ss, m, pp, evac, n_evac)
             delta = scorer(ss, view, old, new)
             return p, view, old, new, feasible, delta
 
-        ps, views, olds, news, feas, deltas = jax.vmap(one)(keys)
+        ps, views, olds, news, feas, deltas = jax.vmap(one)(keys[:n_single])
         better = feas & _lex_lt_batch(deltas.cost_vec, ss.cost_vec)
-        any_better = jnp.any(better)
+        any_single = jnp.any(better)
         best = _lex_argmin(deltas.cost_vec, better)
-
         pick = lambda tree: jax.tree.map(lambda a: a[best], tree)  # noqa: E731
-        ss = apply_move(
-            ss, m, ps[best], pick(views), pick(olds), pick(news), pick(deltas),
-            any_better,
-        )
+
+        def apply_best_single(s):
+            return apply_move(
+                s, m, ps[best], pick(views), pick(olds), pick(news),
+                pick(deltas), any_single,
+            )
+
+        if n_swap:
+            def one_swap(k):
+                p1, v1, o1, n1, p2, v2, o2, n2, ok = propose_swap(k, ss, m, pp)
+                delta = swap_scorer(ss, v1, o1, n1, v2, o2, n2)
+                return p1, v1, o1, n1, p2, v2, o2, n2, ok, delta
+
+            sw = jax.vmap(one_swap)(keys[n_single:])
+            sw_ok, sw_delta = sw[8], sw[9]
+            sw_better = sw_ok & _lex_lt_batch(sw_delta.cost_vec, ss.cost_vec)
+            any_swap = jnp.any(sw_better)
+            best_w = _lex_argmin(sw_delta.cost_vec, sw_better)
+            pick_w = lambda tree: jax.tree.map(lambda a: a[best_w], tree)  # noqa: E731
+
+            # take the swap iff it is feasible-better and the best single is
+            # not lexicographically ahead of it
+            single_vec = deltas.cost_vec[best]
+            swap_vec = sw_delta.cost_vec[best_w]
+            d = swap_vec - single_vec
+            tol = goal_tols(single_vec)
+            sig = jnp.abs(d) > tol
+            swap_ahead = jnp.any(sig) & (d[jnp.argmax(sig)] < 0)
+            take_swap = any_swap & (~any_single | swap_ahead)
+
+            def apply_best_swap(s):
+                return apply_swap(
+                    s, m, sw[0][best_w], pick_w(sw[1]), pick_w(sw[2]),
+                    pick_w(sw[3]), sw[4][best_w], pick_w(sw[5]), pick_w(sw[6]),
+                    pick_w(sw[7]), pick_w(sw_delta), any_swap,
+                )
+
+            ss = jax.lax.cond(take_swap, apply_best_swap, apply_best_single, ss)
+            any_better = any_single | any_swap
+        else:
+            ss = apply_best_single(ss)
+            any_better = any_single
+
         it = it + 1
         stale = jnp.where(any_better, 0, stale + 1)
         moves = moves + any_better.astype(jnp.int32)
@@ -166,6 +216,7 @@ def greedy_optimize(
     p_real = int(np.asarray(m.partition_valid).sum())
     bv = np.asarray(m.broker_valid)
     b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
+    allow_inter = allows_inter_broker(goal_names)
     pp = ProposalParams(
         p_real=p_real,
         b_real=b_real,
@@ -174,7 +225,8 @@ def greedy_optimize(
         p_biased_dest=opts.p_biased_dest,
         p_evac=opts.p_evac,
         target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
-        allow_inter=allows_inter_broker(goal_names),
+        allow_inter=allow_inter,
+        p_swap=opts.swap_fraction if allow_inter else 0.0,
     )
 
     evac_np, n_evac_i = hot_partition_list(m, goal_names)

@@ -87,6 +87,20 @@ class MoveDelta:
 
 
 @struct.dataclass
+class SwapDelta:
+    """MoveDelta for a two-partition REPLICA_SWAP: combined accumulator
+    deltas plus the second topic's total delta (zero when both partitions
+    share a topic)."""
+
+    cost_vec: jnp.ndarray
+    part_sums: jnp.ndarray
+    d_mtl: jnp.ndarray
+    d_trd: jnp.ndarray
+    d_total: jnp.ndarray    # topic(p1) alive-total delta (combined if same)
+    d_total2: jnp.ndarray   # topic(p2) alive-total delta (0 if same topic)
+
+
+@struct.dataclass
 class PartitionView:
     """Every per-partition datum one move needs, gathered into O(R) scalars.
 
@@ -491,4 +505,178 @@ def with_placement(m: TensorClusterModel, s: SearchState) -> TensorClusterModel:
         assignment=s.assignment,
         leader_slot=s.leader_slot,
         replica_disk=s.replica_disk,
+    )
+
+
+def make_swap_scorer(
+    m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig
+):
+    """Build ``score_swap(state, view1, old1, new1, view2, old2, new2) ->
+    MoveDelta`` for two-partition REPLICA_SWAP actions (ref ActionType,
+    SURVEY.md C20).
+
+    A swap exchanges two replicas between brokers. Crucially it crosses
+    states a single move cannot reach: fixing a usage-band violation on a
+    replica-count-balanced broker means any single relocation transiently
+    breaks the count band and is vetoed lexicographically — the reference
+    uses REPLICA_SWAP for exactly this. Scoring composes both partitions'
+    deltas exactly, including the same-topic case where both touch one
+    [T, B] count row.
+
+    The returned MoveDelta carries the *combined* accumulator deltas; apply
+    with two ``apply_move`` calls (old1->new1 then old2->new2) which compose
+    bit-exactly on the incremental state.
+    """
+    vector_fn = make_cost_vector_fn(m, goal_names, cfg)
+    needs_topic = bool(
+        set(goal_names)
+        & {"MinTopicLeadersPerBrokerGoal", "TopicReplicaDistributionGoal"}
+    )
+    T = m.num_topics
+
+    def score_swap(
+        state: SearchState,
+        view1: PartitionView,
+        old1,
+        new1,
+        view2: PartitionView,
+        old2,
+        new2,
+    ) -> MoveDelta:
+        agg = _scatter_broker_fields(
+            state.agg, m, view1, *old1, jnp.float32(-1), jnp.int32(-1)
+        )
+        agg = _scatter_broker_fields(agg, m, view1, *new1, jnp.float32(1), jnp.int32(1))
+        agg = _scatter_broker_fields(agg, m, view2, *old2, jnp.float32(-1), jnp.int32(-1))
+        agg = _scatter_broker_fields(agg, m, view2, *new2, jnp.float32(1), jnp.int32(1))
+        part_new = (
+            state.part_sums
+            - partition_row_sums(m, view1, *old1)
+            + partition_row_sums(m, view1, *new1)
+            - partition_row_sums(m, view2, *old2)
+            + partition_row_sums(m, view2, *new2)
+        )
+
+        zero = jnp.float32(0.0)
+        if needs_topic:
+            t1, t2 = view1.topic, view2.topic
+            same = t1 == t2
+            drc1, dlc1 = topic_row_delta(m, view1, old1, new1)
+            drc2, dlc2 = topic_row_delta(m, view2, old2, new2)
+            trc1 = state.agg.topic_replica_count[t1]
+            tlc1 = state.agg.topic_leader_count[t1]
+            trc2 = state.agg.topic_replica_count[t2]
+            tlc2 = state.agg.topic_leader_count[t2]
+            f1 = m.topic_min_leaders[t1]
+            f2 = m.topic_min_leaders[t2]
+            n_alive = jnp.maximum(
+                jnp.sum(m.broker_valid & m.broker_alive), 1
+            ).astype(jnp.float32)
+
+            def row_deltas(trc_a, tlc_a, drc_a, dlc_a, flag):
+                new_trc = trc_a + drc_a
+                new_tlc = tlc_a + dlc_a
+                d_mtl_ = tt.mtl_row(m, cfg, flag, new_tlc) - tt.mtl_row(
+                    m, cfg, flag, tlc_a
+                )
+                pen_n, _ = tt.trd_row_pen(m, cfg, new_trc)
+                pen_o, _ = tt.trd_row_pen(m, cfg, trc_a)
+                tot_o = tt.trd_row_total(m, trc_a)
+                tot_n = tt.trd_row_total(m, new_trc)
+                d_norm_ = (
+                    jnp.maximum(tot_n / n_alive, 1.0)
+                    - jnp.maximum(tot_o / n_alive, 1.0)
+                ) / jnp.float32(T)
+                return d_mtl_, pen_n - pen_o, tot_n - tot_o, d_norm_
+
+            # same topic: one row takes both deltas; else two independent rows
+            sm = row_deltas(trc1, tlc1, drc1 + drc2, dlc1 + dlc2, f1)
+            a1 = row_deltas(trc1, tlc1, drc1, dlc1, f1)
+            a2 = row_deltas(trc2, tlc2, drc2, dlc2, f2)
+            d_mtl = jnp.where(same, sm[0], a1[0] + a2[0])
+            d_trd = jnp.where(same, sm[1], a1[1] + a2[1])
+            # per-topic total deltas so apply_swap can update both cells
+            d_total = jnp.where(same, sm[2], a1[2])
+            d_total2 = jnp.where(same, zero, a2[2])
+            d_norm = jnp.where(same, sm[3], a1[3] + a2[3])
+            norm_old = tt.trd_normalizer(m, state.topic_totals)
+            norm_new = norm_old + d_norm
+            norm_new = jnp.where(norm_new > 0, norm_new, 1.0)
+        else:
+            d_mtl = d_trd = d_total = d_total2 = zero
+            norm_new = jnp.float32(1.0)
+
+        cost_vec = vector_fn(
+            agg, part_new, state.mtl_sum + d_mtl, state.trd_sum + d_trd, norm_new
+        )
+        return SwapDelta(
+            cost_vec=cost_vec,
+            part_sums=part_new,
+            d_mtl=d_mtl,
+            d_trd=d_trd,
+            d_total=d_total,
+            d_total2=d_total2,
+        )
+
+    return score_swap
+
+
+def apply_swap(
+    state: SearchState,
+    m: TensorClusterModel,
+    p1: jnp.ndarray,
+    view1: PartitionView,
+    old1,
+    new1,
+    p2: jnp.ndarray,
+    view2: PartitionView,
+    old2,
+    new2,
+    delta: "SwapDelta",
+    accept: jnp.ndarray,
+    owned1: jnp.ndarray | bool = True,
+    owned2: jnp.ndarray | bool = True,
+) -> SearchState:
+    """Apply a scored two-partition swap iff ``accept`` (bit-exact no-op on
+    reject, same contract as apply_move)."""
+    af = accept.astype(jnp.float32)
+    ai = accept.astype(jnp.int32)
+    agg = scatter_partition(state.agg, m, view1, *old1, -af, -ai)
+    agg = scatter_partition(agg, m, view1, *new1, af, ai)
+    agg = scatter_partition(agg, m, view2, *old2, -af, -ai)
+    agg = scatter_partition(agg, m, view2, *new2, af, ai)
+    o1 = accept & jnp.asarray(owned1)
+    o2 = accept & jnp.asarray(owned2)
+
+    def sel(n, o):
+        return jnp.where(accept, n, o)
+
+    assignment = state.assignment.at[p1].set(
+        jnp.where(o1, new1[0], state.assignment[p1])
+    )
+    assignment = assignment.at[p2].set(jnp.where(o2, new2[0], assignment[p2]))
+    leader_slot = state.leader_slot.at[p1].set(
+        jnp.where(o1, new1[1], state.leader_slot[p1])
+    )
+    leader_slot = leader_slot.at[p2].set(jnp.where(o2, new2[1], leader_slot[p2]))
+    replica_disk = state.replica_disk.at[p1].set(
+        jnp.where(o1, new1[2], state.replica_disk[p1])
+    )
+    replica_disk = replica_disk.at[p2].set(
+        jnp.where(o2, new2[2], replica_disk[p2])
+    )
+    totals = state.topic_totals.at[view1.topic].add(af * delta.d_total)
+    totals = totals.at[view2.topic].add(af * delta.d_total2)
+
+    return state.replace(
+        assignment=assignment,
+        leader_slot=leader_slot,
+        replica_disk=replica_disk,
+        agg=agg,
+        part_sums=sel(delta.part_sums, state.part_sums),
+        topic_totals=totals,
+        mtl_sum=state.mtl_sum + af * delta.d_mtl,
+        trd_sum=state.trd_sum + af * delta.d_trd,
+        cost_vec=sel(delta.cost_vec, state.cost_vec),
+        n_accepted=state.n_accepted + ai,
     )

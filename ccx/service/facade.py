@@ -617,6 +617,29 @@ class CruiseControl:
         self.executor.stop_execution()
         return {"message": "Execution stop requested"}
 
+    def bootstrap(self, start_ms: int | None, end_ms: int | None,
+                  clear_metrics: bool = True) -> dict:
+        """Ref BOOTSTRAP endpoint (SURVEY.md C9): replay a historical metric
+        range into the aggregators to warm windows without waiting."""
+        if start_ms is None or end_ms is None:
+            raise UserRequestException(
+                "bootstrap requires start and end timestamps (ms)"
+            )
+        if end_ms <= start_ms:
+            raise UserRequestException("bootstrap end must be after start")
+        return self.load_monitor.bootstrap(start_ms, end_ms, clear_metrics)
+
+    def train(self, start_ms: int | None, end_ms: int | None) -> dict:
+        """Ref TRAIN endpoint (SURVEY.md C6): fit the linear-regression CPU
+        estimation model from broker samples over a historical range."""
+        if start_ms is None or end_ms is None:
+            raise UserRequestException(
+                "train requires start and end timestamps (ms)"
+            )
+        if end_ms <= start_ms:
+            raise UserRequestException("train end must be after start")
+        return self.load_monitor.train(start_ms, end_ms)
+
     # ----- internals --------------------------------------------------------
 
     def _broker_health_metrics(self) -> dict[int, dict[str, float]]:

@@ -26,6 +26,8 @@ class EndPoint(enum.Enum):
     USER_TASKS = "user_tasks"
     REVIEW_BOARD = "review_board"
     PERMISSIONS = "permissions"
+    BOOTSTRAP = "bootstrap"
+    TRAIN = "train"
     # POST
     REBALANCE = "rebalance"
     ADD_BROKER = "add_broker"
@@ -45,7 +47,8 @@ GET_ENDPOINTS = frozenset(
     {
         EndPoint.STATE, EndPoint.LOAD, EndPoint.PARTITION_LOAD,
         EndPoint.PROPOSALS, EndPoint.KAFKA_CLUSTER_STATE, EndPoint.USER_TASKS,
-        EndPoint.REVIEW_BOARD, EndPoint.PERMISSIONS,
+        EndPoint.REVIEW_BOARD, EndPoint.PERMISSIONS, EndPoint.BOOTSTRAP,
+        EndPoint.TRAIN,
     }
 )
 POST_ENDPOINTS = frozenset(set(EndPoint) - GET_ENDPOINTS)
@@ -123,6 +126,15 @@ PARAMETERS: dict[EndPoint, tuple[ParamSpec, ...]] = {
         ParamSpec("review_ids", ParamType.CSV_INT, ()),
     ),
     EndPoint.PERMISSIONS: _COMMON,
+    EndPoint.BOOTSTRAP: _COMMON + (
+        ParamSpec("start", ParamType.INT, None),
+        ParamSpec("end", ParamType.INT, None),
+        ParamSpec("clearmetrics", ParamType.BOOLEAN, True),
+    ),
+    EndPoint.TRAIN: _COMMON + (
+        ParamSpec("start", ParamType.INT, None),
+        ParamSpec("end", ParamType.INT, None),
+    ),
     EndPoint.REBALANCE: _COMMON + _MUTATION + (
         ParamSpec("rebalance_disk", ParamType.BOOLEAN, False),
         ParamSpec("destination_broker_ids", ParamType.CSV_INT, ()),

@@ -257,6 +257,13 @@ class CruiseControlApp:
 
         if endpoint is EndPoint.LOAD:
             return lambda progress: f.load()
+        if endpoint is EndPoint.BOOTSTRAP:
+            return lambda progress: f.bootstrap(
+                params["start"], params["end"],
+                clear_metrics=params["clearmetrics"],
+            )
+        if endpoint is EndPoint.TRAIN:
+            return lambda progress: f.train(params["start"], params["end"])
         if endpoint is EndPoint.PARTITION_LOAD:
             return lambda progress: f.partition_load(
                 params["max_load_entries"], resource=params["resource"],

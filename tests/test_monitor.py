@@ -217,3 +217,64 @@ def test_dead_broker_reflected_in_model(tmp_path):
     model, metadata, _ = lm.cluster_model(ModelCompletenessRequirements(2, 0.5))
     assert not np.asarray(model.broker_alive)[2]
     assert 2 in metadata.dead_broker_ids()
+
+
+def test_bootstrap_fills_windows_and_restores_state(tmp_path):
+    """BOOTSTRAP endpoint semantics (ref C9): replay a historical range
+    window-by-window; afterwards the monitor is RUNNING with enough valid
+    windows to build a model immediately."""
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    clock["now"] = 10_000
+    out = lm.bootstrap(0, 6_000)
+    assert out["numSamples"] > 0
+    assert out["numValidWindows"] >= 4
+    assert lm.state()["state"] == "RUNNING"
+    model, _, _ = lm.cluster_model(ModelCompletenessRequirements(2, 0.9))
+    assert int(np.asarray(model.n_partitions)) == 12
+
+
+def test_bootstrap_clear_metrics_resets_aggregators(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    run_windows(lm, clock)
+    before = lm.state()["numTotalSamples"]
+    assert before > 0
+    out = lm.bootstrap(6_000, 9_000, clear_metrics=True)
+    st = lm.state()
+    # only the bootstrapped range remains
+    assert st["numTotalSamples"] == out["numSamples"] < before + out["numSamples"]
+
+
+def test_bootstrap_rejected_while_paused(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    lm.pause_sampling("maintenance")
+    with pytest.raises(RuntimeError, match="(?i)paused"):
+        lm.bootstrap(0, 1_000)
+
+
+def test_train_fits_cpu_model(tmp_path):
+    """TRAIN endpoint semantics (ref C6): linear-regression CPU coefficients
+    fitted from broker samples replace the static config weights."""
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    static_params = lm.cpu_params
+    out = lm.train(0, 20_000)
+    assert out["numTrainingSamples"] >= 16
+    assert out["trained"] is True
+    coeffs = out["coefficients"]
+    assert coeffs["leaderNetworkInboundWeightForCpuUtil"] >= 0.0
+    assert lm.cpu_params is not static_params
+    st = lm.state()
+    assert st["state"] == "RUNNING"
+    assert st["trained"] is True
+    assert st["numTrainingSamples"] >= 16
+
+
+def test_train_insufficient_samples(tmp_path):
+    lm, sim, clock = make_monitor(tmp_path)
+    lm.start_up(run_sampling_loop=False)
+    out = lm.train(0, 2_000)  # 2 rounds x 4 brokers = 8 < 16
+    assert out["trained"] is False
+    assert lm.state()["trained"] is False

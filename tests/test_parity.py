@@ -1,0 +1,132 @@
+"""Score-parity harness: SA+polish vs the faithful greedy oracle.
+
+SURVEY.md section 4's key insight: the reference's analyzer is tested by
+post-conditions and score comparisons, not golden outputs. The oracle
+(ccx.search.greedy) implements the reference's sequential-goal acceptance
+rule exactly (lexicographic on the per-goal cost vector), so the production
+pipeline (repair -> batched SA -> greedy polish, ccx.optimizer.optimize)
+must end at a cost vector no worse, lexicographically, than a pure oracle
+run from the same snapshot.
+
+Configs mirror the four benchmark scenarios (BASELINE.md B1-B4) scaled so
+the whole module stays bounded on the CPU backend: B2/B3 share padded
+shapes + goal stack, so the compiled programs are reused across cases.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, INTRA_BROKER_GOAL_ORDER
+from ccx.model.fixtures import RandomClusterSpec, random_cluster
+from ccx.optimizer import OptimizeOptions, optimize, rebalance_disk
+from ccx.search.annealer import AnnealOptions
+from ccx.search.greedy import GreedyOptions, greedy_optimize
+
+CFG = GoalConfig()
+
+B1_STACK = ("StructuralFeasibility", "ReplicaDistributionGoal")
+
+#: name -> (spec, goal stack). B2/B3 intentionally share padded buckets.
+CASES = {
+    "B1-replica-distribution": (
+        RandomClusterSpec(n_brokers=10, n_partitions=500, seed=21),
+        B1_STACK,
+    ),
+    "B2-full-stack": (
+        RandomClusterSpec(
+            n_brokers=14, n_racks=4, n_topics=10, n_partitions=700, seed=22
+        ),
+        DEFAULT_GOAL_ORDER,
+    ),
+    "B3-dead-brokers": (
+        RandomClusterSpec(
+            n_brokers=14, n_racks=4, n_topics=10, n_partitions=700,
+            n_dead_brokers=2, seed=23,
+        ),
+        DEFAULT_GOAL_ORDER,
+    ),
+}
+
+SA_OPTS = OptimizeOptions(
+    anneal=AnnealOptions(n_chains=8, n_steps=800, moves_per_step=2, seed=9),
+    polish=GreedyOptions(n_candidates=128, max_iters=300, patience=8),
+)
+ORACLE_OPTS = GreedyOptions(n_candidates=128, max_iters=1200, patience=12, seed=4)
+
+
+def _lex_leq(a: np.ndarray, b: np.ndarray, tol: float = 1e-4) -> bool:
+    """a <= b lexicographically with per-entry tolerance."""
+    for x, y in zip(a, b):
+        if x < y - tol:
+            return True
+        if x > y + tol:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_sa_matches_or_beats_oracle(name):
+    spec, stack = CASES[name]
+    m = random_cluster(spec)
+    sa = optimize(m, CFG, stack, SA_OPTS)
+    oracle = greedy_optimize(m, CFG, stack, ORACLE_OPTS)
+    sa_vec = np.asarray(sa.stack_after.costs)
+    or_vec = np.asarray(oracle.stack_after.costs)
+    assert _lex_leq(sa_vec, or_vec), (
+        f"{name}: SA+polish lexicographically worse than oracle\n"
+        f"  sa:     {dict(zip(stack, sa_vec.round(4)))}\n"
+        f"  oracle: {dict(zip(stack, or_vec.round(4)))}"
+    )
+    # both must reach hard feasibility on these inputs
+    assert float(sa.stack_after.hard_cost) == 0.0
+    assert float(oracle.stack_after.hard_cost) == 0.0
+
+
+def test_sa_matches_or_beats_oracle_jbod():
+    """B4 analogue: intra-broker disk stack."""
+    spec = RandomClusterSpec(n_brokers=8, n_partitions=400, n_disks=4, seed=24)
+    m = random_cluster(spec)
+    opts = dataclasses.replace(
+        SA_OPTS,
+        anneal=AnnealOptions(
+            n_chains=8, n_steps=800, p_disk=1.0, p_leadership=0.0,
+            p_biased_dest=0.0, seed=9,
+        ),
+        polish=GreedyOptions(
+            p_disk=1.0, p_leadership=0.0, n_candidates=128, max_iters=300
+        ),
+        check_evacuation=False,
+    )
+    sa = optimize(m, CFG, INTRA_BROKER_GOAL_ORDER, opts)
+    oracle = greedy_optimize(
+        m, CFG, INTRA_BROKER_GOAL_ORDER,
+        GreedyOptions(
+            p_disk=1.0, p_leadership=0.0, n_candidates=128, max_iters=1200,
+            patience=12, seed=4,
+        ),
+    )
+    assert _lex_leq(
+        np.asarray(sa.stack_after.costs), np.asarray(oracle.stack_after.costs)
+    )
+    # intra-broker moves only: no replica may change broker
+    np.testing.assert_array_equal(
+        np.asarray(sa.model.assignment), np.asarray(m.assignment)
+    )
+
+
+def test_oracle_never_worsens_any_higher_goal():
+    """The oracle's defining property (reference actionAcceptance): every
+    accepted move left all higher-priority goals intact, so goal-by-goal the
+    final vector dominates lexicographically from the first changed entry."""
+    spec, stack = CASES["B2-full-stack"]
+    m = random_cluster(spec)
+    res = greedy_optimize(m, CFG, stack, ORACLE_OPTS)
+    before = np.asarray(res.stack_before.costs)
+    after = np.asarray(res.stack_after.costs)
+    hard = np.asarray([GOAL_REGISTRY[n].hard for n in stack])
+    # hard tier never worsens
+    assert np.all(after[hard] <= before[hard] + 1e-4)
+    assert _lex_leq(after, before)

@@ -50,7 +50,7 @@ def server():
         "optimizer.num.chains": 4,
         "optimizer.num.steps": 100,
         "webserver.http.port": 0,           # ephemeral
-        "webserver.request.maxBlockTimeMs": 30_000,
+        "webserver.request.maxBlockTimeMs": 120_000,
         "two.step.verification.enabled": "true",
     })
     clock = {"now": 0}
@@ -433,3 +433,26 @@ def test_user_task_replay_endpoint_mismatch(server):
         headers={"User-Task-ID": task_id},
     )
     assert status == 200
+
+
+def test_train_and_bootstrap_endpoints(server):
+    """TRAIN/BOOTSTRAP GET verbs (ref C6/C9) through the REST stack."""
+    status, body, _ = request(
+        server, "GET", "/kafkacruisecontrol/train?start=0&end=20000"
+    )
+    assert status == 200, body
+    assert body["trained"] is True
+    assert body["numTrainingSamples"] >= 16
+
+    now = server["clock"]["now"]
+    status, body, _ = request(
+        server, "GET",
+        f"/kafkacruisecontrol/bootstrap?start=0&end={now}&clearmetrics=false",
+    )
+    assert status == 200, body
+    assert body["numSamples"] > 0
+    assert body["numValidWindows"] >= 3
+
+    # missing range -> 400
+    status, body, _ = request(server, "GET", "/kafkacruisecontrol/train")
+    assert status == 400
