@@ -247,3 +247,74 @@ class JwtSecurityProvider(SecurityProvider):
         return AuthResult(
             True, claims.get("sub", ""), set(claims.get("roles", []))
         )
+
+
+class SpnegoSecurityProvider(SecurityProvider):
+    """Kerberos/SPNEGO via GSSAPI (ref SpnegoSecurityProvider, SURVEY.md
+    C34): the client sends ``Authorization: Negotiate <base64 token>``; the
+    server accepts the GSS security context under its HTTP service
+    credential (keytab via standard ``KRB5_KTNAME``) and maps the initiator
+    principal to roles.
+
+    The ``gssapi`` package is NOT a hard dependency — construction fails
+    with a clear message when it is missing (same import-guard pattern as
+    ccx.executor.kafka_admin). Role mapping: principals (sans realm) listed
+    in ``webserver.spnego.admin.principals`` get ADMIN, others USER.
+    """
+
+    def __init__(self, service_name: str = "HTTP",
+                 admin_principals: tuple[str, ...] = (), config=None) -> None:
+        try:
+            import gssapi
+        except ImportError as e:  # pragma: no cover - environment dependent
+            raise ImportError(
+                "SpnegoSecurityProvider requires the `gssapi` package "
+                "(pip install gssapi) and a host Kerberos setup; use "
+                "Basic/Jwt/TrustedProxy providers otherwise"
+            ) from e
+        self._gssapi = gssapi
+        self.service_name = service_name
+        self.admin_principals = set(admin_principals)
+        self._server_creds = None
+        if config is not None:
+            self.configure(config)
+
+    def configure(self, config) -> None:
+        admins = config.get("webserver.spnego.admin.principals")
+        if admins:
+            self.admin_principals = set(admins)
+        svc = config.get("webserver.spnego.service.name")
+        if svc:
+            self.service_name = svc
+
+    def _creds(self):
+        if self._server_creds is None:
+            name = self._gssapi.Name(
+                f"{self.service_name}@",  # host resolved by the library
+                name_type=self._gssapi.NameType.hostbased_service,
+            )
+            self._server_creds = self._gssapi.Credentials(
+                name=name, usage="accept"
+            )
+        return self._server_creds
+
+    def authenticate(self, headers) -> AuthResult:
+        auth = headers.get("authorization", "")
+        if not auth.lower().startswith("negotiate "):
+            return AuthResult(False, challenge="Negotiate")
+        try:
+            token = base64.b64decode(auth.split(None, 1)[1])
+            ctx = self._gssapi.SecurityContext(creds=self._creds(), usage="accept")
+            ctx.step(token)
+            if not ctx.complete:
+                # multi-round-trip contexts are not supported over stateless
+                # HTTP here (ref behavior: single-token SPNEGO)
+                return AuthResult(False, challenge="Negotiate")
+            principal = str(ctx.initiator_name)
+        except Exception:
+            return AuthResult(False, challenge="Negotiate")
+        short = principal.split("@", 1)[0]
+        roles = {ROLE_ADMIN} if (
+            principal in self.admin_principals or short in self.admin_principals
+        ) else {ROLE_USER, ROLE_VIEWER}
+        return AuthResult(True, principal, roles)

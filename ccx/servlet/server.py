@@ -357,7 +357,10 @@ def _make_handler(app: CruiseControlApp):
                 is_metrics = (
                     method == "GET" and parsed.path == URL_PREFIX + "/metrics"
                 )
-                if is_ui or is_metrics:
+                is_openapi = (
+                    method == "GET" and parsed.path == URL_PREFIX + "/openapi"
+                )
+                if is_ui or is_metrics or is_openapi:
                     hdrs = {k.lower(): v for k, v in self.headers.items()}
                     hdrs["x-ccx-peer-address"] = self.client_address[0]
                     auth = app.security.authenticate(hdrs)
@@ -373,6 +376,10 @@ def _make_handler(app: CruiseControlApp):
                         self._send_raw(
                             200, PAGE.encode(), "text/html; charset=utf-8"
                         )
+                    elif is_openapi:
+                        from ccx.servlet.openapi import openapi_document
+
+                        self._send(200, openapi_document(URL_PREFIX))
                     else:
                         from ccx.common.metrics import REGISTRY
 

@@ -1,10 +1,13 @@
-"""Minimal operator dashboard.
+"""Operator dashboard.
 
 Parity: the reference serves ``cruise-control-ui`` (a Vue SPA, separate
 repo) from its web root (SURVEY.md M5). ccx ships a single-file dashboard —
-no build step, stdlib-served — that polls the same REST endpoints the UI
-uses (``state``, ``load``, ``kafka_cluster_state``) and renders cluster
-summary, per-broker load bars, monitor/executor/anomaly state.
+no build step, stdlib-served — that drives the same REST endpoints the SPA
+uses: cluster summary + per-broker load (``kafka_cluster_state``, ``load``),
+monitor/executor state (``state``), the anomaly-detector / self-healing
+panel (``state?substates=anomaly_detector``), the user-task audit trail
+(``user_tasks``), and on-demand proposal computation (``proposals`` with
+async 202 + User-Task-ID long-poll, like the SPA's task polling).
 """
 
 PAGE = """<!DOCTYPE html>
@@ -21,22 +24,102 @@ PAGE = """<!DOCTYPE html>
         border-radius:2px; vertical-align: middle; }
  .dead { color: #c0392b; font-weight: 600; }
  .ok { color: #1e8e3e; } .muted { color:#777; font-size:.85rem; }
+ .warn { color: #b7791f; }
  pre { background:#f6f6f9; padding: .7rem; border-radius:6px;
        max-width: 72rem; overflow-x: auto; }
+ button { padding: .35rem .9rem; border-radius: 6px; border: 1px solid #aab;
+          background: #eef; cursor: pointer; } button:disabled { opacity:.5 }
 </style></head><body>
 <h1>ccx — cluster dashboard</h1>
 <div class="muted" id="meta">loading…</div>
 <h2>Cluster</h2><div id="summary"></div>
 <h2>Broker load</h2><div id="load"></div>
+<h2>Proposals
+ <button id="proposebtn" onclick="computeProposals()">Compute proposals</button>
+</h2>
+<div id="proposals" class="muted">not computed yet</div>
+<h2>Anomaly detector / self-healing</h2><div id="anomaly"></div>
+<h2>User tasks</h2><div id="tasks"></div>
 <h2>Service state</h2><pre id="state"></pre>
 <script>
 const J = (u) => fetch(u).then(r => r.json());
+
+async function pollTask(resp) {
+  // async verbs return 202 + User-Task-ID; replay the id until COMPLETED
+  if (resp.status !== 202) return resp.json();
+  const id = resp.headers.get('User-Task-ID');
+  for (;;) {
+    await new Promise(r => setTimeout(r, 1500));
+    const again = await fetch('/kafkacruisecontrol/proposals',
+                              {headers: {'User-Task-ID': id}});
+    if (again.status !== 202) return again.json();
+  }
+}
+
+async function computeProposals() {
+  const btn = document.getElementById('proposebtn');
+  const el = document.getElementById('proposals');
+  btn.disabled = true;
+  el.textContent = 'computing…';
+  try {
+    const r = await fetch('/kafkacruisecontrol/proposals');
+    const j = await pollTask(r);
+    const s = j.summary || j;
+    const goals = (s.goalSummary || []).map(g =>
+      `<tr><td>${g.goal}</td><td>${g.hard ? 'hard' : 'soft'}</td>
+       <td>${g.violationsBefore}</td><td>${g.violationsAfter}</td>
+       <td>${g.costBefore.toFixed(3)}</td><td>${g.costAfter.toFixed(3)}</td></tr>`
+    ).join('');
+    el.innerHTML =
+      `<div>replica movements: <b>${s.numReplicaMovements}</b>,
+        leadership movements: <b>${s.numLeadershipMovements}</b>,
+        verified: <b class="${s.verified ? 'ok' : 'dead'}">${s.verified}</b>
+        ${s.onDemandBalancednessScoreBefore !== undefined ?
+          `, balancedness ${s.onDemandBalancednessScoreBefore.toFixed(1)}
+           → ${s.onDemandBalancednessScoreAfter.toFixed(1)}` : ''}</div>
+       <table><tr><th>Goal</th><th></th><th>viol before</th><th>viol after</th>
+       <th>cost before</th><th>cost after</th></tr>${goals}</table>`;
+  } catch (e) { el.textContent = 'error: ' + e; }
+  btn.disabled = false;
+}
+
+function renderAnomaly(ad) {
+  if (!ad) return '<span class="muted">detector not running</span>';
+  const sh = Object.entries(ad.selfHealingEnabled || {}).map(([k, v]) =>
+    `<td class="${v ? 'ok' : 'muted'}">${k}: ${v ? 'on' : 'off'}</td>`).join('');
+  const recent = (ad.recentAnomalies || []).slice(-8).reverse().map(a =>
+    `<tr><td>${a.type || a.anomalyType || ''}</td>
+     <td>${a.description || JSON.stringify(a)}</td>
+     <td>${a.action || ''}</td></tr>`).join('');
+  return `<table><tr>${sh}</tr></table>
+    <div class="muted">self-healing runs started: ${ad.numSelfHealingStarted},
+      pending checks: ${ad.pendingChecks}</div>
+    <table><tr><th>Type</th><th>Anomaly</th><th>Action</th></tr>
+    ${recent || '<tr><td colspan=3 class="muted">none</td></tr>'}</table>`;
+}
+
+function renderTasks(tj) {
+  const rows = (tj.userTasks || []).slice(0, 12).map(t =>
+    `<tr><td class="muted">${(t.UserTaskId || '').slice(0, 8)}</td>
+     <td>${t.Endpoint}</td>
+     <td class="${t.Status === 'Completed' ? 'ok' :
+                  t.Status === 'CompletedWithError' ? 'dead' : 'warn'}">
+       ${t.Status}</td>
+     <td>${new Date(t.StartMs).toLocaleTimeString()}</td>
+     <td class="muted">${(t.Progress && t.Progress.length) ?
+       t.Progress[t.Progress.length - 1].step || '' : ''}</td></tr>`).join('');
+  return `<table><tr><th>Task</th><th>Endpoint</th><th>Status</th>
+    <th>Started</th><th>Last step</th></tr>
+    ${rows || '<tr><td colspan=5 class="muted">none</td></tr>'}</table>`;
+}
+
 async function refresh() {
   try {
-    const [st, ks, ld] = await Promise.all([
-      J('/kafkacruisecontrol/state'),
+    const [st, ks, ld, tj] = await Promise.all([
+      J('/kafkacruisecontrol/state?substates=monitor,executor,anomaly_detector'),
       J('/kafkacruisecontrol/kafka_cluster_state'),
       J('/kafkacruisecontrol/load'),
+      J('/kafkacruisecontrol/user_tasks'),
     ]);
     const s = ks.KafkaBrokerState.Summary;
     document.getElementById('meta').textContent =
@@ -62,6 +145,9 @@ async function refresh() {
          <td>${b.NwOutRate.toFixed(0)}</td><td>${b.DiskMB.toFixed(0)}</td>
          <td><span class="bar" style="width:${120 * b.DiskMB / maxDisk}px"></span></td>
          </tr>`).join('') + '</table>';
+    document.getElementById('anomaly').innerHTML =
+      renderAnomaly(st.AnomalyDetectorState);
+    document.getElementById('tasks').innerHTML = renderTasks(tj);
     document.getElementById('state').textContent = JSON.stringify(st, null, 2);
   } catch (e) {
     document.getElementById('meta').textContent = 'error: ' + e;
