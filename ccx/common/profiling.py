@@ -1,0 +1,59 @@
+"""Device-side profiling hooks (SURVEY.md §5.1 rebuild note).
+
+The reference's tracing story is host-side (OperationProgress steps +
+Dropwizard/JMX timers, ref async/progress/OperationProgress.java); this
+module adds the TPU-native half the survey calls for: ``jax.profiler``
+traces viewable in XProf/TensorBoard, with named phase annotations so the
+optimizer's repair/anneal/polish phases are visible on the device timeline.
+
+Usage:
+* ``with annotate("ccx:anneal"): ...`` — cheap named region; only recorded
+  while a trace is active, safe to leave on in production.
+* ``with trace(log_dir): ...`` — capture a device trace for the enclosed
+  block (facade wires this to the ``optimizer.profile.dir`` config key).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+#: serializes start/stop — jax.profiler supports one active trace per process
+_LOCK = threading.Lock()
+_ACTIVE = False
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region on the device-side profiler timeline (XProf TraceMe)."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op if falsy or if a
+    trace is already active — nested requests must not kill the outer one)."""
+    global _ACTIVE
+    if not log_dir:
+        yield False
+        return
+    import jax.profiler
+
+    with _LOCK:
+        if _ACTIVE:
+            started = False
+        else:
+            jax.profiler.start_trace(log_dir)
+            _ACTIVE = started = True
+    try:
+        yield started
+    finally:
+        if started:
+            with _LOCK:
+                try:
+                    jax.profiler.stop_trace()
+                finally:
+                    _ACTIVE = False

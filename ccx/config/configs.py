@@ -184,6 +184,11 @@ def analyzer_config_def() -> ConfigDef:
              "Greedy polish candidate moves per iteration.", at_least(1))
     d.define("optimizer.polish.max.iters", Type.INT, 400, Importance.LOW,
              "Greedy polish iteration cap.", at_least(1))
+    d.define("optimizer.profile.dir", Type.STRING, "", Importance.LOW,
+             "When non-empty, capture a jax.profiler (XProf/TensorBoard) "
+             "device trace of each proposal computation into this directory "
+             "(SURVEY.md 5.1: the TPU-side analogue of the reference's JMX "
+             "proposal-computation-timer).")
     return d
 
 
@@ -332,6 +337,12 @@ def webserver_config_def() -> ConfigDef:
     d.define("webserver.trusted.proxy.admin.principals", Type.LIST, (),
              Importance.LOW, "Principals granted ADMIN by the trusted-proxy "
              "provider (others get USER).")
+    d.define("webserver.spnego.admin.principals", Type.LIST, (),
+             Importance.LOW, "Kerberos principals granted ADMIN by the "
+             "SPNEGO provider (others get USER).")
+    d.define("webserver.spnego.service.name", Type.STRING, "HTTP",
+             Importance.LOW, "GSSAPI hostbased service name the SPNEGO "
+             "provider accepts tickets for.")
     d.define("vertx.api.enabled", Type.BOOLEAN, False, Importance.LOW,
              "Alternative API server flavor flag (ref C36; same endpoints).")
     return d

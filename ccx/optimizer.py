@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from ccx.common.profiling import annotate
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import (
     DEFAULT_GOAL_ORDER,
@@ -164,31 +165,34 @@ def optimize(
 
     stack_before = evaluate_stack(m, cfg, goal_names)
     t = _enter("repair")
-    repaired, n_repair = hard_repair(m, cfg, goal_names)
+    with annotate("ccx:repair"):
+        repaired, n_repair = hard_repair(m, cfg, goal_names)
     phases["repair"] = time.monotonic() - t
     t = _enter("anneal")
-    sa = anneal(repaired, cfg, goal_names, opts.anneal)
+    with annotate("ccx:anneal"):
+        sa = anneal(repaired, cfg, goal_names, opts.anneal)
     phases["anneal"] = time.monotonic() - t
     model = sa.model
     stack_after = sa.stack_after
     n_polish = n_repair
     t = _enter("polish")
     if opts.run_polish:
-        polish = greedy_optimize(model, cfg, goal_names, opts.polish)
-        model = polish.model
-        stack_after = polish.stack_after
-        n_polish += polish.n_moves
-        for _ in range(max(opts.max_repair_rounds - 1, 0)):
-            if float(stack_after.hard_violations) <= 0:
-                break
-            model, n_r = hard_repair(model, cfg, goal_names)
-            n_polish += n_r
+        with annotate("ccx:polish"):
             polish = greedy_optimize(model, cfg, goal_names, opts.polish)
-            if polish.n_moves == 0 and n_r == 0:
-                break
             model = polish.model
             stack_after = polish.stack_after
             n_polish += polish.n_moves
+            for _ in range(max(opts.max_repair_rounds - 1, 0)):
+                if float(stack_after.hard_violations) <= 0:
+                    break
+                model, n_r = hard_repair(model, cfg, goal_names)
+                n_polish += n_r
+                polish = greedy_optimize(model, cfg, goal_names, opts.polish)
+                if polish.n_moves == 0 and n_r == 0:
+                    break
+                model = polish.model
+                stack_after = polish.stack_after
+                n_polish += polish.n_moves
     phases["polish"] = time.monotonic() - t
     t = _enter("diff")
     proposals = diff(m, model)

@@ -23,6 +23,7 @@ from ccx.common.exceptions import (
     OptimizationFailureException,
     UserRequestException,
 )
+from ccx.common import profiling
 from ccx.common.metrics import REGISTRY
 
 #: the reference's separate operations log (SURVEY.md §5.1: log4j
@@ -122,23 +123,26 @@ class CruiseControl:
             max_iters=self.config["optimizer.polish.max.iters"],
         )
         if leadership_only:
+            # Swaps relocate replicas and bypass the move-kind draw, so a
+            # leadership-only search (demote) must disable them explicitly.
             anneal = AnnealOptions(
                 n_chains=anneal.n_chains, n_steps=anneal.n_steps,
                 seed=anneal.seed, p_leadership=1.0, p_biased_dest=0.0,
+                p_swap=0.0,
             )
             polish = GreedyOptions(
                 n_candidates=polish.n_candidates, max_iters=polish.max_iters,
-                p_leadership=1.0,
+                p_leadership=1.0, swap_fraction=0.0,
             )
         if disk_only:
             anneal = AnnealOptions(
                 n_chains=anneal.n_chains, n_steps=anneal.n_steps,
                 seed=anneal.seed, p_disk=1.0, p_leadership=0.0,
-                p_biased_dest=0.0,
+                p_biased_dest=0.0, p_swap=0.0,
             )
             polish = GreedyOptions(
                 n_candidates=polish.n_candidates, max_iters=polish.max_iters,
-                p_disk=1.0, p_leadership=0.0,
+                p_disk=1.0, p_leadership=0.0, swap_fraction=0.0,
             )
         return OptimizeOptions(
             anneal=anneal, polish=polish,
@@ -150,7 +154,8 @@ class CruiseControl:
         backend = self.config["goal.optimizer.backend"]
         if progress:
             progress.step(f"Optimizing ({backend} backend, {len(goal_names)} goals)")
-        with REGISTRY.timer("proposal-computation").time():
+        with REGISTRY.timer("proposal-computation").time(), \
+                profiling.trace(self.config["optimizer.profile.dir"]):
             return self._run_optimizer_timed(model, goal_names, opts, progress, backend)
 
     def _run_optimizer_timed(self, model, goal_names, opts, progress,

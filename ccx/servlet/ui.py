@@ -87,10 +87,12 @@ function renderAnomaly(ad) {
   if (!ad) return '<span class="muted">detector not running</span>';
   const sh = Object.entries(ad.selfHealingEnabled || {}).map(([k, v]) =>
     `<td class="${v ? 'ok' : 'muted'}">${k}: ${v ? 'on' : 'off'}</td>`).join('');
-  const recent = (ad.recentAnomalies || []).slice(-8).reverse().map(a =>
-    `<tr><td>${a.type || a.anomalyType || ''}</td>
-     <td>${a.description || JSON.stringify(a)}</td>
-     <td>${a.action || ''}</td></tr>`).join('');
+  const recent = (ad.recentAnomalies || []).slice(-8).reverse().map(a => {
+    const an = a.anomaly || a;
+    return `<tr><td>${an.type || ''}</td>
+     <td>${an.description || JSON.stringify(an)}</td>
+     <td>${a.action || ''}</td></tr>`;
+  }).join('');
   return `<table><tr>${sh}</tr></table>
     <div class="muted">self-healing runs started: ${ad.numSelfHealingStarted},
       pending checks: ${ad.pendingChecks}</div>

@@ -1,0 +1,42 @@
+"""Device-side profiling hooks (SURVEY.md 5.1): jax.profiler trace capture
+around optimizer phases, wired to the optimizer.profile.dir config key."""
+
+import glob
+import os
+
+from ccx.common import profiling
+from ccx.goals.base import GoalConfig
+from ccx.model.fixtures import small_deterministic
+from ccx.optimizer import OptimizeOptions, optimize
+from ccx.search.annealer import AnnealOptions
+from ccx.search.greedy import GreedyOptions
+
+
+def test_trace_noop_without_dir():
+    with profiling.trace("") as started:
+        assert started is False
+    with profiling.trace(None) as started:
+        assert started is False
+
+
+def test_trace_captures_xprof_artifacts(tmp_path):
+    log_dir = str(tmp_path / "xprof")
+    with profiling.trace(log_dir) as started:
+        assert started is True
+        # nested traces must not stop the outer capture
+        with profiling.trace(log_dir) as inner:
+            assert inner is False
+        optimize(
+            small_deterministic(),
+            GoalConfig(),
+            ("StructuralFeasibility", "ReplicaDistributionGoal"),
+            OptimizeOptions(
+                anneal=AnnealOptions(n_chains=2, n_steps=5),
+                polish=GreedyOptions(n_candidates=8, max_iters=2),
+                require_hard_zero=False,
+            ),
+        )
+    artifacts = glob.glob(
+        os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    assert artifacts, f"no XProf trace written under {log_dir}"

@@ -148,6 +148,48 @@ def test_proposals_diff_roundtrip(annealed, small_model):
     assert ActionType.INTER_BROKER_REPLICA_MOVEMENT in kinds
 
 
+def test_lex_accept_sees_lowest_tier():
+    """SA acceptance must not be blind to the lowest-priority soft goal.
+
+    A tier-weighted scalar collapses the last tier below float32 ULP
+    (4^-9 vs O(1) tier-0 costs); the vector-lexicographic acceptance keeps
+    every tier visible: an improvement that only touches the final goal is
+    always accepted, and a worsening there is rejected at low temperature.
+    """
+    from ccx.goals.base import GOAL_REGISTRY
+    from ccx.goals.stack import soft_weights
+    from ccx.search.annealer import lex_accept
+
+    hard_mask = tuple(GOAL_REGISTRY[n].hard for n in DEFAULT_GOAL_ORDER)
+    hard_arr = jnp.asarray(hard_mask)
+    weights = soft_weights(hard_mask)
+    g = len(DEFAULT_GOAL_ORDER)
+    cur = jnp.full((g,), 3.0, jnp.float32)
+    # improvement ONLY in the last (lowest-tier, PreferredLeaderElection) slot
+    better = cur.at[g - 1].add(-1.0)
+    worse = cur.at[g - 1].add(1.0)
+    cold = jnp.asarray(1e-9, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    assert bool(lex_accept(cur, better, hard_arr, weights, cold, key))
+    assert not bool(lex_accept(cur, worse, hard_arr, weights, cold, key))
+
+
+def test_anneal_improves_lowest_tier_goal(small_model):
+    """End-to-end: with leaders knocked off their preferred replica, the
+    full-stack SA (where PreferredLeaderElection is the lowest tier) must
+    recover some of that goal's cost — the round-1 scalarized acceptance
+    could not (VERDICT weak #6)."""
+    m = small_model
+    slot1_ok = np.asarray(m.replica_valid[:, 1]) & np.asarray(m.partition_valid)
+    leader = np.where(slot1_ok, 1, np.asarray(m.leader_slot)).astype(np.int32)
+    m2 = m.replace(leader_slot=jnp.asarray(leader))
+    res = anneal(m2, CFG, DEFAULT_GOAL_ORDER, SMALL_OPTS)
+    ple_before = res.stack_before.by_name()["PreferredLeaderElectionGoal"][1]
+    ple_after = res.stack_after.by_name()["PreferredLeaderElectionGoal"][1]
+    assert ple_before > 0
+    assert ple_after < ple_before
+
+
 def test_greedy_oracle_improves(small_model):
     res = greedy_optimize(
         small_model, CFG, DEFAULT_GOAL_ORDER,
