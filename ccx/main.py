@@ -17,6 +17,7 @@ cluster adapter is the production path.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import sys
 
@@ -45,6 +46,16 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    # Operator backend override (e.g. CCX_JAX_PLATFORM=cpu when the TPU
+    # tunnel is unavailable). Must go through jax.config before first
+    # backend use — the environment preloads jax via sitecustomize, so
+    # JAX_PLATFORMS alone is ignored.
+    platform = os.environ.get("CCX_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        logging.info("jax platform forced to %s (CCX_JAX_PLATFORM)", platform)
     if argv:
         cfg = CruiseControlConfig.from_properties_file(argv[0])
     else:

@@ -134,6 +134,29 @@ class OptimizeOptions:
     #: disable for disk-only stacks — intra-broker moves cannot evacuate
     #: a dead broker
     check_evacuation: bool = True
+    #: also run the pure greedy oracle from the input placement and return
+    #: the lexicographic winner — the portfolio pattern of the reference's
+    #: GoalOptimizer, which precomputes candidate proposals and serves the
+    #: best (SURVEY.md C14/section 2.5). Guarantees the pipeline never
+    #: returns a result lexicographically worse than a plain greedy run of
+    #: the same budget; cheap relative to the SA phase.
+    run_cold_greedy: bool = True
+
+
+def _lex_better(a: StackResult, b: StackResult) -> bool:
+    """True when a's (hard-violations, cost-vector) beats b's
+    lexicographically (hard feasibility always outranks soft tiers)."""
+    import numpy as np
+
+    ka = (float(a.hard_violations),) + tuple(float(x) for x in np.asarray(a.costs))
+    kb = (float(b.hard_violations),) + tuple(float(x) for x in np.asarray(b.costs))
+    tol = 1e-6
+    for x, y in zip(ka, kb):
+        if x < y - tol:
+            return True
+        if x > y + tol:
+            return False
+    return False
 
 
 def optimize(
@@ -194,6 +217,18 @@ def optimize(
                 stack_after = polish.stack_after
                 n_polish += polish.n_moves
     phases["polish"] = time.monotonic() - t
+    if opts.run_cold_greedy:
+        t = _enter("portfolio")
+        with annotate("ccx:portfolio"):
+            cold = greedy_optimize(m, cfg, goal_names, opts.polish)
+            if _lex_better(cold.stack_after, stack_after):
+                model = cold.model
+                stack_after = cold.stack_after
+                # the returned plan is the cold-greedy one (started from the
+                # input placement) — report its move count, not the
+                # abandoned SA path's
+                n_polish = cold.n_moves
+        phases["portfolio"] = time.monotonic() - t
     t = _enter("diff")
     proposals = diff(m, model)
     phases["diff"] = time.monotonic() - t

@@ -188,12 +188,14 @@ def sharded_stack_eval(
 
 def _mask_view(view, owned):
     """Zero a PartitionView's contribution on non-owner shards so a psum
-    reconstructs the owner's values."""
+    reconstructs the owner's values (``owned`` broadcasts over trailing
+    axes of stacked views)."""
 
     def mask(x):
+        ow = owned.reshape(owned.shape + (1,) * (x.ndim - owned.ndim))
         if x.dtype == jnp.bool_:
-            return x & owned
-        return x * owned.astype(x.dtype)
+            return x & ow
+        return x * ow.astype(x.dtype)
 
     return jax.tree.map(mask, view)
 
@@ -244,9 +246,13 @@ def sharded_anneal(
     from ccx.search.state import (
         PartitionView,
         SearchState,
+        TopicGroup,
         make_cost_vector_fn,
         make_move_scorer,
         make_swap_scorer,
+        make_topic_group,
+        max_partitions_per_topic,
+        stack_needs_topic,
         with_placement,
     )
     from ccx.goals import topic_terms as tt_
@@ -292,6 +298,19 @@ def sharded_anneal(
     n_evac = jax.device_put(
         jnp.asarray(n_evac_i, jnp.int32), NamedSharding(mesh, P())
     )
+    # Static topic-membership structure (GLOBAL partition ids), replicated.
+    # The grouped placement mirror it indexes is replicated per chain: every
+    # shard sees the psum'd view of each move, so all shards write identical
+    # mirror cells — reads then need no collective.
+    needs_topic = stack_needs_topic(goal_names)
+    group_rep = (
+        jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P())),
+            make_topic_group(m, max_partitions_per_topic(m)),
+        )
+        if needs_topic
+        else None
+    )
 
     mspecs = model_pspecs(m)
     state_specs = SearchState(
@@ -316,13 +335,19 @@ def sharded_anneal(
         key=P(CHAINS_AXIS, None),
         n_accepted=P(CHAINS_AXIS),
         hard_mask=hard_mask,
+        grouped_assign=(
+            P(CHAINS_AXIS, None, None, None) if needs_topic else None
+        ),
+        grouped_leader=(
+            P(CHAINS_AXIS, None, None) if needs_topic else None
+        ),
     )
 
     import functools as _ft
 
     @_ft.partial(jax.jit, static_argnames=())
-    def run(m_s, keys_s, evac_s, n_evac_s):
-        def body(m_local, keys_local, evac_l, n_evac_l):
+    def run(m_s, keys_s, evac_s, n_evac_s, group_arg):
+        def body(m_local, keys_local, evac_l, n_evac_l, group_l):
             P_local = m_local.assignment.shape[0]
             offset = jax.lax.axis_index(PARTS_AXIS) * P_local
 
@@ -350,6 +375,39 @@ def sharded_anneal(
             cost_vec = make_cost_vector_fn(m_local, goal_names, cfg)(
                 agg, part_sums, mtl_sum, trd_sum, trd_norm
             )
+            # search never carries the [T, B] matrices (ccx.search.state
+            # module docstring) — loud dummies, same as init_search_state
+            agg = agg.replace(
+                topic_replica_count=jnp.zeros((1, 1), jnp.int32),
+                topic_leader_count=jnp.zeros((1, 1), jnp.int32),
+            )
+            # grouped placement mirror, replicated: each member partition is
+            # owned by exactly one shard, which contributes row+1 (others 0);
+            # the psum minus 1 reconstructs the row (-1 for pad entries)
+            ga = gl = None
+            if group_l is not None:
+                mp = group_l.members
+                li = mp - offset
+                mine = (mp >= 0) & (li >= 0) & (li < P_local)
+                lic = jnp.clip(li, 0, P_local - 1)
+                ga = (
+                    jax.lax.psum(
+                        jnp.where(
+                            mine[..., None],
+                            m_local.assignment[lic] + 1,
+                            0,
+                        ),
+                        PARTS_AXIS,
+                    )
+                    - 1
+                )
+                gl = (
+                    jax.lax.psum(
+                        jnp.where(mine, m_local.leader_slot[lic] + 1, 0),
+                        PARTS_AXIS,
+                    )
+                    - 1
+                )
             state0 = SearchState(
                 assignment=m_local.assignment,
                 leader_slot=m_local.leader_slot,
@@ -363,23 +421,22 @@ def sharded_anneal(
                 key=keys_local[0],
                 n_accepted=jnp.asarray(0, jnp.int32),
                 hard_mask=hard_mask,
+                grouped_assign=ga,
+                grouped_leader=gl,
             )
             states = jax.vmap(lambda k: state0.replace(key=k))(keys_local)
 
             # ---- sharding hooks ------------------------------------------
-            def gather(ss, _m, p):
-                li = jnp.clip(p - offset, 0, P_local - 1)
-                owned = (p >= offset) & (p < offset + P_local)
+            def gather(ss, _m, ps):
+                # stacked owner-gather + psum: ps is int32[k] of GLOBAL ids
+                li = jnp.clip(ps - offset, 0, P_local - 1)
+                owned = (ps >= offset) & (ps < offset + P_local)
                 view_local = PartitionView(
                     pvalid=m_local.partition_valid[li] & owned,
                     immovable=m_local.partition_immovable[li] & owned,
                     topic=m_local.partition_topic[li],
-                    lead_load=jax.lax.dynamic_slice_in_dim(
-                        m_local.leader_load, li, 1, axis=1
-                    )[:, 0],
-                    foll_load=jax.lax.dynamic_slice_in_dim(
-                        m_local.follower_load, li, 1, axis=1
-                    )[:, 0],
+                    lead_load=m_local.leader_load[:, li].T,
+                    foll_load=m_local.follower_load[:, li].T,
                     assign=ss.assignment[li],
                     leader=ss.leader_slot[li],
                     disk=ss.replica_disk[li],
@@ -390,7 +447,7 @@ def sharded_anneal(
                 owned = (p >= offset) & (p < offset + P_local)
                 return jnp.clip(p - offset, 0, P_local - 1), owned
 
-            scorer = make_move_scorer(m_local, goal_names, cfg)
+
             hard_arr = jnp.asarray(hard_mask)
             weights = soft_weights(hard_mask)
             n = max(opts.n_steps, 1)
@@ -398,18 +455,15 @@ def sharded_anneal(
             step = _ft.partial(
                 _anneal_step,
                 m=m_local,
-                scorer=scorer,
                 pp=pp,
                 hard_arr=hard_arr,
                 weights=weights,
                 moves_per_step=max(opts.moves_per_step, 1),
-                swap_scorer=(
-                    make_swap_scorer(m_local, goal_names, cfg)
-                    if pp.p_swap > 0
-                    else None
-                ),
+                scorer=make_move_scorer(m_local, goal_names, cfg),
+                swap_scorer=make_swap_scorer(m_local, goal_names, cfg),
                 gather=gather,
                 locate=locate,
+                group=group_l,
             )
 
             def scan_body(ss, t):
@@ -422,17 +476,22 @@ def sharded_anneal(
             states, _ = jax.lax.scan(scan_body, states, jnp.arange(n))
             return states
 
+        group_specs = (
+            TopicGroup(members=P(), member_slot=P())
+            if group_arg is not None
+            else None
+        )
         return jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(mspecs, P(CHAINS_AXIS, None), P(), P()),
+            in_specs=(mspecs, P(CHAINS_AXIS, None), P(), P(), group_specs),
             out_specs=state_specs,
             # the scan carry mixes axis-invariant init values with
             # axis-varying updates; skip the varying-manual-axes check
             check_vma=False,
-        )(m_s, keys_s, evac_s, n_evac_s)
+        )(m_s, keys_s, evac_s, n_evac_s, group_arg)
 
-    states = run(m_sharded, keys, evac, n_evac)
+    states = run(m_sharded, keys, evac, n_evac, group_rep)
 
     best = best_chain_index(np.asarray(states.cost_vec))
     pick = jax.tree.map(lambda a: a[best], states)

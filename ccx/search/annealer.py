@@ -42,6 +42,9 @@ from ccx.search.state import (
     init_search_state,
     make_move_scorer,
     make_swap_scorer,
+    make_topic_group,
+    max_partitions_per_topic,
+    stack_needs_topic,
     with_placement,
 )
 
@@ -183,6 +186,25 @@ def hot_partition_list(
     return _pad_pow2(idx)
 
 
+def _draw_partition(
+    k_p: jnp.ndarray,
+    k_ev: jnp.ndarray,
+    k_evi: jnp.ndarray,
+    pp: ProposalParams,
+    evac: jnp.ndarray | None,
+    n_evac: jnp.ndarray | None,
+):
+    """Index-only partition draw (uniform, or from the hot list with
+    probability p_evac) — no view needed yet."""
+    p = jax.random.randint(k_p, (), 0, pp.p_real)
+    use_evac = jnp.asarray(False)
+    if evac is not None and n_evac is not None:
+        use_evac = (jax.random.uniform(k_ev) < pp.p_evac) & (n_evac > 0)
+        ei = jax.random.randint(k_evi, (), 0, jnp.maximum(n_evac, 1))
+        p = jnp.where(use_evac, evac[ei], p)
+    return p, use_evac
+
+
 def propose_move(
     key: jnp.ndarray,
     state: SearchState,
@@ -193,23 +215,33 @@ def propose_move(
     gather=None,
 ):
     """Draw one candidate move: returns (p, view, old rows, new rows,
-    feasible).
+    feasible). Index draw + local view gather + ``_single_plan``."""
+    k_plan, k_p, k_ev, k_evi = jax.random.split(key, 4)
+    p, use_evac = _draw_partition(k_p, k_ev, k_evi, pp, evac, n_evac)
+    view = (gather or gather_view)(state, m, p)
+    old, new, feasible = _single_plan(k_plan, state, m, pp, view, use_evac)
+    return p, view, old, new, feasible
+
+
+def _single_plan(
+    key: jnp.ndarray,
+    state: SearchState,
+    m: TensorClusterModel,
+    pp: ProposalParams,
+    view,
+    use_evac: jnp.ndarray,
+):
+    """Build one candidate move from a gathered view: returns
+    (old rows, new rows, feasible).
 
     Feasibility masking mirrors the reference's per-goal requirements checks
     (never *create* structural violations): destination must be alive, valid,
     not replica-excluded, not already hosting the partition; leadership may
     only land on alive, non-leadership-excluded brokers; excluded
     (immovable) partitions are untouchable (OptimizationOptions,
-    SURVEY.md C20).
-
-    ``gather(state, p) -> PartitionView`` overrides the local view gather —
-    the partition-axis-sharded search (ccx.parallel) supplies an owner-gather
-    + psum; the RNG draws are replicated, so every shard proposes the same
-    move."""
+    SURVEY.md C20)."""
     R, B, D = m.R, m.B, m.D
-    k_kind, k_p, k_r, k_dst, k_dstu, k_disk, k_bias, k_ev, k_evi = (
-        jax.random.split(key, 9)
-    )
+    k_kind, k_r, k_dst, k_dstu, k_disk, k_bias, k_pref = jax.random.split(key, 7)
 
     kind = jax.random.choice(
         k_kind,
@@ -218,15 +250,13 @@ def propose_move(
             [1.0 - pp.p_leadership - pp.p_disk, pp.p_leadership, pp.p_disk]
         ),
     )
-    p = jax.random.randint(k_p, (), 0, pp.p_real)
-    use_evac = jnp.asarray(False)
-    if evac is not None and n_evac is not None:
-        use_evac = (jax.random.uniform(k_ev) < pp.p_evac) & (n_evac > 0)
-        ei = jax.random.randint(k_evi, (), 0, jnp.maximum(n_evac, 1))
-        p = jnp.where(use_evac, evac[ei], p)
     r = jax.random.randint(k_r, (), 0, R)
-
-    view = (gather or gather_view)(state, m, p)
+    # Half of leadership transfers target the PREFERRED slot (slot 0) — the
+    # move PreferredLeaderElectionGoal wants (ref
+    # goals/PreferredLeaderElectionGoal.java semantics) is rare under a
+    # uniform slot draw.
+    prefer = jax.random.uniform(k_pref) < 0.5
+    r = jnp.where((kind == MOVE_LEADERSHIP) & prefer, 0, r).astype(jnp.int32)
     old_assign = view.assign                  # [R]
     old_leader = view.leader
     old_disk = view.disk                      # [R]
@@ -346,8 +376,6 @@ def propose_move(
         jnp.where(disk_ok, old_disk.at[r].set(disk_new), old_disk),
     )
     return (
-        p,
-        view,
         (old_assign, old_leader, old_disk),
         (new_assign, new_leader, new_disk),
         feasible,
@@ -367,13 +395,38 @@ def propose_swap(
     without transiently violating the count-distribution band.
 
     Returns (p1, view1, old1, new1, p2, view2, old2, new2, feasible)."""
-    R, B, D = m.R, m.B, m.D
-    k_p1, k_p2, k_r1, k_r2, k_d1, k_d2 = jax.random.split(key, 6)
+    k_p1, k_p2, k_plan = jax.random.split(key, 3)
     p1 = jax.random.randint(k_p1, (), 0, pp.p_real)
     p2 = jax.random.randint(k_p2, (), 0, pp.p_real)
     g = gather or gather_view
     view1 = g(state, m, p1)
     view2 = g(state, m, p2)
+    old1, new1, old2, new2, ok = _swap_plan(k_plan, m, pp, p1, view1, p2, view2)
+    return p1, view1, old1, new1, p2, view2, old2, new2, ok
+
+
+def _swap_plan(
+    key: jnp.ndarray,
+    m: TensorClusterModel,
+    pp: ProposalParams,
+    p1: jnp.ndarray,
+    view1,
+    p2: jnp.ndarray,
+    view2,
+):
+    """Build a swap candidate from two gathered views: returns
+    (old1, new1, old2, new2, feasible).
+
+    Two variants share the draw: a REPLICA swap (exchange brokers between
+    two replicas — preserves every broker's replica count) and a LEADERSHIP
+    swap (rotate leadership p1->broker(leader2), p2->broker(leader1) —
+    preserves every broker's LEADER count). The leadership swap is how
+    preferred-leader / leader-bytes improvements cross the
+    LeaderReplicaDistribution tier, which vetoes any single transfer that
+    unbalances leader counts (the reference reaches these states through
+    PreferredLeaderElectionGoal's count-neutral passes)."""
+    R, B, D = m.R, m.B, m.D
+    k_r1, k_r2, k_d1, k_d2, k_kind = jax.random.split(key, 5)
     r1 = jax.random.randint(k_r1, (), 0, R)
     r2 = jax.random.randint(k_r2, (), 0, R)
     x = view1.assign[r1]
@@ -418,7 +471,49 @@ def propose_swap(
         view2.leader,
         view2.disk.at[r2].set(jnp.where(D > 1, d2, 0)),
     )
-    return p1, view1, old1, new1, p2, view2, old2, new2, ok
+
+    # --- leadership-swap variant ------------------------------------------
+    lb1 = jnp.clip(view1.assign[jnp.clip(view1.leader, 0, R - 1)], 0, B - 1)
+    lb2 = jnp.clip(view2.assign[jnp.clip(view2.leader, 0, R - 1)], 0, B - 1)
+    # p1's leadership lands on lb2 (needs a replica there), p2's on lb1
+    on_lb2 = view1.assign == lb2
+    on_lb1 = view2.assign == lb1
+    r1l = jnp.argmax(on_lb2).astype(jnp.int32)
+    r2l = jnp.argmax(on_lb1).astype(jnp.int32)
+    lead_allowed = (
+        m.broker_valid & m.broker_alive & ~m.broker_excl_leadership
+    )
+    ok_lead = (
+        (p1 != p2)
+        & view1.pvalid
+        & view2.pvalid
+        & ~view1.immovable
+        & ~view2.immovable
+        & (lb1 != lb2)
+        & jnp.any(on_lb2)
+        & jnp.any(on_lb1)
+        & lead_allowed[lb1]
+        & lead_allowed[lb2]
+    )
+    use_lead = (
+        (jax.random.uniform(k_kind) < 0.5) if pp.p_leadership > 0 else False
+    )
+    if pp.p_leadership > 0:
+        def sel_rows(a, b):
+            return jnp.where(use_lead, a, b)
+
+        new1 = (
+            sel_rows(view1.assign, new1[0]),
+            jnp.where(use_lead, r1l, new1[1]).astype(jnp.int32),
+            sel_rows(view1.disk, new1[2]),
+        )
+        new2 = (
+            sel_rows(view2.assign, new2[0]),
+            jnp.where(use_lead, r2l, new2[1]).astype(jnp.int32),
+            sel_rows(view2.disk, new2[2]),
+        )
+        ok = jnp.where(use_lead, ok_lead, ok)
+    return old1, new1, old2, new2, ok
 
 
 def goal_tols(cost_vec: jnp.ndarray) -> jnp.ndarray:
@@ -459,70 +554,115 @@ def _anneal_step(
     n_evac: jnp.ndarray,
     *,
     m: TensorClusterModel,
-    scorer,
     pp: ProposalParams,
     hard_arr: jnp.ndarray,
     weights: jnp.ndarray,
     moves_per_step: int,
-    swap_scorer=None,
+    scorer,
+    swap_scorer,
     gather=None,
     locate=None,
+    group=None,
 ) -> SearchState:
     """``moves_per_step`` sequential proposals on one chain (vmapped over
     chains by the caller). Sequential composition inside the step is exact:
     each proposal scores against the state left by the previous one.
 
-    ``gather``/``locate`` are the partition-axis-sharding hooks
-    (ccx.parallel): ``gather(state, p)`` produces the PartitionView (owner
-    gather + psum), ``locate(p) -> (local_index, owned)`` maps the global
-    partition id onto this shard's slice."""
+    Every proposal — single move or REPLICA_SWAP — flows through ONE
+    two-partition code path (a single move is a degenerate swap whose second
+    partition is inert). A ``lax.cond`` between a single-move branch and a
+    swap branch doubles the number of uses of every loop-carried buffer,
+    which defeats XLA's in-place scatter analysis and copies the whole
+    search state per move (measured 95 ms/move at B5 scale on CPU vs
+    ~2 ms condless). The unified path keeps exactly one stacked gather and
+    one stacked scatter per carried buffer per proposal.
 
-    def single(ss: SearchState, k_prop, k_acc) -> SearchState:
-        p, view, old, new, feasible = propose_move(
-            k_prop, ss, m, pp, evac, n_evac, gather=gather
-        )
+    ``gather``/``locate`` are the partition-axis-sharding hooks
+    (ccx.parallel): ``gather(state, ps)`` produces the stacked PartitionView
+    (owner gather + psum), ``locate(p) -> (local_index, owned)`` maps a
+    global partition id onto this shard's slice."""
+    from ccx.search.state import gather_views, view_at
+
+    def inner_single_only(i, ss: SearchState) -> SearchState:
+        # Static fast path for p_swap == 0 stacks (leadership-only demote,
+        # disk-only rebalance): no second-partition gather/scatter at all,
+        # and rejected moves stay bit-exact no-ops.
+        key = jax.random.fold_in(ss.key, step_idx * moves_per_step + i)
+        k_p, k_ev, k_evi, k_single, k_acc = jax.random.split(key, 5)
+        p, use_evac = _draw_partition(k_p, k_ev, k_evi, pp, evac, n_evac)
+        views = (gather or gather_views)(ss, m, jnp.stack([p]))
+        view = view_at(views, 0)
+        old, new, feasible = _single_plan(k_single, ss, m, pp, view, use_evac)
         delta = scorer(ss, view, old, new)
         accept = feasible & lex_accept(
             ss.cost_vec, delta.cost_vec, hard_arr, weights, temperature, k_acc
         )
         p_idx, owned = locate(p) if locate is not None else (p, True)
-        return apply_move(ss, m, p_idx, view, old, new, delta, accept, owned)
-
-    def swap(ss: SearchState, k_prop, k_acc) -> SearchState:
-        p1, v1, o1, n1, p2, v2, o2, n2, feasible = propose_swap(
-            k_prop, ss, m, pp, gather=gather
-        )
-        delta = swap_scorer(ss, v1, o1, n1, v2, o2, n2)
-        accept = feasible & lex_accept(
-            ss.cost_vec, delta.cost_vec, hard_arr, weights, temperature, k_acc
-        )
-        if locate is not None:
-            i1, own1 = locate(p1)
-            i2, own2 = locate(p2)
-        else:
-            i1, own1, i2, own2 = p1, True, p2, True
-        return apply_swap(
-            ss, m, i1, v1, o1, n1, i2, v2, o2, n2, delta, accept, own1, own2
+        return apply_move(
+            ss, m, p_idx, view, old, new, delta, accept, owned,
+            group=group, global_p=p,
         )
 
     def inner(i, ss: SearchState) -> SearchState:
         key = jax.random.fold_in(ss.key, step_idx * moves_per_step + i)
-        k_sel, k_prop, k_acc = jax.random.split(key, 3)
-        if pp.p_swap <= 0.0 or swap_scorer is None:
-            return single(ss, k_prop, k_acc)
+        k_sel, k_p, k_ev, k_evi, k_p1, k_p2, k_single, k_swap, k_acc = (
+            jax.random.split(key, 9)
+        )
         use_swap = jax.random.uniform(k_sel) < pp.p_swap
-        return jax.lax.cond(
-            use_swap,
-            lambda s: swap(s, k_prop, k_acc),
-            lambda s: single(s, k_prop, k_acc),
-            ss,
+
+        p_single, use_evac = _draw_partition(k_p, k_ev, k_evi, pp, evac, n_evac)
+        p1_sw = jax.random.randint(k_p1, (), 0, pp.p_real)
+        p2_sw = jax.random.randint(k_p2, (), 0, pp.p_real)
+        pa = jnp.where(use_swap, p1_sw, p_single)
+        pb = p2_sw
+
+        views = (gather or gather_views)(ss, m, jnp.stack([pa, pb]))
+        va, vb = view_at(views, 0), view_at(views, 1)
+
+        old_s, new_s, feas_s = _single_plan(
+            k_single, ss, m, pp, va, use_evac & ~use_swap
+        )
+        o1w, n1w, o2w, n2w, ok_w = _swap_plan(k_swap, m, pp, pa, va, pb, vb)
+
+        def pick(a, b):
+            return jnp.where(use_swap, a, b)
+
+        def inert(rows):
+            # single moves blank partition b's rows to -1: its scatter
+            # contributions then carry weight 0 exactly (valid mask False),
+            # keeping the inert partition a bit-exact no-op instead of a
+            # float (a - x) + x round trip
+            return tuple(jnp.where(use_swap, r, -1) for r in rows)
+
+        olda = (va.assign, va.leader, va.disk)
+        newa = (pick(n1w[0], new_s[0]), pick(n1w[1], new_s[1]),
+                pick(n1w[2], new_s[2]))
+        oldb = inert((vb.assign, vb.leader, vb.disk))
+        newb = inert((n2w[0], n2w[1], n2w[2]))
+        feasible = jnp.where(use_swap, ok_w, feas_s)
+
+        delta = swap_scorer(ss, va, olda, newa, vb, oldb, newb)
+        accept = feasible & lex_accept(
+            ss.cost_vec, delta.cost_vec, hard_arr, weights, temperature, k_acc
+        )
+        if locate is not None:
+            ia, owna = locate(pa)
+            ib, ownb = locate(pb)
+        else:
+            ia, owna, ib, ownb = pa, True, pb, True
+        return apply_swap(
+            ss, m, ia, va, olda, newa, ib, vb, oldb, newb, delta, accept,
+            owna, ownb, group=group, global_p1=pa, global_p2=pb,
+            active2=use_swap,
         )
 
-    return jax.lax.fori_loop(0, moves_per_step, inner, state)
+    body = inner if pp.p_swap > 0.0 else inner_single_only
+    return jax.lax.fori_loop(0, moves_per_step, body, state)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("goal_names", "cfg", "opts", "p_real", "b_real")
+    jax.jit,
+    static_argnames=("goal_names", "cfg", "opts", "p_real", "b_real", "max_pt"),
 )
 def _run_chains(
     m: TensorClusterModel,
@@ -535,9 +675,10 @@ def _run_chains(
     opts: AnnealOptions,
     p_real: int,
     b_real: int,
+    max_pt: int,
 ) -> SearchState:
-    scorer = make_move_scorer(m, goal_names, cfg)
-    state0 = init_search_state(m, cfg, goal_names, keys[0])
+    group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
+    state0 = init_search_state(m, cfg, goal_names, keys[0], group=group)
     states = jax.vmap(lambda k: state0.replace(key=k))(keys)
     hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
     hard_arr = jnp.asarray(hard_mask)
@@ -561,14 +702,13 @@ def _run_chains(
     step = functools.partial(
         _anneal_step,
         m=m,
-        scorer=scorer,
         pp=pp,
         hard_arr=hard_arr,
         weights=weights,
         moves_per_step=max(opts.moves_per_step, 1),
-        swap_scorer=(
-            make_swap_scorer(m, goal_names, cfg) if pp.p_swap > 0 else None
-        ),
+        scorer=make_move_scorer(m, goal_names, cfg),
+        swap_scorer=make_swap_scorer(m, goal_names, cfg),
+        group=group,
     )
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
@@ -634,6 +774,7 @@ def anneal(
         m, keys, jnp.asarray(evac), jnp.asarray(n_evac, jnp.int32),
         goal_names=goal_names, cfg=cfg, opts=opts,
         p_real=p_real, b_real=b_real,
+        max_pt=max_partitions_per_topic(m),
     )
 
     best = best_chain_index(np.asarray(states.cost_vec))

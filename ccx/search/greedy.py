@@ -53,6 +53,9 @@ from ccx.search.state import (
     init_search_state,
     make_move_scorer,
     make_swap_scorer,
+    make_topic_group,
+    max_partitions_per_topic,
+    stack_needs_topic,
     with_placement,
 )
 
@@ -111,7 +114,7 @@ def _lex_argmin(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("goal_names", "cfg", "pp", "opts")
+    jax.jit, static_argnames=("goal_names", "cfg", "pp", "opts", "max_pt")
 )
 def _greedy_loop(
     m: TensorClusterModel,
@@ -124,7 +127,9 @@ def _greedy_loop(
     cfg: GoalConfig,
     pp: ProposalParams,
     opts: GreedyOptions,
+    max_pt: int,
 ):
+    group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
     scorer = make_move_scorer(m, goal_names, cfg)
     n_swap = int(opts.n_candidates * opts.swap_fraction) if pp.p_swap > 0 else 0
     n_single = max(opts.n_candidates - n_swap, 1)
@@ -154,7 +159,7 @@ def _greedy_loop(
         def apply_best_single(s):
             return apply_move(
                 s, m, ps[best], pick(views), pick(olds), pick(news),
-                pick(deltas), any_single,
+                pick(deltas), any_single, group=group,
             )
 
         if n_swap:
@@ -184,7 +189,7 @@ def _greedy_loop(
                 return apply_swap(
                     s, m, sw[0][best_w], pick_w(sw[1]), pick_w(sw[2]),
                     pick_w(sw[3]), sw[4][best_w], pick_w(sw[5]), pick_w(sw[6]),
-                    pick_w(sw[7]), pick_w(sw_delta), any_swap,
+                    pick_w(sw[7]), pick_w(sw_delta), any_swap, group=group,
                 )
 
             ss = jax.lax.cond(take_swap, apply_best_swap, apply_best_single, ss)
@@ -230,7 +235,13 @@ def greedy_optimize(
     )
 
     evac_np, n_evac_i = hot_partition_list(m, goal_names)
-    state0 = init_search_state(m, cfg, goal_names, jax.random.PRNGKey(opts.seed))
+    max_pt = max_partitions_per_topic(m)
+    group0 = (
+        make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
+    )
+    state0 = init_search_state(
+        m, cfg, goal_names, jax.random.PRNGKey(opts.seed), group=group0
+    )
     state, n_iters, n_moves = _greedy_loop(
         m,
         state0,
@@ -241,6 +252,7 @@ def greedy_optimize(
         cfg=cfg,
         pp=pp,
         opts=opts,
+        max_pt=max_pt,
     )
 
     result_model = with_placement(m, state)

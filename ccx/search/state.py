@@ -9,12 +9,17 @@ TPU-native analogue of the reference's ``ClusterModel.relocateReplica`` /
 * **[B]-level aggregates** (broker_load, replica/leader counts, potential
   nw-out, leader bytes-in, disk_load) — O(R) scatter-adds per move; goal
   kernels re-score them in O(B) per candidate (small).
-* **[T, B] topic count matrices** — sparse cell updates only. Candidate
-  scoring NEVER materializes a per-candidate copy (the round-1 bottleneck:
-  ~0.5 GB of traffic per 256-candidate batch at B5 scale). Instead the two
-  topic goals' contributions are carried as exact scalar accumulators,
-  re-scored per move from only the ONE topic row the move touches
-  (``ccx.goals.topic_terms`` row functions — shared with the full kernels).
+* **[T, B] topic count matrices** — NOT carried in the search state at all.
+  Round 2 finding: reading a topic row and scatter-writing cells of the same
+  loop-carried [T, B] matrix defeats XLA's in-place buffer reuse, copying
+  both matrices every move (~128 MB/move at B5 scale across 32 chains —
+  measured 40 ms/move on CPU vs <1 ms for everything else combined). The
+  two topic goals' contributions are instead carried as exact scalar
+  accumulators, and the ONE topic row a move touches is **derived on demand
+  from the live assignment** via a static topic→member-partitions index
+  (``topic_member_index``; O(max-partitions-per-topic × R) gather +
+  [B]-scatter, a few KB) and re-scored with the shared
+  ``ccx.goals.topic_terms`` row functions.
 * **per-partition goal sums** (``ccx.goals.partition_terms``) — row deltas.
 * **the full per-goal cost vector** — assembled exactly per candidate, so
   acceptance can compare lexicographically (no tier-weight float32 blindness
@@ -61,6 +66,10 @@ class SearchState:
     key: jnp.ndarray           # PRNG key
     n_accepted: jnp.ndarray    # int32 scalar
     hard_mask: tuple[bool, ...] = struct.field(pytree_node=False)
+    #: topic-grouped mirror of the placement (``grouped_placement``): present
+    #: iff the stack scores topic goals; None otherwise
+    grouped_assign: jnp.ndarray | None = None   # int32[T, max_pt, R]
+    grouped_leader: jnp.ndarray | None = None   # int32[T, max_pt]
 
     @property
     def hard_cost(self) -> jnp.ndarray:
@@ -135,6 +144,150 @@ def gather_view(state: SearchState, m: TensorClusterModel, p: jnp.ndarray) -> Pa
     )
 
 
+def gather_views(
+    state: SearchState, m: TensorClusterModel, ps: jnp.ndarray
+) -> PartitionView:
+    """Stacked local gather: one PartitionView with leading axis len(ps).
+
+    The annealer's unified two-partition step gathers BOTH partitions of a
+    (possibly degenerate) swap in a single stacked read per carried buffer —
+    two separate gathers would be a second use and defeat XLA's in-place
+    scatter on the buffer (module docstring)."""
+    return PartitionView(
+        pvalid=m.partition_valid[ps],
+        immovable=m.partition_immovable[ps],
+        topic=m.partition_topic[ps],
+        lead_load=m.leader_load[:, ps].T,     # [k, RES]
+        foll_load=m.follower_load[:, ps].T,
+        assign=state.assignment[ps],          # [k, R]
+        leader=state.leader_slot[ps],
+        disk=state.replica_disk[ps],
+    )
+
+
+def view_at(views: PartitionView, i: int) -> PartitionView:
+    """The i-th PartitionView of a stacked gather."""
+    return jax.tree.map(lambda x: x[i], views)
+
+
+def max_partitions_per_topic(m: TensorClusterModel) -> int:
+    """Host-side static bound for ``topic_member_index`` (jit static arg)."""
+    import numpy as np
+
+    topic = np.asarray(m.partition_topic)
+    valid = np.asarray(m.partition_valid)
+    if not valid.any():
+        return 1
+    return max(int(np.bincount(topic[valid], minlength=m.num_topics).max()), 1)
+
+
+def topic_member_index(m: TensorClusterModel, max_pt: int) -> jnp.ndarray:
+    """int32[T, max_pt] — partition ids of each topic's valid partitions,
+    -1 padded. Static during a search (topic membership never changes);
+    device-computable so it can be built inside a jitted runner."""
+    T = m.num_topics
+    topic = jnp.where(
+        m.partition_valid, m.partition_topic, jnp.int32(T)
+    )  # invalid partitions sort to a sentinel bucket past every topic
+    order = jnp.argsort(topic).astype(jnp.int32)
+    counts = jnp.zeros(T + 1, jnp.int32).at[topic].add(1)[:T]
+    starts = jnp.cumsum(counts) - counts
+    idx = starts[:, None] + jnp.arange(max_pt, dtype=jnp.int32)[None, :]
+    in_range = jnp.arange(max_pt)[None, :] < counts[:, None]
+    return jnp.where(in_range, order[jnp.clip(idx, 0, m.P - 1)], -1)
+
+
+#: goals whose incremental scoring needs per-topic broker-count rows
+TOPIC_GOALS = frozenset(
+    {"MinTopicLeadersPerBrokerGoal", "TopicReplicaDistributionGoal"}
+)
+
+
+def stack_needs_topic(goal_names: tuple[str, ...]) -> bool:
+    """True when the stack scores topic goals — the searches then carry the
+    grouped placement mirror (``make_topic_group`` + ``grouped_placement``)."""
+    return bool(TOPIC_GOALS & set(goal_names))
+
+
+@struct.dataclass
+class TopicGroup:
+    """Static topic-membership structure (never mutated during search).
+
+    ``members[t, j]`` — global partition id of topic t's j-th valid
+    partition (-1 pad); ``member_slot[p]`` — j such that
+    ``members[topic(p), j] == p`` (0 for invalid partitions — writes for
+    those are routed out of bounds and dropped)."""
+
+    members: jnp.ndarray      # int32[T, max_pt]
+    member_slot: jnp.ndarray  # int32[P]
+
+
+def make_topic_group(m: TensorClusterModel, max_pt: int) -> TopicGroup:
+    members = topic_member_index(m, max_pt)
+    flat = members.reshape(-1)
+    slots = jnp.tile(
+        jnp.arange(members.shape[1], dtype=jnp.int32), members.shape[0]
+    )
+    ok = flat >= 0
+    # every valid partition appears exactly once; pad entries add 0 at p=0
+    member_slot = (
+        jnp.zeros(m.P, jnp.int32)
+        .at[jnp.clip(flat, 0, m.P - 1)]
+        .add(jnp.where(ok, slots, 0))
+    )
+    return TopicGroup(members=members, member_slot=member_slot)
+
+
+def grouped_placement(
+    m: TensorClusterModel, group: TopicGroup
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Initial topic-grouped mirror of (assignment rows, leader slot):
+    ``grouped_assign[t, j] = assignment[members[t, j]]`` (-1 pad rows).
+
+    Why a mirror exists at all: the topic goals need topic t's per-broker
+    counts each move. Deriving them from ``assignment`` adds a second gather
+    on the loop-carried placement arrays, and XLA abandons in-place scatter
+    on a carried buffer with more than one read — copying ~3.5 MB x chains
+    per move (measured 17 ms/move at B5 scale). The mirror gives every
+    carried buffer exactly one read + one write per move: ``assignment``
+    keeps its view-gather + row-write, the mirror gets one block read +
+    one cell write, and both stay in-place."""
+    ok = group.members >= 0
+    mpc = jnp.clip(group.members, 0, m.P - 1)
+    ga = jnp.where(ok[..., None], m.assignment[mpc], -1)
+    gl = jnp.where(ok, m.leader_slot[mpc], -1)
+    return ga, gl
+
+
+def derived_topic_rows(state: "SearchState", ts: jnp.ndarray, B: int):
+    """Per-broker (replica_count, leader_count) int32[..., B] rows for the
+    topic(s) ``ts`` (scalar or [k]), derived from the grouped mirror with a
+    single stacked gather."""
+    if state.grouped_assign is None:
+        raise ValueError(
+            "goal stack scores topic goals but the search state carries no "
+            "grouped placement mirror — init_search_state(group=...) required"
+        )
+    blocks = state.grouped_assign[ts]        # [..., max_pt, R]
+    leads = state.grouped_leader[ts]         # [..., max_pt]
+    valid = blocks >= 0
+    b = jnp.clip(blocks, 0, B - 1)
+    R = blocks.shape[-1]
+    is_lead = (jnp.arange(R) == leads[..., None]) & valid
+
+    def count(vals):
+        flat_b = b.reshape(*b.shape[:-2], -1)
+        flat_v = vals.reshape(*vals.shape[:-2], -1).astype(jnp.int32)
+        zero = jnp.zeros((*b.shape[:-2], B), jnp.int32)
+        if flat_b.ndim == 1:
+            return zero.at[flat_b].add(flat_v)
+        return jax.vmap(lambda z, bb, vv: z.at[bb].add(vv))(
+            zero, flat_b, flat_v
+        )
+
+    return count(valid), count(is_lead)
+
+
 def _scatter_broker_fields(
     agg: BrokerAggregates,
     m: TensorClusterModel,
@@ -194,22 +347,13 @@ def scatter_partition(
     w_f: jnp.ndarray,          # f32 scalar weight (+1 add, -1 remove, 0 no-op)
     w_i: jnp.ndarray,          # int32 scalar weight
 ) -> BrokerAggregates:
-    """Full weighted scatter: the [B]-level fields plus the sparse [T, B]
-    topic count cells. All updates touch <= 2R cells per array."""
-    R = assign_row.shape[0]
-    valid = (assign_row >= 0) & view.pvalid
-    b = jnp.clip(assign_row, 0, m.B - 1)
-    is_lead = (jnp.arange(R) == leader_slot_p) & valid
-    vi = valid.astype(jnp.int32)
-    li = is_lead.astype(jnp.int32)
-    t = view.topic
-
-    agg = _scatter_broker_fields(
+    """Weighted scatter of one partition's contribution into the [B]-level
+    aggregate fields (<= 2R cells per array). The [T, B] topic matrices are
+    deliberately NOT maintained during search — topic rows are derived on
+    demand from the assignment (``make_topic_rows_fn``; see module
+    docstring for the copy-per-move pathology this avoids)."""
+    return _scatter_broker_fields(
         agg, m, view, assign_row, leader_slot_p, disk_row, w_f, w_i
-    )
-    return agg.replace(
-        topic_replica_count=agg.topic_replica_count.at[t, b].add(w_i * vi),
-        topic_leader_count=agg.topic_leader_count.at[t, b].add(w_i * li),
     )
 
 
@@ -334,18 +478,19 @@ def make_cost_vector_fn(
 
 
 def make_move_scorer(
-    m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig
+    m: TensorClusterModel,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
 ):
-    """Build ``score(state, p, old_rows, new_rows) -> MoveDelta``.
+    """Build ``score(state, view, old_rows, new_rows) -> MoveDelta``.
 
     Per move this touches: O(R) scatter cells on the [B]-level aggregates,
-    ONE [B] row of each [T, B] matrix (gathered, never copied per candidate),
-    and O(B) kernel re-scores — independent of P and T.
+    ONE topic-row pair derived from the grouped mirror
+    (``derived_topic_rows``), and O(B) kernel re-scores — independent of
+    P and T.
     """
     vector_fn = make_cost_vector_fn(m, goal_names, cfg)
-    needs_topic = bool(
-        set(goal_names) & {"MinTopicLeadersPerBrokerGoal", "TopicReplicaDistributionGoal"}
-    )
+    needs_topic = stack_needs_topic(goal_names)
     T = m.num_topics
 
     def score(
@@ -368,8 +513,7 @@ def make_move_scorer(
         if needs_topic:
             t = view.topic
             drc, dlc = topic_row_delta(m, view, old, new)
-            trc_row = state.agg.topic_replica_count[t]
-            tlc_row = state.agg.topic_leader_count[t]
+            trc_row, tlc_row = derived_topic_rows(state, t, m.B)
             new_trc = trc_row + drc
             new_tlc = tlc_row + dlc
             flagged = m.topic_min_leaders[t]
@@ -410,6 +554,52 @@ def make_move_scorer(
     return score
 
 
+def _placement_updates(
+    state: SearchState,
+    group: "TopicGroup | None",
+    write: jnp.ndarray,      # bool[k] — row writes to perform (accept&owned)
+    ps: jnp.ndarray,         # int32[k] LOCAL partition indexes
+    mirror: jnp.ndarray,     # bool[k] — mirror writes (accept, every shard)
+    global_ps: jnp.ndarray,  # int32[k] GLOBAL partition ids
+    ts: jnp.ndarray,         # int32[k] topics
+    rows: jnp.ndarray,       # int32[k, R] new assignment rows
+    leads: jnp.ndarray,      # int32[k] new leader slots
+    disks: jnp.ndarray,      # int32[k, R] new disk rows
+) -> dict:
+    """Placement (+ grouped-mirror) writes as stacked mode='drop' scatters.
+
+    Every carried buffer gets exactly ONE scatter per move batch and no
+    extra read: suppressed writes (reject / non-owner shard / invalid
+    partition) are routed to an out-of-bounds index and dropped, instead of
+    writing the current value back — a re-read of the current row would be a
+    second use of the loop-carried buffer, which defeats XLA's in-place
+    scatter and copies the whole array every move (see module docstring)."""
+    Pn = state.assignment.shape[0]
+    pidx = jnp.where(write, ps, Pn)
+    out = dict(
+        assignment=state.assignment.at[pidx].set(rows, mode="drop"),
+        leader_slot=state.leader_slot.at[pidx].set(leads, mode="drop"),
+        replica_disk=state.replica_disk.at[pidx].set(disks, mode="drop"),
+    )
+    if state.grouped_assign is None:
+        return out
+    if group is None:
+        raise ValueError("state carries a grouped mirror; pass group=")
+    max_pt = group.members.shape[1]
+    slots = jnp.where(
+        mirror,
+        group.member_slot[jnp.clip(global_ps, 0, group.member_slot.shape[0] - 1)],
+        max_pt,
+    )
+    out["grouped_assign"] = state.grouped_assign.at[ts, slots].set(
+        rows, mode="drop"
+    )
+    out["grouped_leader"] = state.grouped_leader.at[ts, slots].set(
+        leads, mode="drop"
+    )
+    return out
+
+
 def apply_move(
     state: SearchState,
     m: TensorClusterModel,
@@ -420,14 +610,18 @@ def apply_move(
     delta: MoveDelta,
     accept: jnp.ndarray,        # bool scalar
     owned: jnp.ndarray | bool = True,
+    group: "TopicGroup | None" = None,
+    global_p: jnp.ndarray | None = None,
 ) -> SearchState:
     """Apply a scored move iff ``accept`` — reject is a bit-exact no-op
-    (all scatters run with weight 0; integer accumulators add 0).
+    (suppressed writes are dropped; weighted scatters run with weight 0).
 
     ``p`` indexes this state's [P]-axis arrays (a *local* index when the
-    partition axis is sharded); ``owned`` gates the row writes so only the
-    shard owning the partition mutates placement, while the replicated
-    aggregates/accumulators are updated identically on every shard."""
+    partition axis is sharded; ``global_p`` is the mesh-global id for the
+    grouped-mirror write, defaulting to ``p``); ``owned`` gates the row
+    writes so only the shard owning the partition mutates placement, while
+    the replicated aggregates/accumulators/mirror are updated identically
+    on every shard."""
     af = accept.astype(jnp.float32)
     ai = accept.astype(jnp.int32)
     agg = scatter_partition(state.agg, m, view, *old, -af, -ai)
@@ -438,20 +632,8 @@ def apply_move(
     def sel(n, o):
         return jnp.where(accept, n, o)
 
-    def sel_row(n, cur):
-        # non-owners write their own current row back (bit-exact no-op)
-        return jnp.where(accept & owned, n, cur)
-
+    gp = p if global_p is None else global_p
     return state.replace(
-        assignment=state.assignment.at[p].set(
-            sel_row(new[0], state.assignment[p])
-        ),
-        leader_slot=state.leader_slot.at[p].set(
-            sel_row(new[1], state.leader_slot[p])
-        ),
-        replica_disk=state.replica_disk.at[p].set(
-            sel_row(new[2], state.replica_disk[p])
-        ),
         agg=agg,
         part_sums=sel(delta.part_sums, state.part_sums),
         topic_totals=state.topic_totals.at[t].add(af * delta.d_total),
@@ -459,6 +641,18 @@ def apply_move(
         trd_sum=state.trd_sum + af * delta.d_trd,
         cost_vec=sel(delta.cost_vec, state.cost_vec),
         n_accepted=state.n_accepted + ai,
+        **_placement_updates(
+            state,
+            group,
+            write=jnp.stack([accept & owned]),
+            ps=jnp.stack([p]),
+            mirror=jnp.stack([accept & view.pvalid]),
+            global_ps=jnp.stack([gp]),
+            ts=jnp.stack([t]),
+            rows=jnp.stack([new[0]]),
+            leads=jnp.stack([new[1]]),
+            disks=jnp.stack([new[2]]),
+        ),
     )
 
 
@@ -467,6 +661,7 @@ def init_search_state(
     cfg: GoalConfig,
     goal_names: tuple[str, ...],
     key: jnp.ndarray,
+    group: "TopicGroup | None" = None,
 ) -> SearchState:
     """Full (non-incremental) evaluation of the starting state. The cost
     vector is assembled through the same row functions the incremental path
@@ -483,6 +678,16 @@ def init_search_state(
     cost_vec = make_cost_vector_fn(m, goal_names, cfg)(
         agg, part_sums, mtl_sum, trd_sum, trd_norm
     )
+    # The [T, B] matrices are NOT maintained during search (module
+    # docstring); carry loud [1, 1] dummies so any stale read fails on shape
+    # instead of silently returning the initial counts.
+    agg = agg.replace(
+        topic_replica_count=jnp.zeros((1, 1), jnp.int32),
+        topic_leader_count=jnp.zeros((1, 1), jnp.int32),
+    )
+    ga = gl = None
+    if group is not None:
+        ga, gl = grouped_placement(m, group)
     return SearchState(
         assignment=m.assignment,
         leader_slot=m.leader_slot,
@@ -496,6 +701,8 @@ def init_search_state(
         key=key,
         n_accepted=jnp.asarray(0, jnp.int32),
         hard_mask=tuple(GOAL_REGISTRY[n].hard for n in goal_names),
+        grouped_assign=ga,
+        grouped_leader=gl,
     )
 
 
@@ -509,7 +716,9 @@ def with_placement(m: TensorClusterModel, s: SearchState) -> TensorClusterModel:
 
 
 def make_swap_scorer(
-    m: TensorClusterModel, goal_names: tuple[str, ...], cfg: GoalConfig
+    m: TensorClusterModel,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
 ):
     """Build ``score_swap(state, view1, old1, new1, view2, old2, new2) ->
     MoveDelta`` for two-partition REPLICA_SWAP actions (ref ActionType,
@@ -528,10 +737,7 @@ def make_swap_scorer(
     bit-exactly on the incremental state.
     """
     vector_fn = make_cost_vector_fn(m, goal_names, cfg)
-    needs_topic = bool(
-        set(goal_names)
-        & {"MinTopicLeadersPerBrokerGoal", "TopicReplicaDistributionGoal"}
-    )
+    needs_topic = stack_needs_topic(goal_names)
     T = m.num_topics
 
     def score_swap(
@@ -563,10 +769,12 @@ def make_swap_scorer(
             same = t1 == t2
             drc1, dlc1 = topic_row_delta(m, view1, old1, new1)
             drc2, dlc2 = topic_row_delta(m, view2, old2, new2)
-            trc1 = state.agg.topic_replica_count[t1]
-            tlc1 = state.agg.topic_leader_count[t1]
-            trc2 = state.agg.topic_replica_count[t2]
-            tlc2 = state.agg.topic_leader_count[t2]
+            # ONE stacked gather on the grouped mirror for both topics —
+            # two separate reads would be a second use of the carried buffer
+            # (copy-per-move pathology, module docstring)
+            trc12, tlc12 = derived_topic_rows(state, jnp.stack([t1, t2]), m.B)
+            trc1, tlc1 = trc12[0], tlc12[0]
+            trc2, tlc2 = trc12[1], tlc12[1]
             f1 = m.topic_min_leaders[t1]
             f2 = m.topic_min_leaders[t2]
             n_alive = jnp.maximum(
@@ -636,42 +844,35 @@ def apply_swap(
     accept: jnp.ndarray,
     owned1: jnp.ndarray | bool = True,
     owned2: jnp.ndarray | bool = True,
+    group: "TopicGroup | None" = None,
+    global_p1: jnp.ndarray | None = None,
+    global_p2: jnp.ndarray | None = None,
+    active2: jnp.ndarray | bool = True,
 ) -> SearchState:
     """Apply a scored two-partition swap iff ``accept`` (bit-exact no-op on
-    reject, same contract as apply_move)."""
+    reject, same contract as apply_move; both rows land in one stacked
+    mode='drop' scatter per carried buffer).
+
+    ``active2=False`` makes partition 2 inert (the unified single-move path:
+    a single move is a degenerate swap) — its row/mirror writes are dropped,
+    which also guards the duplicate-index case p1 == p2 where an undefined
+    scatter order could clobber the accepted row."""
     af = accept.astype(jnp.float32)
     ai = accept.astype(jnp.int32)
     agg = scatter_partition(state.agg, m, view1, *old1, -af, -ai)
     agg = scatter_partition(agg, m, view1, *new1, af, ai)
     agg = scatter_partition(agg, m, view2, *old2, -af, -ai)
     agg = scatter_partition(agg, m, view2, *new2, af, ai)
-    o1 = accept & jnp.asarray(owned1)
-    o2 = accept & jnp.asarray(owned2)
 
     def sel(n, o):
         return jnp.where(accept, n, o)
 
-    assignment = state.assignment.at[p1].set(
-        jnp.where(o1, new1[0], state.assignment[p1])
-    )
-    assignment = assignment.at[p2].set(jnp.where(o2, new2[0], assignment[p2]))
-    leader_slot = state.leader_slot.at[p1].set(
-        jnp.where(o1, new1[1], state.leader_slot[p1])
-    )
-    leader_slot = leader_slot.at[p2].set(jnp.where(o2, new2[1], leader_slot[p2]))
-    replica_disk = state.replica_disk.at[p1].set(
-        jnp.where(o1, new1[2], state.replica_disk[p1])
-    )
-    replica_disk = replica_disk.at[p2].set(
-        jnp.where(o2, new2[2], replica_disk[p2])
-    )
     totals = state.topic_totals.at[view1.topic].add(af * delta.d_total)
     totals = totals.at[view2.topic].add(af * delta.d_total2)
+    gp1 = p1 if global_p1 is None else global_p1
+    gp2 = p2 if global_p2 is None else global_p2
 
     return state.replace(
-        assignment=assignment,
-        leader_slot=leader_slot,
-        replica_disk=replica_disk,
         agg=agg,
         part_sums=sel(delta.part_sums, state.part_sums),
         topic_totals=totals,
@@ -679,4 +880,26 @@ def apply_swap(
         trd_sum=state.trd_sum + af * delta.d_trd,
         cost_vec=sel(delta.cost_vec, state.cost_vec),
         n_accepted=state.n_accepted + ai,
+        **_placement_updates(
+            state,
+            group,
+            write=jnp.stack(
+                [
+                    accept & jnp.asarray(owned1),
+                    accept & jnp.asarray(owned2) & jnp.asarray(active2),
+                ]
+            ),
+            ps=jnp.stack([p1, p2]),
+            mirror=jnp.stack(
+                [
+                    accept & view1.pvalid,
+                    accept & view2.pvalid & jnp.asarray(active2),
+                ]
+            ),
+            global_ps=jnp.stack([gp1, gp2]),
+            ts=jnp.stack([view1.topic, view2.topic]),
+            rows=jnp.stack([new1[0], new2[0]]),
+            leads=jnp.stack([new1[1], new2[1]]),
+            disks=jnp.stack([new1[2], new2[2]]),
+        ),
     )

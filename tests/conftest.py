@@ -20,5 +20,21 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables between test modules.
+
+    Every jitted program (and every eager op) maps executable pages; across
+    the full suite the process accumulates tens of thousands of mappings
+    (measured ~20k after two modules) and eventually crosses the kernel's
+    vm.max_map_count (65530) — at which point an mmap failure inside LLVM's
+    JIT segfaults the whole run (observed deterministically at
+    test_sidecar). Modules rarely share compiled programs (different padded
+    shapes), so per-module clearing costs little and bounds the growth."""
+    yield
+    jax.clear_caches()

@@ -1,0 +1,82 @@
+"""B5-scale phase probe: separate XLA compile from steady-state run time.
+
+Usage: python tools/probe_b5.py [B5|B2|...]
+Prints per-phase cold/warm timings and an anneal per-step slope so bench
+tuning is driven by data, not guesses.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("PROBE_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER
+from ccx.model.fixtures import bench_spec, random_cluster
+from ccx.search.annealer import AnnealOptions, anneal
+from ccx.search.greedy import GreedyOptions, greedy_optimize
+from ccx.search.repair import hard_repair
+
+
+def t(label, fn, *a, **k):
+    t0 = time.monotonic()
+    r = fn(*a, **k)
+    jax.block_until_ready(jax.tree.leaves(r)[0] if jax.tree.leaves(r) else r)
+    dt = time.monotonic() - t0
+    print(f"[probe] {label}: {dt:.2f}s", flush=True)
+    return r, dt
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "B5"
+    print(f"[probe] backend={jax.default_backend()} devices={jax.devices()}", flush=True)
+    spec = bench_spec(name)
+    m = random_cluster(spec)
+    print(f"[probe] {name}: P={m.P} B={m.B} T={m.num_topics} R={m.R}", flush=True)
+    cfg = GoalConfig()
+
+    (rep, n_rep), _ = t("repair cold", hard_repair, m, cfg, DEFAULT_GOAL_ORDER)
+    t("repair warm", hard_repair, m, cfg, DEFAULT_GOAL_ORDER)
+
+    chains = int(os.environ.get("PROBE_CHAINS", "32"))
+    moves = int(os.environ.get("PROBE_MOVES", "8"))
+    p_swap = float(os.environ.get("PROBE_SWAP", "0.15"))
+    for steps in (10, 50):
+        opts = AnnealOptions(
+            n_chains=chains, n_steps=steps, moves_per_step=moves, seed=42,
+            p_swap=p_swap,
+        )
+        _, cold = t(f"anneal[{steps}] cold(compile+run)", anneal, rep, cfg,
+                    DEFAULT_GOAL_ORDER, opts)
+        _, warm = t(f"anneal[{steps}] warm", anneal, rep, cfg,
+                    DEFAULT_GOAL_ORDER, opts)
+        per_step = warm / steps
+        print(
+            f"[probe] anneal per-step (chains={chains} moves={moves}): "
+            f"{per_step * 1e3:.1f} ms -> 3000 steps = {per_step * 3000:.0f}s",
+            flush=True,
+        )
+
+    popts = GreedyOptions(n_candidates=256, max_iters=5, patience=5)
+    _, cold = t("polish[5 iters] cold", greedy_optimize, rep, cfg,
+                DEFAULT_GOAL_ORDER, popts)
+    _, warm = t("polish[5 iters] warm", greedy_optimize, rep, cfg,
+                DEFAULT_GOAL_ORDER, popts)
+    print(f"[probe] polish per-iter warm: {warm / 5 * 1e3:.0f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
