@@ -234,6 +234,7 @@ def sharded_anneal(
     """
     from ccx.goals.stack import evaluate_stack, soft_weights
     from ccx.search.annealer import (
+        CAPACITY_GOALS as CAPACITY_GOALS_,
         RACK_TARGET_GOALS,
         AnnealOptions,
         AnnealResult,
@@ -275,7 +276,7 @@ def sharded_anneal(
     p_real = int(np.asarray(m.partition_valid).sum())
     bv = np.asarray(m.broker_valid)
     b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
-    evac_np, n_evac_i = hot_partition_list(m, goal_names)
+    evac_np, n_evac_i = hot_partition_list(m, goal_names, cfg)
 
     hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
     allow_inter = allows_inter_broker(goal_names)
@@ -289,6 +290,8 @@ def sharded_anneal(
         target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
         allow_inter=allow_inter,
         p_swap=opts.p_swap if allow_inter else 0.0,
+        target_capacity=bool(CAPACITY_GOALS_ & set(goal_names)),
+        cap_thresholds=tuple(cfg.capacity_threshold),
     )
 
     m_sharded = shard_model(m, mesh)

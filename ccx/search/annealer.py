@@ -120,10 +120,22 @@ class ProposalParams:
     allow_inter: bool = True
     #: REPLICA_SWAP share of proposals (0 disables the swap branch).
     p_swap: float = 0.15
+    #: True when the stack scores capacity goals: hot draws then target
+    #: replicas on over-effective-capacity brokers and biased destinations
+    #: avoid them. Effective capacity = broker_capacity * per-resource
+    #: threshold (ref *.capacity.threshold; kernels._capacity_goal).
+    target_capacity: bool = True
+    #: per-resource capacity thresholds from GoalConfig (static)
+    cap_thresholds: tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0)
 
 
 RACK_TARGET_GOALS = frozenset(
     {"RackAwareGoal", "RackAwareDistributionGoal", "KafkaAssignerEvenRackAwareGoal"}
+)
+
+CAPACITY_GOALS = frozenset(
+    {"CpuCapacityGoal", "NetworkInboundCapacityGoal",
+     "NetworkOutboundCapacityGoal", "DiskCapacityGoal"}
 )
 
 #: Goals whose stacks move replicas only *within* a broker (rebalance_disk);
@@ -147,7 +159,9 @@ def _pad_pow2(idx: np.ndarray) -> tuple[np.ndarray, int]:
 
 
 def hot_partition_list(
-    m: TensorClusterModel, goal_names: tuple[str, ...] = ()
+    m: TensorClusterModel,
+    goal_names: tuple[str, ...] = (),
+    cfg: GoalConfig | None = None,
 ) -> tuple[np.ndarray, int]:
     """Partitions violating *targetable* hard constraints: structural
     (dead broker/disk, the self-healing set) plus — when the stack contains a
@@ -166,6 +180,23 @@ def hot_partition_list(
             & ~np.asarray(m.broker_alive & m.broker_valid)[np.clip(a, 0, m.B - 1)]
         )
         hot.update(np.unique(np.nonzero(on_dead)[0]).tolist())
+        if not hot and CAPACITY_GOALS & set(goal_names):
+            # capacity offenders: partitions with a replica on a broker above
+            # EFFECTIVE capacity (capacity * threshold, where the hard
+            # CapacityGoal hinge starts). Only added when no dead-broker
+            # offenders exist — the self-healing evacuation draw must not be
+            # diluted by (far more numerous) hot-broker partitions.
+            from ccx.model.aggregates import broker_aggregates
+
+            thr = np.asarray((cfg or GoalConfig()).capacity_threshold)
+            agg = broker_aggregates(m)
+            cap = np.asarray(m.broker_capacity) * thr[:, None]
+            load = np.asarray(agg.broker_load)
+            util = np.max(load / np.where(cap > 0, cap, 1e-9), axis=0)
+            over_b = np.asarray(m.broker_alive & m.broker_valid) & (util > 1.0)
+            if over_b.any():
+                on_over = valid & over_b[np.clip(a, 0, m.B - 1)]
+                hot.update(np.unique(np.nonzero(on_over)[0]).tolist())
     rd = np.asarray(m.replica_disk)
     dead_disk = (
         valid
@@ -271,10 +302,24 @@ def _single_plan(
     safe_row = jnp.clip(old_assign, 0, B - 1)
     safe_dk = jnp.clip(old_disk, 0, D - 1)
     slot_ok = old_assign >= 0
+    thr = jnp.asarray(pp.cap_thresholds, jnp.float32)
+    cap_b = jnp.where(
+        m.broker_capacity > 0, m.broker_capacity * thr[:, None], 1e-9
+    )
+    util_b = jnp.max(state.agg.broker_load / cap_b, axis=0)   # [B] dynamic
     if pp.allow_inter:
         dead_broker_slot = slot_ok & ~ok_b[safe_row]
+        # hot draws also target replicas on brokers above EFFECTIVE capacity
+        # (capacity * threshold — where the hard CapacityGoal hinge starts,
+        # kernels._capacity_goal) — healed by relocation
+        over_slot = (
+            slot_ok & ok_b[safe_row] & (util_b[safe_row] > 1.0)
+            if pp.target_capacity
+            else jnp.zeros_like(slot_ok)
+        )
     else:
         dead_broker_slot = jnp.zeros_like(slot_ok)
+        over_slot = jnp.zeros_like(slot_ok)
     dead_disk_slot = (
         slot_ok
         & ok_b[safe_row]
@@ -292,9 +337,18 @@ def _single_plan(
         )
     else:
         rack_dup_slot = jnp.zeros_like(slot_ok)
-    bad_slot = dead_broker_slot | dead_disk_slot | rack_dup_slot
-    has_bad = jnp.any(bad_slot)
-    bad_r = jnp.argmax(bad_slot)
+    # prioritized like the repair sweep: a dead-broker replica outranks a
+    # dead disk outranks a rack duplicate outranks a capacity overload —
+    # otherwise a cluster where most brokers run hot would drown out the
+    # rare structural offenders hot draws exist for
+    bad_score = (
+        3.0 * dead_broker_slot
+        + 2.5 * dead_disk_slot
+        + 1.0 * rack_dup_slot
+        + 0.5 * over_slot
+    )
+    has_bad = jnp.max(bad_score) > 0.0
+    bad_r = jnp.argmax(bad_score)
     r = jnp.where(use_evac & has_bad, bad_r, r).astype(jnp.int32)
     evac_kind = jnp.where(dead_disk_slot[bad_r], MOVE_DISK, MOVE_REPLICA)
     kind = jnp.where(use_evac & has_bad, evac_kind, kind)
@@ -306,10 +360,17 @@ def _single_plan(
 
     # --- destination broker: headroom-weighted or uniform ------------------
     alive_ok = m.broker_valid & m.broker_alive & ~m.broker_excl_replicas
-    cap = m.broker_capacity  # [RES, B]
-    util = state.agg.broker_load / jnp.where(cap > 0, cap, 1.0)
-    headroom = 1.0 - jnp.max(util, axis=0)                      # [B]
-    w = jnp.where(alive_ok, jnp.maximum(headroom, 0.0) + 0.05, 0.0)
+    headroom = 1.0 - util_b                                     # [B]
+    if pp.target_capacity:
+        w = jnp.where(
+            alive_ok & (util_b <= 1.0), jnp.maximum(headroom, 0.0) + 0.05, 0.0
+        )
+        # every alive broker over capacity (e.g. after broker failures):
+        # fall back to least-loaded so evacuations still have a destination
+        w_fb = jnp.where(alive_ok, 1.0 / jnp.maximum(util_b, 1e-9), 0.0)
+        w = jnp.where(jnp.any(w > 0), w, w_fb)
+    else:
+        w = jnp.where(alive_ok, jnp.maximum(headroom, 0.0) + 0.05, 0.0)
     g = -jnp.log(-jnp.log(jax.random.uniform(k_dst, (B,), minval=1e-12, maxval=1.0)))
     dst_biased = jnp.argmax(jnp.where(w > 0, jnp.log(w) + g, -jnp.inf))
     dst_uniform = jax.random.randint(k_dstu, (), 0, pp.b_real)
@@ -698,6 +759,8 @@ def _run_chains(
         target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
         allow_inter=allow_inter,
         p_swap=opts.p_swap if allow_inter else 0.0,
+        target_capacity=bool(CAPACITY_GOALS & set(goal_names)),
+        cap_thresholds=tuple(cfg.capacity_threshold),
     )
     step = functools.partial(
         _anneal_step,
@@ -754,7 +817,7 @@ def anneal(
     p_real = int(np.asarray(m.partition_valid).sum())
     bv = np.asarray(m.broker_valid)
     b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
-    evac, n_evac = hot_partition_list(m, goal_names)
+    evac, n_evac = hot_partition_list(m, goal_names, cfg)
 
     keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
     if mesh is not None:

@@ -38,6 +38,7 @@ from ccx.goals.base import GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
 from ccx.model.tensor_model import TensorClusterModel
 from ccx.search.annealer import (
+    CAPACITY_GOALS,
     RACK_TARGET_GOALS,
     ProposalParams,
     allows_inter_broker,
@@ -232,9 +233,11 @@ def greedy_optimize(
         target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
         allow_inter=allow_inter,
         p_swap=opts.swap_fraction if allow_inter else 0.0,
+        target_capacity=bool(CAPACITY_GOALS & set(goal_names)),
+        cap_thresholds=tuple(cfg.capacity_threshold),
     )
 
-    evac_np, n_evac_i = hot_partition_list(m, goal_names)
+    evac_np, n_evac_i = hot_partition_list(m, goal_names, cfg)
     max_pt = max_partitions_per_topic(m)
     group0 = (
         make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None

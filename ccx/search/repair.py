@@ -29,10 +29,16 @@ import numpy as np
 
 from ccx.goals.base import GoalConfig
 from ccx.model.tensor_model import TensorClusterModel, build_model
-from ccx.search.annealer import RACK_TARGET_GOALS, allows_inter_broker
+from ccx.search.annealer import (
+    CAPACITY_GOALS,
+    RACK_TARGET_GOALS,
+    allows_inter_broker,
+)
 
 
-@functools.partial(jax.jit, static_argnames=("target_rack",))
+@functools.partial(
+    jax.jit, static_argnames=("target_rack", "target_capacity", "cfg")
+)
 def _sweep(
     m: TensorClusterModel,
     assignment: jnp.ndarray,   # int32[P, R]
@@ -41,6 +47,8 @@ def _sweep(
     key: jnp.ndarray,
     *,
     target_rack: bool,
+    target_capacity: bool,
+    cfg: GoalConfig,
 ):
     P, R, B, K = m.P, m.R, m.B, m.num_racks
     pvalid = m.partition_valid
@@ -48,6 +56,15 @@ def _sweep(
     safe_b = jnp.clip(assignment, 0, B - 1)
     alive_b = m.broker_alive & m.broker_valid
     recv_ok = alive_b & ~m.broker_excl_replicas
+
+    from ccx.model.aggregates import broker_aggregates
+
+    agg = broker_aggregates(
+        m.replace(
+            assignment=assignment, leader_slot=leader_slot,
+            replica_disk=replica_disk,
+        )
+    )
 
     # --- offender selection -------------------------------------------------
     on_dead = valid & ~alive_b[safe_b]
@@ -69,11 +86,36 @@ def _sweep(
         axis=2,
     )
 
+    # capacity offenders: replicas on brokers above EFFECTIVE capacity
+    # (capacity * per-resource threshold — where the hard CapacityGoal hinge
+    # starts, kernels._capacity_goal), selected with probability ~ the
+    # broker's excess fraction so a sweep sheds roughly the overflow instead
+    # of evacuating the whole broker. Only for stacks that score capacity.
+    thr = jnp.asarray(cfg.capacity_threshold, jnp.float32)
+    cap = jnp.where(
+        m.broker_capacity > 0, m.broker_capacity * thr[:, None], 1e-9
+    )
+    util = jnp.max(agg.broker_load / cap, axis=0)          # [B]
+    if target_capacity:
+        over_b = alive_b & (util > 1.0)
+        exc_frac = jnp.where(
+            over_b,
+            jnp.clip(1.0 - 1.0 / jnp.maximum(util, 1e-9), 0.0, 1.0),
+            0.0,
+        )
+        key, k_cap = jax.random.split(key)
+        u_cap = jax.random.uniform(k_cap, (P, R))
+        on_over = valid & over_b[safe_b] & (u_cap < 1.5 * exc_frac[safe_b])
+    else:
+        over_b = jnp.zeros_like(alive_b)
+        on_over = jnp.zeros_like(valid)
+
     score = (
         3.0 * on_dead
         + 2.5 * on_dead_disk
         + 2.0 * dup_broker
         + (1.0 * dup_rack if target_rack else 0.0)
+        + 0.75 * on_over
     )
     slot = jnp.argmax(score, axis=1)                       # int[P]
     has_offender = jnp.max(score, axis=1) > 0.0
@@ -81,6 +123,7 @@ def _sweep(
         jnp.take_along_axis(on_dead_disk, slot[:, None], 1)[:, 0]
         & ~jnp.take_along_axis(on_dead, slot[:, None], 1)[:, 0]
         & ~jnp.take_along_axis(dup_broker, slot[:, None], 1)[:, 0]
+        & ~jnp.take_along_axis(on_over, slot[:, None], 1)[:, 0]
         & (
             ~jnp.take_along_axis(dup_rack, slot[:, None], 1)[:, 0]
             if target_rack
@@ -99,23 +142,22 @@ def _sweep(
     rack_idx = jnp.clip(racks, 0, K - 1)
     used_rack = used_rack.at[rows, rack_idx].max(keep & (racks >= 0))
 
-    allowed_base = recv_ok[None, :] & ~in_part
+    # prefer destinations under effective capacity, but never strand an
+    # offender: when no under-capacity destination exists (e.g. every alive
+    # broker runs hot after failures), fall back to any alive receiver
+    allowed_any = recv_ok[None, :] & ~in_part
+    allowed_cap = allowed_any & ~over_b[None, :]
+    has_cap_dest = jnp.any(allowed_cap, axis=1, keepdims=True)
+    allowed_base = jnp.where(has_cap_dest, allowed_cap, allowed_any)
     rack_free = ~used_rack[:, jnp.clip(m.broker_rack, 0, K - 1)]  # [P, B]
     allowed_rack = allowed_base & rack_free
     use_rack_constraint = jnp.any(allowed_rack, axis=1, keepdims=True)
     allowed = jnp.where(use_rack_constraint, allowed_rack, allowed_base)
 
-    # headroom score: spare disk+replica capacity, noise-spread
-    from ccx.model.aggregates import broker_aggregates
-
-    agg = broker_aggregates(
-        m.replace(
-            assignment=assignment, leader_slot=leader_slot,
-            replica_disk=replica_disk,
-        )
-    )
-    disk_cap = jnp.maximum(m.broker_capacity[3], 1e-9)
-    headroom = 1.0 - agg.broker_load[3] / disk_cap
+    # headroom score: spare capacity across EVERY resource (a destination
+    # with free disk but saturated CPU would just trade one capacity
+    # violation for another), plus replica-count headroom; noise-spread
+    headroom = 1.0 - util
     count_head = 1.0 - agg.replica_count / jnp.maximum(
         jnp.max(agg.replica_count), 1.0
     )
@@ -182,6 +224,7 @@ def hard_repair(
     all cases.
     """
     target_rack = bool(RACK_TARGET_GOALS & set(goal_names))
+    target_capacity = bool(CAPACITY_GOALS & set(goal_names))
     assignment = m.assignment
     leader_slot = m.leader_slot
     replica_disk = m.replica_disk
@@ -192,7 +235,8 @@ def hard_repair(
             key, sub = jax.random.split(key)
             assignment, replica_disk, n = _sweep(
                 m, assignment, leader_slot, replica_disk, sub,
-                target_rack=target_rack,
+                target_rack=target_rack, target_capacity=target_capacity,
+                cfg=cfg,
             )
             n = int(n)
             total += n
