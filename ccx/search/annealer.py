@@ -180,23 +180,6 @@ def hot_partition_list(
             & ~np.asarray(m.broker_alive & m.broker_valid)[np.clip(a, 0, m.B - 1)]
         )
         hot.update(np.unique(np.nonzero(on_dead)[0]).tolist())
-        if not hot and CAPACITY_GOALS & set(goal_names):
-            # capacity offenders: partitions with a replica on a broker above
-            # EFFECTIVE capacity (capacity * threshold, where the hard
-            # CapacityGoal hinge starts). Only added when no dead-broker
-            # offenders exist — the self-healing evacuation draw must not be
-            # diluted by (far more numerous) hot-broker partitions.
-            from ccx.model.aggregates import broker_aggregates
-
-            thr = np.asarray((cfg or GoalConfig()).capacity_threshold)
-            agg = broker_aggregates(m)
-            cap = np.asarray(m.broker_capacity) * thr[:, None]
-            load = np.asarray(agg.broker_load)
-            util = np.max(load / np.where(cap > 0, cap, 1e-9), axis=0)
-            over_b = np.asarray(m.broker_alive & m.broker_valid) & (util > 1.0)
-            if over_b.any():
-                on_over = valid & over_b[np.clip(a, 0, m.B - 1)]
-                hot.update(np.unique(np.nonzero(on_over)[0]).tolist())
     rd = np.asarray(m.replica_disk)
     dead_disk = (
         valid
@@ -213,6 +196,30 @@ def hot_partition_list(
         )
         hot.update(np.unique(np.nonzero(dup.any(axis=(1, 2)) & pvalid)[0]).tolist())
 
+    if (
+        not hot
+        and allows_inter_broker(goal_names)
+        and CAPACITY_GOALS & set(goal_names)
+    ):
+        # capacity offenders: partitions with a replica on a broker above
+        # EFFECTIVE capacity (capacity * threshold, where the hard
+        # CapacityGoal hinge starts). Only added when NO structural offender
+        # (dead broker/disk, rack duplicate) exists — the targeted draws for
+        # those must not be diluted by (far more numerous) hot-broker
+        # partitions.
+        from ccx.model.aggregates import broker_aggregates
+
+        thr = np.asarray((cfg or GoalConfig()).capacity_threshold)
+        agg = broker_aggregates(m)
+        cap = np.asarray(m.broker_capacity) * thr[:, None]
+        load = np.asarray(agg.broker_load)
+        util = np.max(
+            np.where(cap > 0, load / np.where(cap > 0, cap, 1.0), 0.0), axis=0
+        )
+        over_b = np.asarray(m.broker_alive & m.broker_valid) & (util > 1.0)
+        if over_b.any():
+            on_over = valid & over_b[np.clip(a, 0, m.B - 1)]
+            hot.update(np.unique(np.nonzero(on_over)[0]).tolist())
     idx = np.asarray(sorted(hot), np.int32)
     return _pad_pow2(idx)
 
@@ -303,10 +310,17 @@ def _single_plan(
     safe_dk = jnp.clip(old_disk, 0, D - 1)
     slot_ok = old_assign >= 0
     thr = jnp.asarray(pp.cap_thresholds, jnp.float32)
-    cap_b = jnp.where(
-        m.broker_capacity > 0, m.broker_capacity * thr[:, None], 1e-9
+    cap_eff = m.broker_capacity * thr[:, None]
+    # a resource with capacity 0 is UNCONSTRAINED (capacity unset), not
+    # infinitely over — contribute 0 utilization for it
+    util_b = jnp.max(
+        jnp.where(
+            cap_eff > 0,
+            state.agg.broker_load / jnp.where(cap_eff > 0, cap_eff, 1.0),
+            0.0,
+        ),
+        axis=0,
     )
-    util_b = jnp.max(state.agg.broker_load / cap_b, axis=0)   # [B] dynamic
     if pp.allow_inter:
         dead_broker_slot = slot_ok & ~ok_b[safe_row]
         # hot draws also target replicas on brokers above EFFECTIVE capacity

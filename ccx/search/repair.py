@@ -92,10 +92,16 @@ def _sweep(
     # broker's excess fraction so a sweep sheds roughly the overflow instead
     # of evacuating the whole broker. Only for stacks that score capacity.
     thr = jnp.asarray(cfg.capacity_threshold, jnp.float32)
-    cap = jnp.where(
-        m.broker_capacity > 0, m.broker_capacity * thr[:, None], 1e-9
-    )
-    util = jnp.max(agg.broker_load / cap, axis=0)          # [B]
+    cap_eff = m.broker_capacity * thr[:, None]
+    # capacity 0 = unconstrained resource (capacity unset), utilization 0
+    util = jnp.max(
+        jnp.where(
+            cap_eff > 0,
+            agg.broker_load / jnp.where(cap_eff > 0, cap_eff, 1.0),
+            0.0,
+        ),
+        axis=0,
+    )                                                       # [B]
     if target_capacity:
         over_b = alive_b & (util > 1.0)
         exc_frac = jnp.where(
@@ -103,9 +109,21 @@ def _sweep(
             jnp.clip(1.0 - 1.0 / jnp.maximum(util, 1e-9), 0.0, 1.0),
             0.0,
         )
+        # scale selection so a sweep sheds at most roughly what the
+        # under-capacity brokers can absorb — otherwise every offender
+        # piles onto the few cool brokers and the sweeps oscillate
+        excess_rel = jnp.sum(jnp.where(over_b, util - 1.0, 0.0))
+        head_rel = jnp.sum(
+            jnp.where(alive_b & ~over_b, jnp.maximum(1.0 - util, 0.0), 0.0)
+        )
+        absorb = jnp.clip(head_rel / jnp.maximum(excess_rel, 1e-9), 0.0, 1.0)
         key, k_cap = jax.random.split(key)
         u_cap = jax.random.uniform(k_cap, (P, R))
-        on_over = valid & over_b[safe_b] & (u_cap < 1.5 * exc_frac[safe_b])
+        on_over = (
+            valid
+            & over_b[safe_b]
+            & (u_cap < 1.5 * absorb * exc_frac[safe_b])
+        )
     else:
         over_b = jnp.zeros_like(alive_b)
         on_over = jnp.zeros_like(valid)
@@ -193,7 +211,8 @@ def _sweep(
     )
     new_replica_disk = replica_disk.at[pidx, slot].set(new_disk_val)
     n_moved = jnp.sum(do_move) + jnp.sum(do_disk)
-    return new_assignment, new_replica_disk, n_moved
+    n_over_b = jnp.sum(over_b)
+    return new_assignment, new_replica_disk, n_moved, n_over_b
 
 
 @jax.jit
@@ -231,17 +250,25 @@ def hard_repair(
     total = 0
     if allows_inter_broker(goal_names):
         key = jax.random.PRNGKey(seed)
+        prev_over = None
         for i in range(max_sweeps):
             key, sub = jax.random.split(key)
-            assignment, replica_disk, n = _sweep(
+            assignment, replica_disk, n, n_over = _sweep(
                 m, assignment, leader_slot, replica_disk, sub,
                 target_rack=target_rack, target_capacity=target_capacity,
                 cfg=cfg,
             )
             n = int(n)
+            n_over = int(n_over)
             total += n
             if n == 0:
                 break
+            # capacity shedding that stops reducing the over-capacity broker
+            # count is oscillating (destinations saturated) — stop and let
+            # the annealer's targeted draws finish the job
+            if prev_over is not None and 0 < prev_over <= n_over:
+                break
+            prev_over = n_over
     leader_slot = _leader_fix(m, assignment, leader_slot)
     out = m.replace(
         assignment=assignment, leader_slot=leader_slot,

@@ -84,16 +84,29 @@ def test_repair_respects_receive_exclusions():
     assert stack_v(fixed)["StructuralFeasibility"] == 0
 
 
-def test_repair_idempotent_on_feasible_cluster():
+def test_repair_converges_then_is_idempotent():
+    """Repeated repair reaches a structurally+capacity-feasible fixpoint in
+    a few rounds, after which a further call is an exact no-op. (Repair now
+    also sheds capacity overloads, so a single call on a cluster with hot
+    brokers may legitimately be followed by further shedding rounds.)"""
     m = random_cluster(RandomClusterSpec(
         n_brokers=6, n_racks=3, n_topics=3, n_partitions=32, seed=4
     ))
-    fixed1, _ = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
-    assert stack_v(fixed1)["RackAwareGoal"] == 0
-    fixed2, n2 = hard_repair(fixed1, GoalConfig(), DEFAULT_GOAL_ORDER)
+    fixed, _ = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
+    assert stack_v(fixed)["RackAwareGoal"] == 0
+    for _ in range(4):
+        fixed, n = hard_repair(fixed, GoalConfig(), DEFAULT_GOAL_ORDER)
+        if n == 0:
+            break
+    assert n == 0, "repair failed to reach a fixpoint"
+    v = stack_v(fixed)
+    for g in ("RackAwareGoal", "CpuCapacityGoal", "DiskCapacityGoal",
+              "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal"):
+        assert v[g] == 0, (g, v[g])
+    again, n2 = hard_repair(fixed, GoalConfig(), DEFAULT_GOAL_ORDER)
     assert n2 == 0
     np.testing.assert_array_equal(
-        np.asarray(fixed2.assignment), np.asarray(fixed1.assignment)
+        np.asarray(again.assignment), np.asarray(fixed.assignment)
     )
 
 
