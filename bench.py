@@ -24,7 +24,15 @@ Fail-loudly contract (a timed-out driver run must still leave diagnostics):
   last phase entered) so rc=124 still leaves a breadcrumb trail.
 
 Env knobs: CCX_BENCH=B1..B5 selects the config; CCX_BENCH_CHAINS /
-CCX_BENCH_STEPS override SA effort; CCX_BENCH_SKIP_SMOKE=1 skips the smoke.
+CCX_BENCH_STEPS / CCX_BENCH_MOVES / CCX_BENCH_POLISH_ITERS override SA
+effort; CCX_BENCH_SKIP_SMOKE=1 skips the smoke; CCX_BENCH_CPU=1 forces the
+CPU backend; CCX_BENCH_PROBE_TIMEOUT sets the device-probe timeout.
+Smoke-first caveat: when the DEVICE PROBE times out (wedged TPU) the run
+falls back to CPU and skips the smoke — the probe already established the
+device state; the JSON then carries the fallback reason, a "lean": true
+marker and the exact "effort" used (fallback runs halve SA effort to fit
+the driver timeout on a much slower backend — numbers are NOT same-workload
+comparable with full-effort runs).
 """
 
 from __future__ import annotations
@@ -78,7 +86,7 @@ def _on_signal(signum, frame):
     os.kill(os.getpid(), signum)
 
 
-def run_config(name: str, *, smoke: bool = False) -> dict:
+def run_config(name: str, *, smoke: bool = False, lean: bool = False) -> dict:
     from ccx.goals.base import GoalConfig
     from ccx.goals.stack import DEFAULT_GOAL_ORDER
     from ccx.model.fixtures import bench_spec, random_cluster
@@ -102,11 +110,17 @@ def run_config(name: str, *, smoke: bool = False) -> dict:
     if smoke:
         n_chains, n_steps, moves, polish_iters = 8, 100, 1, 10
     else:
-        n_chains = int(os.environ.get("CCX_BENCH_CHAINS", "32"))
-        n_steps = int(os.environ.get("CCX_BENCH_STEPS", "3000"))
+        # CPU-fallback runs halve the SA effort: the number exists to prove
+        # completion + verification under a wedged TPU, and must fit the
+        # driver's timeout on a ~50x slower backend
+        d_chains, d_steps, d_polish = ("16", "1500", "200") if lean else (
+            "32", "3000", "400"
+        )
+        n_chains = int(os.environ.get("CCX_BENCH_CHAINS", d_chains))
+        n_steps = int(os.environ.get("CCX_BENCH_STEPS", d_steps))
         # proposals per chain-step: churn must scale with partition count
         moves = int(os.environ.get("CCX_BENCH_MOVES", "8"))
-        polish_iters = int(os.environ.get("CCX_BENCH_POLISH_ITERS", "400"))
+        polish_iters = int(os.environ.get("CCX_BENCH_POLISH_ITERS", d_polish))
     opts = OptimizeOptions(
         anneal=AnnealOptions(
             n_chains=n_chains, n_steps=n_steps, moves_per_step=moves, seed=42
@@ -154,6 +168,10 @@ def run_config(name: str, *, smoke: bool = False) -> dict:
         "warm": t_warm,
         "verified": bool(res.verification.ok),
         "proposals": len(res.proposals),
+        "effort": {
+            "chains": n_chains, "steps": n_steps, "moves": moves,
+            "polish_iters": polish_iters,
+        },
     }
 
 
@@ -174,6 +192,7 @@ def main() -> None:
     import subprocess
 
     backend_forced = None
+    probe_failed = False
     if os.environ.get("CCX_BENCH_CPU") == "1":
         backend_forced = "cpu (CCX_BENCH_CPU=1)"
     else:
@@ -185,8 +204,10 @@ def main() -> None:
             )
             if probe.returncode != 0:
                 backend_forced = f"cpu (device probe rc={probe.returncode})"
+                probe_failed = True
         except subprocess.TimeoutExpired:
             backend_forced = "cpu (device probe timed out — TPU wedged?)"
+            probe_failed = True
     if backend_forced:
         log(f"FALLING BACK to {backend_forced}")
 
@@ -215,13 +236,16 @@ def main() -> None:
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
 
     # Smoke: tiny B1 in seconds. If the device is wedged this is where the
-    # run dies, and the breadcrumb says so.
-    if os.environ.get("CCX_BENCH_SKIP_SMOKE") != "1":
+    # run dies, and the breadcrumb says so. Skipped only when the PROBE
+    # already failed (it established the device state and the fallback run
+    # must fit the driver timeout); a voluntary CCX_BENCH_CPU=1 run keeps
+    # its smoke.
+    if os.environ.get("CCX_BENCH_SKIP_SMOKE") != "1" and not probe_failed:
         enter_phase("smoke")
         smoke = run_config("B1", smoke=True)
         log(f"smoke OK: cold={smoke['cold']:.2f}s warm={smoke['warm']:.2f}s — device is alive")
 
-    r = run_config(name)
+    r = run_config(name, lean=bool(backend_forced))
     enter_phase("report")
     log(f"total harness time {time.monotonic() - T_START:.1f}s")
 
@@ -239,6 +263,8 @@ def main() -> None:
                 "cold_s": round(r["cold"], 3),
                 "backend": jax.default_backend()
                 + (f" (fallback: {backend_forced})" if backend_forced else ""),
+                "lean": bool(backend_forced),
+                "effort": r["effort"],
             }
         )
     )
