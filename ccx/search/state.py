@@ -350,8 +350,8 @@ def scatter_partition(
     """Weighted scatter of one partition's contribution into the [B]-level
     aggregate fields (<= 2R cells per array). The [T, B] topic matrices are
     deliberately NOT maintained during search — topic rows are derived on
-    demand from the assignment (``make_topic_rows_fn``; see module
-    docstring for the copy-per-move pathology this avoids)."""
+    demand from the grouped placement mirror (``derived_topic_rows``; see
+    module docstring for the copy-per-move pathology this avoids)."""
     return _scatter_broker_fields(
         agg, m, view, assign_row, leader_slot_p, disk_row, w_f, w_i
     )
