@@ -184,6 +184,10 @@ def analyzer_config_def() -> ConfigDef:
              "Greedy polish candidate moves per iteration.", at_least(1))
     d.define("optimizer.polish.max.iters", Type.INT, 400, Importance.LOW,
              "Greedy polish iteration cap.", at_least(1))
+    d.define("optimizer.polish.batch.moves", Type.INT, 16, Importance.LOW,
+             "Non-conflicting improving moves applied per polish iteration "
+             "(disjoint partitions/topics/broker sets; 1 = classic "
+             "best-move hill climbing).", at_least(1))
     d.define("optimizer.profile.dir", Type.STRING, "", Importance.LOW,
              "When non-empty, capture a jax.profiler (XProf/TensorBoard) "
              "device trace of each proposal computation into this directory "

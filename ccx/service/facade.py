@@ -121,28 +121,24 @@ class CruiseControl:
         polish = GreedyOptions(
             n_candidates=self.config["optimizer.polish.candidates"],
             max_iters=self.config["optimizer.polish.max.iters"],
+            batch_moves=self.config["optimizer.polish.batch.moves"],
         )
+        import dataclasses as _dc
+
         if leadership_only:
             # Swaps relocate replicas and bypass the move-kind draw, so a
             # leadership-only search (demote) must disable them explicitly.
-            anneal = AnnealOptions(
-                n_chains=anneal.n_chains, n_steps=anneal.n_steps,
-                seed=anneal.seed, p_leadership=1.0, p_biased_dest=0.0,
+            anneal = _dc.replace(
+                anneal, p_leadership=1.0, p_biased_dest=0.0, p_swap=0.0
+            )
+            polish = _dc.replace(polish, p_leadership=1.0, swap_fraction=0.0)
+        if disk_only:
+            anneal = _dc.replace(
+                anneal, p_disk=1.0, p_leadership=0.0, p_biased_dest=0.0,
                 p_swap=0.0,
             )
-            polish = GreedyOptions(
-                n_candidates=polish.n_candidates, max_iters=polish.max_iters,
-                p_leadership=1.0, swap_fraction=0.0,
-            )
-        if disk_only:
-            anneal = AnnealOptions(
-                n_chains=anneal.n_chains, n_steps=anneal.n_steps,
-                seed=anneal.seed, p_disk=1.0, p_leadership=0.0,
-                p_biased_dest=0.0, p_swap=0.0,
-            )
-            polish = GreedyOptions(
-                n_candidates=polish.n_candidates, max_iters=polish.max_iters,
-                p_disk=1.0, p_leadership=0.0, swap_fraction=0.0,
+            polish = _dc.replace(
+                polish, p_disk=1.0, p_leadership=0.0, swap_fraction=0.0
             )
         return OptimizeOptions(
             anneal=anneal, polish=polish,
