@@ -179,6 +179,10 @@ def analyzer_config_def() -> ConfigDef:
              "SA chains vmapped on device.", at_least(1))
     d.define("optimizer.num.steps", Type.INT, 3000, Importance.MEDIUM,
              "SA steps per chain.", at_least(1))
+    d.define("optimizer.moves.per.step", Type.INT, 8, Importance.MEDIUM,
+             "SA proposals per chain per scan step, applied as a disjoint "
+             "batch on large clusters (AnnealOptions.batched) — total churn "
+             "budget is chains * steps * this.", at_least(1))
     d.define("optimizer.seed", Type.INT, 42, Importance.LOW, "SA PRNG seed.")
     d.define("optimizer.polish.candidates", Type.INT, 256, Importance.LOW,
              "Greedy polish candidate moves per iteration.", at_least(1))
@@ -188,6 +192,13 @@ def analyzer_config_def() -> ConfigDef:
              "Non-conflicting improving moves applied per polish iteration "
              "(disjoint partitions/topics/broker sets; 1 = classic "
              "best-move hill climbing).", at_least(1))
+    d.define("optimizer.portfolio.cold.greedy", Type.BOOLEAN, True,
+             Importance.LOW,
+             "Also run the greedy oracle from the input placement and return "
+             "the lexicographic winner (the GoalOptimizer precompute-cache "
+             "portfolio pattern). Costs roughly one extra polish-budget run "
+             "per optimize() call; disable for latency-sensitive endpoints. "
+             "Leadership-only and disk-only fast paths skip it regardless.")
     d.define("optimizer.profile.dir", Type.STRING, "", Importance.LOW,
              "When non-empty, capture a jax.profiler (XProf/TensorBoard) "
              "device trace of each proposal computation into this directory "

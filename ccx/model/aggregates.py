@@ -88,3 +88,9 @@ def broker_aggregates(m: TensorClusterModel) -> BrokerAggregates:
         topic_leader_count=topic_leader_count,
         disk_load=disk_load,
     )
+
+
+#: Jitted entry for host-side callers (e.g. hot-partition targeting) — an
+#: eager call dispatches every op separately and recomputes per invocation;
+#: the jitted form compiles once per shape and fuses the segment-sums.
+broker_aggregates_jit = jax.jit(broker_aggregates)

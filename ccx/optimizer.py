@@ -134,13 +134,36 @@ class OptimizeOptions:
     #: disable for disk-only stacks — intra-broker moves cannot evacuate
     #: a dead broker
     check_evacuation: bool = True
+    #: run a leadership-only greedy sweep as the LAST pipeline stage (ref:
+    #: PreferredLeaderElectionGoal runs last in the goal order, SURVEY.md
+    #: section 2.3): single leadership transfers + count-preserving
+    #: leadership rotations, lex-guarded against the full stack, so the
+    #: pipeline never ends with fixable preferred-leader / leader-balance
+    #: debris. Skipped automatically for intra-broker (disk-only) stacks.
+    run_leader_pass: bool = True
     #: also run the pure greedy oracle from the input placement and return
     #: the lexicographic winner — the portfolio pattern of the reference's
     #: GoalOptimizer, which precomputes candidate proposals and serves the
     #: best (SURVEY.md C14/section 2.5). Guarantees the pipeline never
     #: returns a result lexicographically worse than a plain greedy run of
-    #: the same budget; cheap relative to the SA phase.
+    #: the same budget. Cost: one extra run at the polish budget per
+    #: optimize() call (roughly doubles the polish phase) — the facade
+    #: disables it for leadership-/disk-only fast paths and exposes
+    #: ``optimizer.portfolio.cold.greedy`` for latency-sensitive callers.
     run_cold_greedy: bool = True
+
+
+#: goals a leadership-only move can improve — stacks scoring none of these
+#: skip the final leadership pass (it could only burn a compile + budget)
+LEADERSHIP_GOALS = frozenset(
+    {
+        "PreferredLeaderElectionGoal",
+        "LeaderReplicaDistributionGoal",
+        "LeaderBytesInDistributionGoal",
+        "MinTopicLeadersPerBrokerGoal",
+        "KafkaAssignerEvenRackAwareGoal",
+    }
+)
 
 
 def _lex_better(a: StackResult, b: StackResult) -> bool:
@@ -229,6 +252,29 @@ def optimize(
                 # abandoned SA path's
                 n_polish = cold.n_moves
         phases["portfolio"] = time.monotonic() - t
+    from ccx.search.annealer import allows_inter_broker
+
+    leadership_scored = LEADERSHIP_GOALS & set(goal_names)
+    if (
+        opts.run_leader_pass
+        and leadership_scored
+        and allows_inter_broker(goal_names)
+    ):
+        # final preferred-leadership pass over whichever candidate won:
+        # greedy only applies lex-improving moves, so the result is adopted
+        # unconditionally
+        t = _enter("leader-pass")
+        with annotate("ccx:leader-pass"):
+            lead = greedy_optimize(
+                model,
+                cfg,
+                goal_names,
+                dataclasses.replace(opts.polish, leadership_only=True),
+            )
+            model = lead.model
+            stack_after = lead.stack_after
+            n_polish += lead.n_moves
+        phases["leader-pass"] = time.monotonic() - t
     t = _enter("diff")
     proposals = diff(m, model)
     phases["diff"] = time.monotonic() - t

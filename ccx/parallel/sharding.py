@@ -240,9 +240,11 @@ def sharded_anneal(
         AnnealResult,
         ProposalParams,
         _anneal_step,
+        _anneal_step_batched,
         allows_inter_broker,
         best_chain_index,
         hot_partition_list,
+        lead_swap_share,
     )
     from ccx.search.state import (
         PartitionView,
@@ -292,6 +294,7 @@ def sharded_anneal(
         p_swap=opts.p_swap if allow_inter else 0.0,
         target_capacity=bool(CAPACITY_GOALS_ & set(goal_names)),
         cap_thresholds=tuple(cfg.capacity_threshold),
+        p_lead_swap=lead_swap_share(opts.p_leadership),
     )
 
     m_sharded = shard_model(m, mesh)
@@ -455,8 +458,14 @@ def sharded_anneal(
             weights = soft_weights(hard_mask)
             n = max(opts.n_steps, 1)
             decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
+            # same small-cluster gate as ccx.search.annealer._run_chains
+            batched = (
+                opts.batched
+                and opts.moves_per_step > 1
+                and b_real >= 4 * m_local.R * opts.moves_per_step
+            )
             step = _ft.partial(
-                _anneal_step,
+                _anneal_step_batched if batched else _anneal_step,
                 m=m_local,
                 pp=pp,
                 hard_arr=hard_arr,
@@ -467,6 +476,15 @@ def sharded_anneal(
                 gather=gather,
                 locate=locate,
                 group=group_l,
+                **(
+                    {
+                        "vector_fn": make_cost_vector_fn(
+                            m_local, goal_names, cfg
+                        )
+                    }
+                    if batched
+                    else {}
+                ),
             )
 
             def scan_body(ss, t):

@@ -59,9 +59,20 @@ MOVE_SWAP = 3         # REPLICA_SWAP (two-partition exchange)
 class AnnealOptions:
     n_chains: int = 64
     n_steps: int = 3000
-    #: proposals per chain per scan step (sequential, exact composition) —
-    #: raise so churn scales with partition count without growing the scan
+    #: proposals per chain per scan step — raise so churn scales with
+    #: partition count without growing the scan length
     moves_per_step: int = 1
+    #: True (default): the ``moves_per_step`` proposals of a step are drawn,
+    #: scored against the step's base state and applied as a
+    #: pairwise-DISJOINT batch (one stacked gather/scatter per carried
+    #: buffer per step — the polish batching lifted into SA, ~K× churn per
+    #: unit wall-clock; under partition-axis sharding this also amortizes
+    #: the per-move psum into one per step). False: proposals compose
+    #: sequentially inside the step (each scores the state left by the
+    #: previous one) — the round-2 engine, kept for ablation and as the
+    #: reference semantics for equivalence tests. Both modes are
+    #: deterministic given the seed, but their chains differ.
+    batched: bool = True
     t0: float = 0.3          # initial temperature (soft-cost units)
     t1: float = 1e-4         # final temperature
     p_leadership: float = 0.15
@@ -127,6 +138,21 @@ class ProposalParams:
     target_capacity: bool = True
     #: per-resource capacity thresholds from GoalConfig (static)
     cap_thresholds: tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0)
+    #: share of swap proposals taken by the LEADERSHIP-swap variant (vs the
+    #: replica swap). Scaled from the configured leadership share by
+    #: ``lead_swap_share`` so a stack with a tiny p_leadership doesn't spend
+    #: half its swap budget on leadership rotations.
+    p_lead_swap: float = 0.5
+
+
+def lead_swap_share(p_leadership: float) -> float:
+    """Leadership-swap share of swap proposals, following the configured
+    leadership share: 0.5 at the default p_leadership=0.15 (measured-good
+    mix for the PLE/leader-distribution tiers), proportionally less below
+    it, 0 when leadership moves are disabled."""
+    if p_leadership <= 0:
+        return 0.0
+    return 0.5 * min(p_leadership / 0.15, 1.0)
 
 
 RACK_TARGET_GOALS = frozenset(
@@ -207,10 +233,10 @@ def hot_partition_list(
         # (dead broker/disk, rack duplicate) exists — the targeted draws for
         # those must not be diluted by (far more numerous) hot-broker
         # partitions.
-        from ccx.model.aggregates import broker_aggregates
+        from ccx.model.aggregates import broker_aggregates_jit
 
         thr = np.asarray((cfg or GoalConfig()).capacity_threshold)
-        agg = broker_aggregates(m)
+        agg = broker_aggregates_jit(m)
         cap = np.asarray(m.broker_capacity) * thr[:, None]
         load = np.asarray(agg.broker_load)
         util = np.max(
@@ -571,9 +597,11 @@ def _swap_plan(
         & lead_allowed[lb2]
     )
     use_lead = (
-        (jax.random.uniform(k_kind) < 0.5) if pp.p_leadership > 0 else False
+        (jax.random.uniform(k_kind) < pp.p_lead_swap)
+        if pp.p_lead_swap > 0
+        else False
     )
-    if pp.p_leadership > 0:
+    if pp.p_lead_swap > 0:
         def sel_rows(a, b):
             return jnp.where(use_lead, a, b)
 
@@ -735,6 +763,226 @@ def _anneal_step(
     return jax.lax.fori_loop(0, moves_per_step, body, state)
 
 
+def _anneal_step_batched(
+    state: SearchState,
+    temperature: jnp.ndarray,
+    step_idx: jnp.ndarray,
+    evac: jnp.ndarray,
+    n_evac: jnp.ndarray,
+    *,
+    m: TensorClusterModel,
+    pp: ProposalParams,
+    hard_arr: jnp.ndarray,
+    weights: jnp.ndarray,
+    moves_per_step: int,
+    scorer,
+    swap_scorer,
+    vector_fn,
+    gather=None,
+    locate=None,
+    group=None,
+) -> SearchState:
+    """``moves_per_step`` proposals drawn, scored and accepted against the
+    step's BASE state, then applied as a pairwise-disjoint batch — the
+    polish-pass batching (ccx.search.greedy apply_batch) lifted into the SA
+    step. Wall-clock rationale: the sequential step pays one stacked
+    gather + one stacked scatter per carried buffer *per proposal*; this
+    step pays the same *per step*, so K proposals cost ~one proposal's
+    kernel sequencing. Under partition-axis sharding the per-proposal psum
+    (ccx.parallel.sharding) collapses the same way: ONE collective per step
+    for all 2K views.
+
+    Acceptance semantics: each candidate independently passes the
+    vector-lexicographic/Metropolis rule vs the base state; candidates whose
+    {touched brokers} ∪ {touched topics} overlap an earlier-selected
+    candidate are dropped (disjointness makes every sum-decomposable goal
+    term exactly additive). The non-sum-decomposable couplings
+    (leader-evenness, trd normalizer) cannot violate hard tiers by
+    composition, but the composed vector is still recomputed exactly and the
+    whole batch is rejected in the (float-drift-only) event a hard tier
+    regressed. Chains in batched mode are deterministic given the seed but
+    differ from sequential-mode chains (AnnealOptions.batched docstring).
+    """
+    from ccx.goals import topic_terms as tt
+    from ccx.search.state import (
+        _placement_updates,
+        gather_views,
+        scatter_partition,
+        view_at,
+    )
+
+    K = moves_per_step
+    B, T = m.B, m.num_topics
+    ss = state
+    keys = jax.random.split(jax.random.fold_in(ss.key, step_idx), K)
+
+    # --- draw K candidate partition pairs (index-only, no state reads) ----
+    def draw(k):
+        k_sel, k_p, k_ev, k_evi, k_p1, k_p2, k_s, k_w, k_acc = jax.random.split(
+            k, 9
+        )
+        use_swap = (
+            (jax.random.uniform(k_sel) < pp.p_swap)
+            if pp.p_swap > 0.0
+            else jnp.asarray(False)
+        )
+        p_single, use_evac = _draw_partition(k_p, k_ev, k_evi, pp, evac, n_evac)
+        p1 = jax.random.randint(k_p1, (), 0, pp.p_real)
+        p2 = jax.random.randint(k_p2, (), 0, pp.p_real)
+        pa = jnp.where(use_swap, p1, p_single)
+        return pa, p2, use_swap, use_evac & ~use_swap, k_s, k_w, k_acc
+
+    pa, pb, use_swap, use_evac, ks_single, ks_swap, ks_acc = jax.vmap(draw)(keys)
+
+    # ONE stacked gather for all 2K views per carried placement buffer
+    # (the sharding hook turns this into one owner-gather + one psum)
+    views = (gather or gather_views)(ss, m, jnp.concatenate([pa, pb]))
+    va = jax.tree.map(lambda x: x[:K], views)
+    vb = jax.tree.map(lambda x: x[K:], views)
+
+    def plan(k_s, k_w, va_k, vb_k, pa_k, pb_k, use_swap_k, use_evac_k):
+        old_s, new_s, feas_s = _single_plan(k_s, ss, m, pp, va_k, use_evac_k)
+        o1w, n1w, o2w, n2w, ok_w = _swap_plan(k_w, m, pp, pa_k, va_k, pb_k, vb_k)
+
+        def pick(a, b):
+            return jnp.where(use_swap_k, a, b)
+
+        def inert(rows):
+            # single moves blank partition b's rows to -1 (bit-exact no-op
+            # contribution, same trick as the sequential unified path)
+            return tuple(jnp.where(use_swap_k, r, -1) for r in rows)
+
+        olda = (va_k.assign, va_k.leader, va_k.disk)
+        newa = (
+            pick(n1w[0], new_s[0]),
+            pick(n1w[1], new_s[1]),
+            pick(n1w[2], new_s[2]),
+        )
+        oldb = inert((vb_k.assign, vb_k.leader, vb_k.disk))
+        newb = inert(n2w)
+        return olda, newa, oldb, newb, jnp.where(use_swap_k, ok_w, feas_s)
+
+    olda, newa, oldb, newb, feas = jax.vmap(plan)(
+        ks_single, ks_swap, va, vb, pa, pb, use_swap, use_evac
+    )
+
+    deltas = jax.vmap(
+        lambda va_k, o1, n1, vb_k, o2, n2: swap_scorer(
+            ss, va_k, o1, n1, vb_k, o2, n2
+        )
+    )(va, olda, newa, vb, oldb, newb)
+
+    accept = feas & jax.vmap(
+        lambda vec, k: lex_accept(
+            ss.cost_vec, vec, hard_arr, weights, temperature, k
+        )
+    )(deltas.cost_vec, ks_acc)
+
+    # --- disjoint selection in draw order (keeps the SA proposal mix
+    # unbiased; the polish pass, whose job is descent, selects lex-best
+    # first instead) --------------------------------------------------------
+    touched = jnp.concatenate([olda[0], newa[0], oldb[0], newb[0]], axis=1)
+    bmask = jnp.zeros((K, B), bool)
+    bmask = jax.vmap(lambda z, bb, v: z.at[bb].set(v, mode="drop"))(
+        bmask,
+        jnp.where(touched >= 0, jnp.clip(touched, 0, B - 1), B),
+        touched >= 0,
+    )
+    ta = jnp.clip(va.topic, 0, T - 1)
+    tb = jnp.clip(vb.topic, 0, T - 1)
+
+    def select(k, carry):
+        used_b, used_t, sel = carry
+        conf = (
+            jnp.any(bmask[k] & used_b)
+            | used_t[ta[k]]
+            | (use_swap[k] & used_t[tb[k]])
+        )
+        take_k = accept[k] & ~conf
+        sel = sel.at[k].set(take_k)
+        used_b = used_b | (bmask[k] & take_k)
+        used_t = used_t.at[ta[k]].max(take_k)
+        used_t = used_t.at[tb[k]].max(take_k & use_swap[k])
+        return used_b, used_t, sel
+
+    _, _, take = jax.lax.fori_loop(
+        0,
+        K,
+        select,
+        (jnp.zeros(B, bool), jnp.zeros(T, bool), jnp.zeros(K, bool)),
+    )
+
+    # --- exact composition over the selected disjoint subset ---------------
+    def acc(k, carry):
+        agg, part, mtl, trd, totals = carry
+        w = take[k].astype(jnp.float32)
+        wi = take[k].astype(jnp.int32)
+        va_k = view_at(va, k)
+        vb_k = view_at(vb, k)
+        o1 = tuple(x[k] for x in olda)
+        n1 = tuple(x[k] for x in newa)
+        o2 = tuple(x[k] for x in oldb)
+        n2 = tuple(x[k] for x in newb)
+        agg = scatter_partition(agg, m, va_k, *o1, -w, -wi)
+        agg = scatter_partition(agg, m, va_k, *n1, w, wi)
+        agg = scatter_partition(agg, m, vb_k, *o2, -w, -wi)
+        agg = scatter_partition(agg, m, vb_k, *n2, w, wi)
+        part = part + w * (deltas.part_sums[k] - ss.part_sums)
+        mtl = mtl + w * deltas.d_mtl[k]
+        trd = trd + w * deltas.d_trd[k]
+        totals = totals.at[va_k.topic].add(w * deltas.d_total[k])
+        totals = totals.at[vb_k.topic].add(w * deltas.d_total2[k])
+        return agg, part, mtl, trd, totals
+
+    agg, part, mtl, trd, totals = jax.lax.fori_loop(
+        0, K, acc, (ss.agg, ss.part_sums, ss.mtl_sum, ss.trd_sum, ss.topic_totals)
+    )
+    cost_vec = vector_fn(agg, part, mtl, trd, tt.trd_normalizer(m, totals))
+
+    # composed-hard guard: additivity is exact for every hard goal term
+    # under disjointness, so only float reduction-order drift across a
+    # capacity hinge can trip this — reject the whole batch if it does
+    d = cost_vec - ss.cost_vec
+    batch_ok = ~jnp.any((jnp.abs(d) > goal_tols(ss.cost_vec)) & hard_arr & (d > 0))
+
+    def sel_tree(new, old):
+        return jax.tree.map(lambda a, b: jnp.where(batch_ok, a, b), new, old)
+
+    if locate is not None:
+        ia, owna = locate(pa)
+        ib, ownb = locate(pb)
+    else:
+        ia, owna = pa, jnp.ones((K,), bool)
+        ib, ownb = pb, jnp.ones((K,), bool)
+
+    write_a = take & batch_ok & owna
+    write_b = take & batch_ok & use_swap & ownb
+    mirror_a = take & batch_ok & va.pvalid
+    mirror_b = take & batch_ok & use_swap & vb.pvalid
+    return ss.replace(
+        agg=sel_tree(agg, ss.agg),
+        part_sums=sel_tree(part, ss.part_sums),
+        mtl_sum=sel_tree(mtl, ss.mtl_sum),
+        trd_sum=sel_tree(trd, ss.trd_sum),
+        topic_totals=sel_tree(totals, ss.topic_totals),
+        cost_vec=sel_tree(cost_vec, ss.cost_vec),
+        n_accepted=ss.n_accepted
+        + jnp.where(batch_ok, jnp.sum(take.astype(jnp.int32)), 0),
+        **_placement_updates(
+            ss,
+            group,
+            write=jnp.concatenate([write_a, write_b]),
+            ps=jnp.concatenate([ia, ib]),
+            mirror=jnp.concatenate([mirror_a, mirror_b]),
+            global_ps=jnp.concatenate([pa, pb]),
+            ts=jnp.concatenate([va.topic, vb.topic]),
+            rows=jnp.concatenate([newa[0], newb[0]]),
+            leads=jnp.concatenate([newa[1], newb[1]]),
+            disks=jnp.concatenate([newa[2], newb[2]]),
+        ),
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("goal_names", "cfg", "opts", "p_real", "b_real", "max_pt"),
@@ -775,9 +1023,22 @@ def _run_chains(
         p_swap=opts.p_swap if allow_inter else 0.0,
         target_capacity=bool(CAPACITY_GOALS & set(goal_names)),
         cap_thresholds=tuple(cfg.capacity_threshold),
+        p_lead_swap=lead_swap_share(opts.p_leadership),
+    )
+    from ccx.search.state import make_cost_vector_fn
+
+    # Batched disjoint proposals need room to BE disjoint: each move touches
+    # ~2R brokers, so on small clusters (B1-scale) most of a batch conflicts
+    # and churn collapses — measured 2.5x fewer accepted moves at B=10.
+    # Sequential composition wins there; batching wins from ~hundreds of
+    # brokers up (B5: 1024 >> 4*R*K).
+    batched = (
+        opts.batched
+        and opts.moves_per_step > 1
+        and b_real >= 4 * m.R * opts.moves_per_step
     )
     step = functools.partial(
-        _anneal_step,
+        _anneal_step_batched if batched else _anneal_step,
         m=m,
         pp=pp,
         hard_arr=hard_arr,
@@ -786,6 +1047,11 @@ def _run_chains(
         scorer=make_move_scorer(m, goal_names, cfg),
         swap_scorer=make_swap_scorer(m, goal_names, cfg),
         group=group,
+        **(
+            {"vector_fn": make_cost_vector_fn(m, goal_names, cfg)}
+            if batched
+            else {}
+        ),
     )
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:

@@ -44,6 +44,7 @@ from ccx.search.annealer import (
     allows_inter_broker,
     goal_tols,
     hot_partition_list,
+    lead_swap_share,
     propose_move,
     propose_swap,
 )
@@ -88,6 +89,13 @@ class GreedyOptions:
     #: 1 restores classic best-move hill climbing; >1 is what lets the
     #: polish clean thousands of residuals at B5 scale within max_iters.
     batch_moves: int = 16
+    #: restrict EVERY proposal to leadership movements: single proposals are
+    #: all LEADERSHIP_MOVEMENT (p_leadership forced to 1) and swap proposals
+    #: are all count-preserving leadership rotations — no replica ever
+    #: changes broker. This is the final preferred-leadership pass of the
+    #: pipeline (ref: PreferredLeaderElectionGoal runs last in the goal
+    #: order, SURVEY.md section 2.3) and the demote fast path.
+    leadership_only: bool = False
     seed: int = 0
 
 
@@ -238,12 +246,37 @@ def _greedy_loop(
                 totals = totals.at[view_i.topic].add(w * deltas.d_total[i])
                 return agg, part, mtl, trd, totals
 
-            agg, part, mtl, trd, totals = jax.lax.fori_loop(
-                0, n_batch, acc,
-                (s.agg, s.part_sums, s.mtl_sum, s.trd_sum, s.topic_totals),
+            # Slot 0 always holds the lex-best candidate (_lex_argmin over
+            # the improving set), so the state after acc(0, .) doubles as the
+            # single-move fallback checkpoint.
+            first = acc(0, (s.agg, s.part_sums, s.mtl_sum, s.trd_sum,
+                            s.topic_totals))
+            full = jax.lax.fori_loop(1, n_batch, acc, first)
+
+            def costs_of(c):
+                agg_c, part_c, mtl_c, trd_c, totals_c = c
+                return vector_fn(
+                    agg_c, part_c, mtl_c, trd_c, tt.trd_normalizer(m, totals_c)
+                )
+
+            cost_full = costs_of(full)
+            # Disjointness makes sum-decomposable goal terms exactly
+            # additive, but the leader-evenness and trd-normalizer couplings
+            # are not sum-decomposable, and per-candidate vetoes are
+            # tolerance-filtered — a composed batch can net-regress a tier
+            # even though every member improved vs base. The composed vector
+            # is recomputed exactly here; when it is not lex-better than the
+            # iteration base, fall back to the best single move, which IS
+            # exactly lex-improving.
+            batch_ok = (n_sel <= 1) | _lex_lt_batch(
+                cost_full[None, :], s.cost_vec
+            )[0]
+            agg, part, mtl, trd, totals = jax.tree.map(
+                lambda a, b: jnp.where(batch_ok, a, b), full, first
             )
-            norm = tt.trd_normalizer(m, totals)
-            cost_vec = vector_fn(agg, part, mtl, trd, norm)
+            cost_vec = jnp.where(batch_ok, cost_full, costs_of(first))
+            n_applied = jnp.where(batch_ok, n_sel, jnp.minimum(n_sel, 1))
+            write = taken & (batch_ok | (jnp.arange(n_batch) == 0))
             rows_k = new_rows[safe]
             leads_k = news[1][safe]
             disks_k = news[2][safe]
@@ -254,13 +287,13 @@ def _greedy_loop(
                 trd_sum=trd,
                 topic_totals=totals,
                 cost_vec=cost_vec,
-                n_accepted=s.n_accepted + n_sel,
+                n_accepted=s.n_accepted + n_applied,
                 **_placement_updates(
                     s,
                     group,
-                    write=taken,
+                    write=write,
                     ps=ps[safe],
-                    mirror=taken & views.pvalid[safe],
+                    mirror=write & views.pvalid[safe],
                     global_ps=ps[safe],
                     ts=cand_t[safe],
                     rows=rows_k,
@@ -310,13 +343,15 @@ def _greedy_loop(
                     pick_w(sw[7]), pick_w(sw_delta), any_swap, group=group,
                 )
 
+            prev_accepted = ss.n_accepted
             ss = jax.lax.cond(take_swap, apply_best_swap, apply_batch, ss)
             any_better = any_single | any_swap
-            n_applied = jnp.where(take_swap, any_swap.astype(jnp.int32), n_sel)
+            n_applied = ss.n_accepted - prev_accepted
         else:
+            prev_accepted = ss.n_accepted
             ss = apply_batch(ss)
             any_better = any_single
-            n_applied = n_sel
+            n_applied = ss.n_accepted - prev_accepted
 
         it = it + 1
         stale = jnp.where(any_better, 0, stale + 1)
@@ -342,21 +377,32 @@ def greedy_optimize(
     bv = np.asarray(m.broker_valid)
     b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
     allow_inter = allows_inter_broker(goal_names)
+    lead_only = opts.leadership_only
     pp = ProposalParams(
         p_real=p_real,
         b_real=b_real,
-        p_leadership=opts.p_leadership,
-        p_disk=opts.p_disk,
-        p_biased_dest=opts.p_biased_dest,
-        p_evac=opts.p_evac,
-        target_rack=bool(RACK_TARGET_GOALS & set(goal_names)),
-        allow_inter=allow_inter,
+        p_leadership=1.0 if lead_only else opts.p_leadership,
+        p_disk=0.0 if lead_only else opts.p_disk,
+        p_biased_dest=0.0 if lead_only else opts.p_biased_dest,
+        p_evac=0.0 if lead_only else opts.p_evac,
+        target_rack=(not lead_only)
+        and bool(RACK_TARGET_GOALS & set(goal_names)),
+        allow_inter=allow_inter and not lead_only,
         p_swap=opts.swap_fraction if allow_inter else 0.0,
-        target_capacity=bool(CAPACITY_GOALS & set(goal_names)),
+        target_capacity=(not lead_only)
+        and bool(CAPACITY_GOALS & set(goal_names)),
         cap_thresholds=tuple(cfg.capacity_threshold),
+        # every swap proposal is a leadership rotation in leadership-only
+        # mode — a replica swap would move replicas between brokers
+        p_lead_swap=1.0 if lead_only else lead_swap_share(opts.p_leadership),
     )
 
-    evac_np, n_evac_i = hot_partition_list(m, goal_names, cfg)
+    if lead_only:
+        # leadership moves cannot heal placement offenders; skip the
+        # aggregate pass that builds the hot list (p_evac is 0 anyway)
+        evac_np, n_evac_i = np.zeros(1, np.int32), 0
+    else:
+        evac_np, n_evac_i = hot_partition_list(m, goal_names, cfg)
     max_pt = max_partitions_per_topic(m)
     group0 = (
         make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None

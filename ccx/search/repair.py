@@ -37,7 +37,8 @@ from ccx.search.annealer import (
 
 
 @functools.partial(
-    jax.jit, static_argnames=("target_rack", "target_capacity", "cfg")
+    jax.jit,
+    static_argnames=("target_rack", "target_capacity", "cfg", "nk"),
 )
 def _sweep(
     m: TensorClusterModel,
@@ -49,6 +50,7 @@ def _sweep(
     target_rack: bool,
     target_capacity: bool,
     cfg: GoalConfig,
+    nk: int,
 ):
     P, R, B, K = m.P, m.R, m.B, m.num_racks
     pvalid = m.partition_valid
@@ -149,16 +151,37 @@ def _sweep(
         )
     )
 
-    # --- destination choice -------------------------------------------------
-    # brokers already hosting the partition (excluding the offender slot)
-    keep = valid & (jnp.arange(R)[None, :] != slot[:, None])
-    in_part = jnp.zeros((P, B), bool)
-    rows = jnp.repeat(jnp.arange(P)[:, None], R, 1)
-    in_part = in_part.at[rows, safe_b].max(keep)
+    # --- bounded offender set ----------------------------------------------
+    # Destination scoring needs [offenders, B] matrices; doing it for every
+    # partition materialized ~0.5 GB of [P, B] temporaries at B5 scale.
+    # Offenders are a small fraction of P, so score only the first ``nk``
+    # of them (static bound) — when more exist, the next sweep of the
+    # hard_repair loop picks up the remainder.
+    # Severity-ordered selection (argsort on the per-partition max offender
+    # score): structural offenders (dead broker/disk, duplicate, rack)
+    # outrank capacity shedding, so plentiful hot-broker picks can never
+    # starve the offenders the sweep MUST fix before hard_repair's
+    # capacity-oscillation break may fire.
+    score_max = jnp.max(score, axis=1)
+    eligible = pvalid & has_offender
+    order = jnp.argsort(jnp.where(eligible, -score_max, jnp.inf))[:nk]
+    sel_ok = eligible[order]                                  # bool[nk]
+    sel = jnp.where(sel_ok, order, P)
+    ssel = jnp.clip(sel, 0, P - 1)
+    slot_s = slot[ssel]                                       # int[nk]
+    valid_s = valid[ssel]                                     # [nk, R]
+    safe_b_s = safe_b[ssel]                                   # [nk, R]
+    racks_s = racks[ssel]                                     # [nk, R]
 
-    used_rack = jnp.zeros((P, K), bool)
-    rack_idx = jnp.clip(racks, 0, K - 1)
-    used_rack = used_rack.at[rows, rack_idx].max(keep & (racks >= 0))
+    # brokers already hosting the partition (excluding the offender slot)
+    keep = valid_s & (jnp.arange(R)[None, :] != slot_s[:, None])
+    rows = jnp.repeat(jnp.arange(nk)[:, None], R, 1)
+    in_part = jnp.zeros((nk, B), bool).at[rows, safe_b_s].max(keep)
+
+    rack_idx = jnp.clip(racks_s, 0, K - 1)
+    used_rack = jnp.zeros((nk, K), bool).at[rows, rack_idx].max(
+        keep & (racks_s >= 0)
+    )
 
     # prefer destinations under effective capacity, but never strand an
     # offender: when no under-capacity destination exists (e.g. every alive
@@ -167,7 +190,7 @@ def _sweep(
     allowed_cap = allowed_any & ~over_b[None, :]
     has_cap_dest = jnp.any(allowed_cap, axis=1, keepdims=True)
     allowed_base = jnp.where(has_cap_dest, allowed_cap, allowed_any)
-    rack_free = ~used_rack[:, jnp.clip(m.broker_rack, 0, K - 1)]  # [P, B]
+    rack_free = ~used_rack[:, jnp.clip(m.broker_rack, 0, K - 1)]  # [nk, B]
     allowed_rack = allowed_base & rack_free
     use_rack_constraint = jnp.any(allowed_rack, axis=1, keepdims=True)
     allowed = jnp.where(use_rack_constraint, allowed_rack, allowed_base)
@@ -180,36 +203,31 @@ def _sweep(
         jnp.max(agg.replica_count), 1.0
     )
     base_score = headroom + 0.5 * count_head
-    noise = jax.random.uniform(key, (P, B)) * 0.35
+    noise = jax.random.uniform(key, (nk, B)) * 0.35
     dest_score = jnp.where(allowed, base_score[None, :] + noise, -jnp.inf)
-    dest = jnp.argmax(dest_score, axis=1).astype(jnp.int32)   # int[P]
+    dest = jnp.argmax(dest_score, axis=1).astype(jnp.int32)   # int[nk]
     dest_found = jnp.isfinite(jnp.max(dest_score, axis=1))
 
     # --- disk-only offenders move disks, not brokers ------------------------
     # choose the least-loaded alive disk on the *current* broker
-    cur_b = jnp.take_along_axis(safe_b, slot[:, None], 1)[:, 0]
-    disk_ok = m.disk_alive[cur_b]                             # [P, D]
+    cur_b = jnp.take_along_axis(safe_b_s, slot_s[:, None], 1)[:, 0]
+    disk_ok = m.disk_alive[cur_b]                             # [nk, D]
     disk_load = agg.disk_load[cur_b] / jnp.maximum(m.disk_capacity[cur_b], 1e-9)
     disk_score = jnp.where(disk_ok, -disk_load, -jnp.inf)
     best_disk = jnp.argmax(disk_score, axis=1).astype(jnp.int32)
     disk_found = jnp.isfinite(jnp.max(disk_score, axis=1))
 
-    # --- apply --------------------------------------------------------------
-    do_move = pvalid & has_offender & dest_found & ~off_is_disk_only
-    do_disk = pvalid & has_offender & off_is_disk_only & disk_found
-    pidx = jnp.arange(P)
-    new_assignment = assignment.at[pidx, slot].set(
-        jnp.where(do_move, dest, jnp.take_along_axis(assignment, slot[:, None], 1)[:, 0])
-    )
-    new_disk_val = jnp.where(
-        do_move,
-        0,
-        jnp.where(
-            do_disk, best_disk,
-            jnp.take_along_axis(replica_disk, slot[:, None], 1)[:, 0],
-        ),
-    )
-    new_replica_disk = replica_disk.at[pidx, slot].set(new_disk_val)
+    # --- apply (suppressed writes routed out of bounds and dropped) ---------
+    disk_only_s = off_is_disk_only[ssel]
+    do_move = sel_ok & dest_found & ~disk_only_s
+    do_disk = sel_ok & disk_only_s & disk_found
+    new_assignment = assignment.at[
+        jnp.where(do_move, ssel, P), slot_s
+    ].set(dest, mode="drop")
+    new_disk_val = jnp.where(do_move, 0, best_disk)
+    new_replica_disk = replica_disk.at[
+        jnp.where(do_move | do_disk, ssel, P), slot_s
+    ].set(new_disk_val, mode="drop")
     n_moved = jnp.sum(do_move) + jnp.sum(do_disk)
     n_over_b = jnp.sum(over_b)
     return new_assignment, new_replica_disk, n_moved, n_over_b
@@ -248,6 +266,11 @@ def hard_repair(
     leader_slot = m.leader_slot
     replica_disk = m.replica_disk
     total = 0
+    # static per-sweep offender bound: [nk, B] scoring matrices instead of
+    # [P, B] (0.5 GB of temporaries at B5). P/16 covers typical offender
+    # densities in one or two sweeps; the loop below retries while offenders
+    # remain, so a larger spill only costs extra sweeps, never correctness.
+    nk = min(m.P, max(1024, m.P // 16))
     if allows_inter_broker(goal_names):
         key = jax.random.PRNGKey(seed)
         prev_over = None
@@ -256,7 +279,7 @@ def hard_repair(
             assignment, replica_disk, n, n_over = _sweep(
                 m, assignment, leader_slot, replica_disk, sub,
                 target_rack=target_rack, target_capacity=target_capacity,
-                cfg=cfg,
+                cfg=cfg, nk=nk,
             )
             n = int(n)
             n_over = int(n_over)

@@ -116,6 +116,7 @@ class CruiseControl:
         anneal = AnnealOptions(
             n_chains=self.config["optimizer.num.chains"],
             n_steps=self.config["optimizer.num.steps"],
+            moves_per_step=self.config["optimizer.moves.per.step"],
             seed=self.config["optimizer.seed"],
         )
         polish = GreedyOptions(
@@ -126,12 +127,15 @@ class CruiseControl:
         import dataclasses as _dc
 
         if leadership_only:
-            # Swaps relocate replicas and bypass the move-kind draw, so a
-            # leadership-only search (demote) must disable them explicitly.
+            # The annealer's swap branch mixes replica swaps in by draw, so
+            # a leadership-only search (demote) disables swaps there; the
+            # polish runs in leadership_only mode, where every proposal —
+            # including swaps, which become count-preserving leadership
+            # rotations — is guaranteed to keep replicas in place.
             anneal = _dc.replace(
                 anneal, p_leadership=1.0, p_biased_dest=0.0, p_swap=0.0
             )
-            polish = _dc.replace(polish, p_leadership=1.0, swap_fraction=0.0)
+            polish = _dc.replace(polish, leadership_only=True)
         if disk_only:
             anneal = _dc.replace(
                 anneal, p_disk=1.0, p_leadership=0.0, p_biased_dest=0.0,
@@ -143,6 +147,12 @@ class CruiseControl:
         return OptimizeOptions(
             anneal=anneal, polish=polish,
             check_evacuation=not disk_only,
+            # the portfolio candidate roughly doubles polish-phase cost;
+            # never pay it on the leadership-/disk-only fast paths
+            run_cold_greedy=(
+                self.config["optimizer.portfolio.cold.greedy"]
+                and not (leadership_only or disk_only)
+            ),
         )
 
     def _run_optimizer(self, model, goal_names, opts: OptimizeOptions,

@@ -114,6 +114,7 @@ class OptimizerSidecar:
             anneal=AnnealOptions(
                 n_chains=int(o.get("chains", 32)),
                 n_steps=int(o.get("steps", 3000)),
+                moves_per_step=int(o.get("moves_per_step", 8)),
                 seed=int(o.get("seed", 42)),
             ),
             polish=GreedyOptions(

@@ -77,6 +77,7 @@ def verify_optimization(
     proposals: list[ExecutionProposal] | None = None,
     require_hard_zero: bool = True,
     check_evacuation: bool = True,
+    check_per_goal: bool = True,
     stack_before: "StackResult | None" = None,
     stack_after: "StackResult | None" = None,
 ) -> Verification:
@@ -146,6 +147,26 @@ def verify_optimization(
     # hard-feasible start.
     if float(s0.hard_violations) == 0 and soft1 > soft0 * (1.0 + 1e-4) + 1e-6:
         failures.append(f"soft cost worsened: {soft0:.4f} -> {soft1:.4f}")
+
+    # Per-goal violation-count non-regression (ref: OptimizationVerifier
+    # asserts per-goal stats, SURVEY.md section 4). The aggregate soft
+    # scalar is blind to a low tier regressing while a high tier improves —
+    # round-2's bench carried verified=true while PreferredLeaderElection
+    # went 0->364 — so every soft goal's count is checked individually.
+    # Slack: structural repair/evacuation legitimately shifts load between
+    # brokers, churning distribution counts by a few percent; the bound
+    # catches introduced debris (hundreds) without flagging that churn.
+    # ``check_per_goal=False`` is for verifying PARTIAL pipelines (e.g. the
+    # annealer alone, whose low-tier debris the final leadership pass
+    # cleans); the full optimize() result is always held to the strict bar.
+    for n in s1.names if check_per_goal else ():
+        if GOAL_REGISTRY[n].hard:
+            continue
+        vb_, va_ = v0[n][0], v1[n][0]
+        if va_ > vb_ + max(8.0, 0.05 * vb_):
+            failures.append(
+                f"soft goal {n}: violations regressed {vb_:.0f} -> {va_:.0f}"
+            )
 
     if proposals is not None:
         failures.extend(_verify_proposals(before, after, proposals))

@@ -108,6 +108,37 @@ def test_sharded_anneal_partition_axis(model):
     )
 
 
+def test_sharded_anneal_batched_partition_axis():
+    """Batched disjoint proposals (AnnealOptions.batched) under
+    partition-axis sharding: ONE owner-gather + psum per step covers all 2K
+    candidate views, and the placements stay bit-exact vs the unsharded
+    batched annealer (same RNG stream, same disjoint selection). Needs a
+    cluster large enough to pass the small-cluster batching gate
+    (b_real >= 4 * R * moves_per_step)."""
+    m = random_cluster(
+        RandomClusterSpec(
+            n_brokers=64, n_racks=4, n_topics=8, n_partitions=256, seed=11
+        )
+    )
+    mesh = make_mesh(jax.devices(), parts=4)
+    opts = AnnealOptions(n_chains=4, n_steps=80, moves_per_step=4, seed=3)
+    rs = sharded_anneal(m, GoalConfig(), DEFAULT_GOAL_ORDER, opts, mesh)
+    ru = anneal(m, GoalConfig(), DEFAULT_GOAL_ORDER, opts)
+
+    # the batched path must actually take moves, and the model must stay
+    # sharded over parts
+    assert ru.n_accepted > 0
+    spec = rs.model.assignment.sharding.spec
+    assert spec and spec[0] == "parts", spec
+
+    np.testing.assert_array_equal(
+        np.asarray(rs.model.assignment), np.asarray(ru.model.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rs.model.leader_slot), np.asarray(ru.model.leader_slot)
+    )
+
+
 def test_sharded_stack_eval_kafka_assigner(model):
     """Kafka-assigner stacks evaluate sharded too (decomposed
     KafkaAssignerEvenRackAwareGoal) — parity between both eval paths."""

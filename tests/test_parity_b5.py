@@ -1,0 +1,98 @@
+"""B5-scale quality parity: the full pipeline vs its own greedy oracle.
+
+VERDICT r2 finding: sub-B5 parity tests plus lean-effort bench numbers
+cannot support an "equal-or-better goal-violation score" claim at the
+headline scale. This module runs the REAL B5 config (1000 brokers / 100k
+partitions, full default stack) at full effort and asserts the quality
+story end-to-end:
+
+* the pipeline's final cost vector is lexicographically <= the greedy
+  oracle's at the same polish budget (the reference's acceptance semantics,
+  SURVEY.md section 4 / OptimizationVerifier);
+* no preferred-leadership debris: PreferredLeaderElection violations end
+  at or below the input's (ref: PreferredLeaderElectionGoal runs last in
+  the goal order, SURVEY.md section 2.3);
+* verification passes under the strict per-goal non-regression check
+  (ccx.verify).
+
+Minutes-scale on the CPU backend -> marked ``nightly`` (excluded from
+default runs; `pytest -m nightly` executes it).
+"""
+
+import numpy as np
+import pytest
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER
+from ccx.model.fixtures import bench_spec, random_cluster
+from ccx.optimizer import OptimizeOptions, optimize
+from ccx.search.annealer import AnnealOptions
+from ccx.search.greedy import GreedyOptions, greedy_optimize
+
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
+
+CFG = GoalConfig()
+
+
+def _lex_leq(a, b, tol=1e-4):
+    for x, y in zip(np.asarray(a), np.asarray(b)):
+        if x < y - tol:
+            return True
+        if x > y + tol:
+            return False
+    return True
+
+
+def test_b5_pipeline_matches_or_beats_oracle_full_effort():
+    m = random_cluster(bench_spec("B5"))
+    polish = GreedyOptions(n_candidates=256, max_iters=400, patience=8)
+    res = optimize(
+        m,
+        CFG,
+        DEFAULT_GOAL_ORDER,
+        OptimizeOptions(
+            anneal=AnnealOptions(
+                n_chains=32, n_steps=3000, moves_per_step=32, seed=42
+            ),
+            polish=polish,
+        ),
+    )
+    oracle = greedy_optimize(m, CFG, DEFAULT_GOAL_ORDER, polish)
+
+    before = res.stack_before.by_name()
+    after = res.stack_after.by_name()
+
+    # pipeline >= oracle lexicographically (portfolio guarantees it; this
+    # asserts the guarantee holds at B5 scale, full effort)
+    assert _lex_leq(
+        np.asarray(res.stack_after.costs), np.asarray(oracle.stack_after.costs)
+    ), (
+        "pipeline lexicographically worse than oracle at B5:\n"
+        f"  pipeline: {after}\n"
+        f"  oracle:   {oracle.stack_after.by_name()}"
+    )
+
+    # hard feasibility reached and the strict verifier (per-goal
+    # non-regression included) passes
+    assert float(res.stack_after.hard_cost) == 0.0
+    assert res.verification.ok, res.verification.failures
+
+    # no preferred-leadership debris: the final leadership pass must leave
+    # PLE at or below the input level (round-2 bench introduced 364)
+    assert after["PreferredLeaderElectionGoal"][0] <= (
+        before["PreferredLeaderElectionGoal"][0]
+    )
+
+    # mid-tier distribution goals must genuinely converge at full effort,
+    # not just shave costs: violation counts cut >= 50% from the input
+    # (VERDICT r2 "Next round" #4 done-bar)
+    for goal in (
+        "ReplicaDistributionGoal",
+        "DiskUsageDistributionGoal",
+        "NetworkInboundUsageDistributionGoal",
+        "CpuUsageDistributionGoal",
+    ):
+        vb, va = before[goal][0], after[goal][0]
+        assert va <= 0.5 * vb, (
+            f"{goal}: violations {vb:.0f} -> {va:.0f}, less than 50% cut"
+        )

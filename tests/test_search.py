@@ -6,6 +6,8 @@ RandomClusterTest / RandomSelfHealingTest -> the anneal tests here;
 OptimizationVerifier -> ccx.verify assertions.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -153,6 +155,9 @@ def test_proposals_diff_roundtrip(annealed, small_model):
     v = verify_optimization(
         small_model, annealed.model, CFG, DEFAULT_GOAL_ORDER,
         proposals=props, require_hard_zero=False,
+        # annealer-only result: low-tier debris is the final leadership
+        # pass's job (ccx.optimizer), not this roundtrip's subject
+        check_per_goal=False,
     )
     assert v.ok, v.failures
     kinds = {a for p in props for a in p.actions}
@@ -246,6 +251,31 @@ def test_immovable_partitions_respected(small_model):
     np.testing.assert_array_equal(
         np.asarray(res.model.leader_slot)[:10], np.asarray(m.leader_slot)[:10]
     )
+
+
+def test_batched_anneal_improves_and_stays_consistent():
+    """AnnealOptions.batched on a cluster wide enough to pass the
+    small-cluster gate: disjoint batches must make real progress and keep
+    the incremental state truthful (verified by the from-scratch re-eval
+    inside anneal())."""
+    m = random_cluster(
+        RandomClusterSpec(
+            n_brokers=64, n_racks=4, n_topics=8, n_partitions=256, seed=11
+        )
+    )
+    opts = AnnealOptions(n_chains=4, n_steps=150, moves_per_step=4, seed=3)
+    res = anneal(m, CFG, DEFAULT_GOAL_ORDER, opts)
+    assert res.n_accepted > 0
+    assert res.improved
+    # batched and sequential are DIFFERENT deterministic chains
+    seq = anneal(
+        m, CFG, DEFAULT_GOAL_ORDER,
+        dataclasses.replace(opts, batched=False),
+    )
+    assert seq.n_accepted > 0
+    # both end hard-feasible-or-better from the same start
+    assert float(res.stack_after.hard_cost) <= float(res.stack_before.hard_cost)
+    assert float(seq.stack_after.hard_cost) <= float(seq.stack_before.hard_cost)
 
 
 def test_optimize_end_to_end(small_model):

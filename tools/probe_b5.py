@@ -54,21 +54,32 @@ def main():
     chains = int(os.environ.get("PROBE_CHAINS", "32"))
     moves = int(os.environ.get("PROBE_MOVES", "8"))
     p_swap = float(os.environ.get("PROBE_SWAP", "0.15"))
+    batched = os.environ.get("PROBE_BATCHED", "1") == "1"
+    warms = {}
     for steps in (10, 50):
         opts = AnnealOptions(
             n_chains=chains, n_steps=steps, moves_per_step=moves, seed=42,
-            p_swap=p_swap,
+            p_swap=p_swap, batched=batched,
         )
         _, cold = t(f"anneal[{steps}] cold(compile+run)", anneal, rep, cfg,
                     DEFAULT_GOAL_ORDER, opts)
         _, warm = t(f"anneal[{steps}] warm", anneal, rep, cfg,
                     DEFAULT_GOAL_ORDER, opts)
+        warms[steps] = warm
         per_step = warm / steps
         print(
-            f"[probe] anneal per-step (chains={chains} moves={moves}): "
-            f"{per_step * 1e3:.1f} ms -> 3000 steps = {per_step * 3000:.0f}s",
+            f"[probe] anneal per-step (chains={chains} moves={moves} "
+            f"batched={batched}): {per_step * 1e3:.1f} ms -> 3000 steps = "
+            f"{per_step * 3000:.0f}s",
             flush=True,
         )
+    slope = (warms[50] - warms[10]) / 40
+    print(
+        f"[probe] anneal step SLOPE (chains={chains} moves={moves} "
+        f"batched={batched}): {slope * 1e3:.1f} ms/step, "
+        f"{slope / moves * 1e3:.2f} ms/proposal",
+        flush=True,
+    )
 
     popts = GreedyOptions(n_candidates=256, max_iters=5, patience=5)
     _, cold = t("polish[5 iters] cold", greedy_optimize, rep, cfg,

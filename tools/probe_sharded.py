@@ -1,0 +1,93 @@
+"""Quantify partition-axis-sharding overhead on the virtual CPU mesh.
+
+VERDICT r2 "Next round" #7: before real multi-chip hardware exists, put a
+number on what `sharded_anneal`'s per-move collectives cost relative to the
+unsharded annealer at FIXED work, and how batched proposals
+(AnnealOptions.batched — one gather+psum per step instead of per proposal)
+change that ratio. On the 8-virtual-CPU-device mesh the "collectives" are
+memcpy-grade, so the ratio mostly prices the extra gather/masking/psum
+*structure*; on real ICI the per-collective latency multiplies the same
+counts, which is exactly why the batched mode's 1-collective-per-step
+matters.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+       python tools/probe_sharded.py
+Results land in docs/perf-notes.md (round 3 section).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from ccx.goals.base import GoalConfig  # noqa: E402
+from ccx.goals.stack import DEFAULT_GOAL_ORDER  # noqa: E402
+from ccx.model.fixtures import RandomClusterSpec, random_cluster  # noqa: E402
+from ccx.parallel.sharding import make_mesh, sharded_anneal  # noqa: E402
+from ccx.search.annealer import AnnealOptions, anneal  # noqa: E402
+
+
+def timed(fn, *a, **k):
+    r = fn(*a, **k)
+    jax.block_until_ready(r.model.assignment)
+    t0 = time.monotonic()
+    r = fn(*a, **k)
+    jax.block_until_ready(r.model.assignment)
+    return time.monotonic() - t0
+
+
+def main():
+    n_b = int(os.environ.get("PROBE_BROKERS", "256"))
+    n_p = int(os.environ.get("PROBE_PARTS", "16000"))
+    m = random_cluster(
+        RandomClusterSpec(
+            n_brokers=n_b, n_racks=8, n_topics=64, n_partitions=n_p, seed=5
+        )
+    )
+    cfg = GoalConfig()
+    mesh = make_mesh(jax.devices(), parts=4)  # (chains=2, parts=4)
+    print(
+        f"[sharded-probe] P={m.P} B={m.B} mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}",
+        flush=True,
+    )
+
+    steps_lo, steps_hi = 10, 50
+    for label, moves, batched in (
+        ("sequential", 8, False),
+        ("batched-8", 8, True),
+        ("batched-32", 32, True),
+    ):
+        res = {}
+        for steps in (steps_lo, steps_hi):
+            opts = AnnealOptions(
+                n_chains=4, n_steps=steps, moves_per_step=moves, seed=3,
+                batched=batched,
+            )
+            t_u = timed(anneal, m, cfg, DEFAULT_GOAL_ORDER, opts)
+            t_s = timed(sharded_anneal, m, cfg, DEFAULT_GOAL_ORDER, opts, mesh)
+            res[steps] = (t_u, t_s)
+        slope_u = (res[steps_hi][0] - res[steps_lo][0]) / (steps_hi - steps_lo)
+        slope_s = (res[steps_hi][1] - res[steps_lo][1]) / (steps_hi - steps_lo)
+        print(
+            f"[sharded-probe] {label:>12}: unsharded {slope_u * 1e3:7.1f} ms/step"
+            f"  sharded {slope_s * 1e3:7.1f} ms/step"
+            f"  ratio {slope_s / max(slope_u, 1e-9):5.2f}x"
+            f"  ({slope_s / moves * 1e3:6.2f} ms/proposal sharded)",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
