@@ -30,7 +30,7 @@ from ccx.proposals import ExecutionProposal, diff
 from ccx.goals.stack import evaluate_stack
 from ccx.search.annealer import AnnealOptions, anneal
 from ccx.search.greedy import GreedyOptions, greedy_optimize
-from ccx.search.repair import hard_repair
+from ccx.search.repair import finalize_preferred_leaders, hard_repair
 from ccx.verify import Verification, verify_optimization
 
 
@@ -275,6 +275,14 @@ def optimize(
             stack_after = lead.stack_after
             n_polish += lead.n_moves
         phases["leader-pass"] = time.monotonic() - t
+    # exact final guarantee: fold leadership decisions into canonical
+    # replica order (leader first), zeroing fixable PLE violations without
+    # perturbing any other tier — see repair.finalize_preferred_leaders
+    t = _enter("preferred-leader")
+    model, stack_after, _ = finalize_preferred_leaders(
+        model, cfg, goal_names, stack_after
+    )
+    phases["preferred-leader"] = time.monotonic() - t
     t = _enter("diff")
     proposals = diff(m, model)
     phases["diff"] = time.monotonic() - t

@@ -230,7 +230,109 @@ def _sweep(
     ].set(new_disk_val, mode="drop")
     n_moved = jnp.sum(do_move) + jnp.sum(do_disk)
     n_over_b = jnp.sum(over_b)
-    return new_assignment, new_replica_disk, n_moved, n_over_b
+    # FIXABLE structural offenders present BEFORE this sweep's moves (dead
+    # broker/disk, duplicate broker, rack duplicate) — capacity shedding is
+    # the only offender class the oscillation break in hard_repair may
+    # abandon, so the caller needs to know whether any structural work
+    # remained when the sweep ran. Rack duplicates only count while the row
+    # is rack-FEASIBLE (rf <= racks with an alive receiver): infeasible rows
+    # (OptimizationFailure territory, ccx.feasibility) would otherwise pin
+    # n_struct > 0 forever and disable the break entirely.
+    rack_has_recv = (
+        jnp.zeros(K, bool)
+        .at[jnp.clip(m.broker_rack, 0, K - 1)]
+        .max(recv_ok & m.broker_valid)
+    )
+    n_recv_racks = jnp.sum(rack_has_recv)
+    rf_row = jnp.sum(valid, axis=1)
+    rack_fixable = rf_row <= n_recv_racks
+    structural = (
+        on_dead
+        | on_dead_disk
+        | dup_broker
+        | (
+            dup_rack & rack_fixable[:, None]
+            if target_rack
+            else jnp.zeros_like(dup_broker)
+        )
+    )
+    n_struct = jnp.sum(pvalid & jnp.any(structural, axis=1))
+    return new_assignment, new_replica_disk, n_moved, n_over_b, n_struct
+
+
+def canonicalize_preferred_leaders(
+    m: TensorClusterModel,
+) -> tuple[TensorClusterModel, int]:
+    """Reorder replica lists so every chosen leader sits in the preferred
+    (slot-0) position — the pipeline's PreferredLeaderElectionGoal
+    guarantee.
+
+    Parity: the reference encodes leadership decisions in its proposals as
+    *replica-list order* — an ExecutionProposal's new leader is the first
+    replica of ``newReplicas`` and the executor runs a preferred-leader
+    election after reordering (SURVEY.md C20/C24; PreferredLeaderElectionGoal
+    "leadership on the first replica", section 2.3). The search engine moves
+    leadership freely to balance the leader tiers; this final pass folds
+    those decisions into the canonical order the reference's proposals carry.
+    Swapping two slots of a row relabels positions only: every goal except
+    PreferredLeaderElection scores roles (who leads, who follows) and broker
+    sets, which are unchanged — so the pass is exact, coupling-free, and
+    always ends with zero fixable PLE violations.
+
+    Immovable/excluded partitions are never touched (the search engine does
+    not move them either, so they can only carry PLE violations present in
+    the input). Returns (model, partitions reordered).
+    """
+    a = np.asarray(m.assignment).copy()
+    lead = np.asarray(m.leader_slot).copy()
+    dsk = np.asarray(m.replica_disk).copy()
+    pvalid = np.asarray(m.partition_valid)
+    imm = np.asarray(m.partition_immovable)
+    alive = np.asarray(m.broker_alive) & np.asarray(m.broker_valid)
+    excl = np.asarray(m.broker_excl_leadership)
+    b0 = np.clip(a[:, 0], 0, m.B - 1)
+    # mirror of partition_terms.preferred_leader_rows eligibility: only rows
+    # whose slot-0 broker could actually lead count as violations
+    eligible = pvalid & (a[:, 0] >= 0) & alive[b0] & ~excl[b0]
+    viol = eligible & (lead != 0) & ~imm
+    idx = np.nonzero(viol)[0]
+    if idx.size == 0:
+        return m, 0
+    j = lead[idx]
+    a[idx, 0], a[idx, j] = a[idx, j], a[idx, 0]
+    dsk[idx, 0], dsk[idx, j] = dsk[idx, j], dsk[idx, 0]
+    lead[idx] = 0
+    out = m.replace(
+        assignment=jnp.asarray(a, dtype=m.assignment.dtype),
+        leader_slot=jnp.asarray(lead, dtype=m.leader_slot.dtype),
+        replica_disk=jnp.asarray(dsk, dtype=m.replica_disk.dtype),
+    )
+    return out, int(idx.size)
+
+
+def finalize_preferred_leaders(
+    model: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...],
+    stack_after,
+):
+    """The pipeline's LAST stage, shared by every verified path (optimize()
+    and the facade's greedy backend): canonicalize preferred leaders and
+    re-evaluate the stack when anything changed. The verifier's zero
+    PLE slack (ccx.verify.soft_goal_slack) is a contract that every
+    verified pipeline ends here — change this helper, not the call sites.
+
+    Returns (model, stack_after, n_canonicalized). No-op for stacks that
+    don't score PreferredLeaderElectionGoal (e.g. intra-broker disk-only).
+    """
+    if "PreferredLeaderElectionGoal" not in goal_names:
+        return model, stack_after, 0
+    model, n = canonicalize_preferred_leaders(model)
+    if n:
+        from ccx.goals.stack import evaluate_stack
+
+        stack_after = evaluate_stack(model, cfg, goal_names)
+    return model, stack_after, n
 
 
 @jax.jit
@@ -253,12 +355,13 @@ def hard_repair(
     goal_names: tuple[str, ...],
     max_sweeps: int = 8,
     seed: int = 17,
+    nk: int | None = None,
 ) -> tuple[TensorClusterModel, int]:
     """Sweep until no targetable hard offenders remain (or max_sweeps).
 
     Returns (repaired model, total moves). Only runs the placement sweep for
     stacks that allow inter-broker movement; leader placement is fixed in
-    all cases.
+    all cases. ``nk`` overrides the per-sweep offender bound (tests).
     """
     target_rack = bool(RACK_TARGET_GOALS & set(goal_names))
     target_capacity = bool(CAPACITY_GOALS & set(goal_names))
@@ -270,13 +373,14 @@ def hard_repair(
     # [P, B] (0.5 GB of temporaries at B5). P/16 covers typical offender
     # densities in one or two sweeps; the loop below retries while offenders
     # remain, so a larger spill only costs extra sweeps, never correctness.
-    nk = min(m.P, max(1024, m.P // 16))
+    if nk is None:
+        nk = min(m.P, max(1024, m.P // 16))
     if allows_inter_broker(goal_names):
         key = jax.random.PRNGKey(seed)
         prev_over = None
         for i in range(max_sweeps):
             key, sub = jax.random.split(key)
-            assignment, replica_disk, n, n_over = _sweep(
+            assignment, replica_disk, n, n_over, n_struct = _sweep(
                 m, assignment, leader_slot, replica_disk, sub,
                 target_rack=target_rack, target_capacity=target_capacity,
                 cfg=cfg, nk=nk,
@@ -288,8 +392,16 @@ def hard_repair(
                 break
             # capacity shedding that stops reducing the over-capacity broker
             # count is oscillating (destinations saturated) — stop and let
-            # the annealer's targeted draws finish the job
-            if prev_over is not None and 0 < prev_over <= n_over:
+            # the annealer's targeted draws finish the job. Only honored once
+            # NO structural offenders (dead broker/disk, duplicate, rack)
+            # remained when the sweep ran: with > nk offenders a sweep is
+            # bounded, and breaking early could strand dead-broker
+            # evacuation on the annealer's random draws.
+            if (
+                int(n_struct) == 0
+                and prev_over is not None
+                and 0 < prev_over <= n_over
+            ):
                 break
             prev_over = n_over
     leader_slot = _leader_fix(m, assignment, leader_slot)

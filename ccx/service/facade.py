@@ -172,24 +172,28 @@ class CruiseControl:
             t0 = _t.monotonic()
             g = greedy_optimize(model, self.goal_config, goal_names, opts.polish)
             from ccx.goals.stack import evaluate_stack
+            from ccx.search.repair import finalize_preferred_leaders
             from ccx.verify import verify_optimization
 
-            proposals = diff(model, g.model)
+            out_model, stack_after, _ = finalize_preferred_leaders(
+                g.model, self.goal_config, goal_names, g.stack_after
+            )
+            proposals = diff(model, out_model)
             stack_before = evaluate_stack(model, self.goal_config, goal_names)
             verification = verify_optimization(
-                model, g.model, self.goal_config, goal_names,
+                model, out_model, self.goal_config, goal_names,
                 proposals=proposals,
                 require_hard_zero=opts.require_hard_zero,
                 check_evacuation=opts.check_evacuation,
                 stack_before=stack_before,
-                stack_after=g.stack_after,
+                stack_after=stack_after,
             )
             return OptimizerResult(
                 proposals=proposals,
                 stack_before=stack_before,
-                stack_after=g.stack_after,
+                stack_after=stack_after,
                 verification=verification,
-                model=g.model,
+                model=out_model,
                 wall_seconds=_t.monotonic() - t0,
                 n_sa_accepted=0,
                 n_polish_moves=g.n_moves,

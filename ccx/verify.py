@@ -69,6 +69,79 @@ def verify_model_consistency(m: TensorClusterModel) -> list[str]:
     return failures
 
 
+#: soft goals whose violations are counted per (topic x broker) cell rather
+#: than per broker (kernels.topic_replica_distribution via tt.trd_row_pen)
+_TOPIC_CELL_GOALS = frozenset({"TopicReplicaDistributionGoal"})
+#: soft goals counted per (broker x disk) cell (intra-broker JBOD)
+_DISK_CELL_GOALS = frozenset({"IntraBrokerDiskUsageDistributionGoal"})
+
+
+def soft_goal_slack(
+    name: str,
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    violations_before: float,
+    hard_feasible_start: bool,
+) -> float:
+    """Allowed violation-count increase for one soft goal.
+
+    Lexicographic optimization legitimately trades LOWER tiers for higher
+    ones, and a balance band is a knife-edge: a broker at 0.999x the band
+    limit flips to a violation when an unrelated move shifts the cluster
+    average. The slack therefore scales with the number of scoring units the
+    goal counts over — brokers for the per-broker distribution goals,
+    (topic x broker) cells for topic distribution, (broker x disk) cells for
+    intra-broker JBOD — at 2% of units (min 2): enough for band-edge churn,
+    far below real debris (the 28-violation PLE regression this bound was
+    built against is 28% of an 8-broker cluster's natural units).
+
+    Three exceptions:
+    * PreferredLeaderElectionGoal gets ZERO slack — the pipeline's final
+      canonicalization pass (repair.canonicalize_preferred_leaders) makes
+      every fixable violation vanish exactly, so any regression is a bug.
+    * PotentialNwOutGoal is a fixed-cap hinge over a placement-INVARIANT
+      total (every replica of a partition contributes its would-be-leader
+      outbound no matter where it sits), so when the per-broker average
+      potential exceeds a broker's cap, that broker is over cap in ANY
+      placement as balanced as the higher tiers demand — the input only
+      scores lower by being imbalanced. Brokers whose cap sits below the
+      alive-average potential are counted as unavoidable and excused.
+    * From a hard-INFEASIBLE start (dead brokers to evacuate, capacity
+      overflow to shed), structural repair must land displaced load on
+      scored brokers — every receiver can cross a band edge even when the
+      input scored zero. Allow an extra 3% of units (min 2, the absolute
+      component a goal at 0 needs) plus 10% of the input count.
+    """
+    if name == "PreferredLeaderElectionGoal":
+        return 0.0
+    alive_mask = np.asarray(m.broker_alive) & np.asarray(m.broker_valid)
+    alive = float(np.sum(alive_mask))
+    if name in _TOPIC_CELL_GOALS:
+        units = alive * max(float(m.num_topics), 1.0)
+    elif name in _DISK_CELL_GOALS:
+        units = float(np.sum(np.asarray(m.disk_alive)))
+    else:
+        units = alive
+    slack = max(2.0, 0.02 * units)
+    if name == "PotentialNwOutGoal":
+        from ccx.common.resources import Resource
+
+        pvalid = np.asarray(m.partition_valid)
+        rf = ((np.asarray(m.assignment) >= 0) & pvalid[:, None]).sum(axis=1)
+        out_rate = np.asarray(m.leader_load[int(Resource.NW_OUT)])
+        total = float(np.sum(out_rate * rf * pvalid))
+        avg = total / max(alive, 1.0)
+        # effective cap matches kernels.potential_nw_out
+        cap_eff = np.asarray(m.broker_capacity[int(Resource.NW_OUT)]) * float(
+            cfg.capacity_threshold[int(Resource.NW_OUT)]
+        )
+        unavoidable = float(np.sum(alive_mask & (cap_eff < avg)))
+        slack += max(0.0, unavoidable - violations_before)
+    if not hard_feasible_start:
+        slack += max(2.0, 0.03 * units) + 0.10 * violations_before
+    return slack
+
+
 def verify_optimization(
     before: TensorClusterModel,
     after: TensorClusterModel,
@@ -152,18 +225,18 @@ def verify_optimization(
     # asserts per-goal stats, SURVEY.md section 4). The aggregate soft
     # scalar is blind to a low tier regressing while a high tier improves —
     # round-2's bench carried verified=true while PreferredLeaderElection
-    # went 0->364 — so every soft goal's count is checked individually.
-    # Slack: structural repair/evacuation legitimately shifts load between
-    # brokers, churning distribution counts by a few percent; the bound
-    # catches introduced debris (hundreds) without flagging that churn.
+    # went 0->364 — so every soft goal's count is checked individually,
+    # with slack derived from the goal's natural unit count
+    # (``soft_goal_slack``).
     # ``check_per_goal=False`` is for verifying PARTIAL pipelines (e.g. the
     # annealer alone, whose low-tier debris the final leadership pass
     # cleans); the full optimize() result is always held to the strict bar.
+    hard_feasible_start = float(s0.hard_violations) == 0
     for n in s1.names if check_per_goal else ():
         if GOAL_REGISTRY[n].hard:
             continue
         vb_, va_ = v0[n][0], v1[n][0]
-        if va_ > vb_ + max(8.0, 0.05 * vb_):
+        if va_ > vb_ + soft_goal_slack(n, after, cfg, vb_, hard_feasible_start):
             failures.append(
                 f"soft goal {n}: violations regressed {vb_:.0f} -> {va_:.0f}"
             )

@@ -121,3 +121,110 @@ def test_repair_scales_to_b5_style_violations():
     after = stack_v(fixed)
     assert after["RackAwareGoal"] == 0, before["RackAwareGoal"]
     assert after["StructuralFeasibility"] == 0
+
+
+def test_canonicalize_preferred_leaders_zeroes_ple_exactly():
+    """Reordering replica rows so the chosen leader is slot-0 must zero PLE
+    and leave EVERY other goal's (violations, cost) bit-identical — the pass
+    relabels slot positions, never roles (repair.canonicalize_preferred_leaders)."""
+    from ccx.search.repair import canonicalize_preferred_leaders
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=8, n_racks=4, n_topics=4, n_partitions=64, seed=9
+    ))
+    # scramble leadership off the preferred slot for half the partitions
+    lead = np.asarray(m.leader_slot).copy()
+    a = np.asarray(m.assignment)
+    for p in range(0, 64, 2):
+        if a[p, 1] >= 0:
+            lead[p] = 1
+    m = m.replace(leader_slot=np.asarray(lead, np.int32))
+    before = evaluate_stack(m, GoalConfig(), DEFAULT_GOAL_ORDER).by_name()
+    assert before["PreferredLeaderElectionGoal"][0] > 0
+
+    fixed, n = canonicalize_preferred_leaders(m)
+    assert n == before["PreferredLeaderElectionGoal"][0]
+    after = evaluate_stack(fixed, GoalConfig(), DEFAULT_GOAL_ORDER).by_name()
+    assert after["PreferredLeaderElectionGoal"][0] == 0
+    for g, (v0, c0) in before.items():
+        if g == "PreferredLeaderElectionGoal":
+            continue
+        v1, c1 = after[g]
+        assert v0 == v1, (g, v0, v1)
+        np.testing.assert_allclose(c0, c1, rtol=1e-6, err_msg=g)
+    # leader BROKER unchanged everywhere; rows are permutations
+    a0, a1 = np.asarray(m.assignment), np.asarray(fixed.assignment)
+    l0, l1 = np.asarray(m.leader_slot), np.asarray(fixed.leader_slot)
+    rows = np.arange(64)
+    np.testing.assert_array_equal(a0[rows, l0[:64]], a1[rows, l1[:64]])
+    np.testing.assert_array_equal(np.sort(a0, axis=1), np.sort(a1, axis=1))
+
+
+def test_canonicalize_skips_immovable_and_ineligible():
+    from ccx.search.repair import canonicalize_preferred_leaders
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=6, n_racks=3, n_topics=3, n_partitions=32, seed=10
+    ))
+    lead = np.asarray(m.leader_slot).copy()
+    a = np.asarray(m.assignment)
+    movable = [p for p in range(32) if a[p, 1] >= 0]
+    for p in movable:
+        lead[p] = 1
+    imm = np.zeros(m.P, bool)
+    imm[movable[0]] = True
+    # slot-0 broker of movable[1] is dead -> ineligible, not a violation
+    alive = np.asarray(m.broker_alive).copy()
+    alive[a[movable[1], 0]] = False
+    m = m.replace(
+        leader_slot=np.asarray(lead, np.int32),
+        partition_immovable=np.asarray(imm),
+        broker_alive=np.asarray(alive),
+    )
+    fixed, n = canonicalize_preferred_leaders(m)
+    a1 = np.asarray(fixed.assignment)
+    l1 = np.asarray(fixed.leader_slot)
+    # immovable row untouched
+    np.testing.assert_array_equal(a1[movable[0]], a[movable[0]])
+    assert l1[movable[0]] == 1
+    # ineligible (dead slot-0) row untouched
+    np.testing.assert_array_equal(a1[movable[1]], a[movable[1]])
+    after = evaluate_stack(fixed, GoalConfig(), DEFAULT_GOAL_ORDER).by_name()
+    # the immovable row's violation is the ONLY one the pass may leave —
+    # input-carried, never introduced (ineligible rows don't count at all)
+    assert after["PreferredLeaderElectionGoal"][0] == 1
+
+
+def test_bounded_sweeps_still_evacuate_with_capacity_oscillation():
+    """With the per-sweep offender bound far below the structural offender
+    count AND every destination broker over effective capacity (so the
+    over-capacity broker count can never decrease), the capacity-oscillation
+    break must not fire until dead-broker evacuation is complete
+    (ADVICE round-3 medium: repair.py oscillation break vs structural
+    offenders)."""
+    B, P, R = 10, 120, 2
+    rng = np.random.default_rng(7)
+    # all replicas on brokers 0..3; brokers 0-1 die -> ~P structural offenders
+    assignment = np.array(
+        [rng.choice(4, size=R, replace=False) for _ in range(P)], np.int32
+    )
+    alive = np.ones(B, bool)
+    alive[[0, 1]] = False
+    # tiny capacities: every alive broker runs over effective capacity once
+    # it hosts anything, so capacity shedding can only oscillate
+    m = build_model(
+        assignment=assignment,
+        leader_load=np.ones((NUM_RESOURCES, P), np.float32),
+        follower_load=np.ones((NUM_RESOURCES, P), np.float32) * 0.5,
+        broker_capacity=np.full((NUM_RESOURCES, B), 3.0, np.float32),
+        broker_rack=np.arange(B, dtype=np.int32) % 5,
+        broker_alive=alive,
+    )
+    fixed, n = hard_repair(
+        m, GoalConfig(), DEFAULT_GOAL_ORDER, max_sweeps=40, nk=8
+    )
+    a = np.asarray(fixed.assignment)[np.asarray(fixed.partition_valid)]
+    hosted = a[a >= 0]
+    alive_after = np.asarray(fixed.broker_alive & fixed.broker_valid)
+    assert alive_after[hosted].all(), "dead-broker replicas left behind"
+    assert stack_v(fixed)["StructuralFeasibility"] == 0

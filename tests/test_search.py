@@ -291,3 +291,87 @@ def test_optimize_end_to_end(small_model):
     j = res.to_json()
     assert j["numReplicaMovements"] > 0
     assert all("goal" in g for g in j["goalSummary"])
+
+
+def test_batched_step_rejects_mispredicted_composition():
+    """Composed-batch lex fallback (round-3 ADVICE #1): when the EXACT
+    recomputed composition of a batch is worse than every member's
+    (here: deliberately lying) per-candidate prediction, the whole batch
+    must be rejected by the composed lex_accept guard — soft tiers can
+    never silently net-regress past the acceptance rule.
+
+    A scorer that claims every candidate reaches cost-vector 0 makes every
+    feasible draw individually acceptable; the deterministic guard compares
+    the exact composed vector against the step base and the member-sanctioned
+    prediction, so after every step the state must still be lexicographically
+    no worse than where that step started (at T ~ 0)."""
+    import jax.numpy as jnp
+    from ccx.goals.base import GOAL_REGISTRY
+    from ccx.goals.stack import soft_weights
+    from ccx.search.annealer import _anneal_step_batched
+    from ccx.search.state import (
+        init_search_state as init_ss,
+        make_cost_vector_fn,
+        make_move_scorer,
+        make_swap_scorer,
+        make_topic_group,
+        max_partitions_per_topic,
+        stack_needs_topic,
+    )
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=16, n_racks=4, n_topics=4, n_partitions=64, seed=2
+    ))
+    names = DEFAULT_GOAL_ORDER
+    group = (
+        make_topic_group(m, max_partitions_per_topic(m))
+        if stack_needs_topic(names) else None
+    )
+    state = init_ss(m, CFG, names, jax.random.PRNGKey(0), group=group)
+    hard_mask = tuple(GOAL_REGISTRY[n].hard for n in names)
+    hard_arr = jnp.asarray(hard_mask)
+    weights = soft_weights(hard_mask)
+    pp = ProposalParams(p_real=64, b_real=16, p_swap=0.3)
+    real_swap = make_swap_scorer(m, names, CFG)
+
+    def lying_swap(ss, v1, o1, n1, v2, o2, n2):
+        d = real_swap(ss, v1, o1, n1, v2, o2, n2)
+        return d.replace(cost_vec=jnp.zeros_like(d.cost_vec))
+
+    def lex_le(a, b, tol=1e-4):
+        for x, y in zip(a, b):
+            if x < y - tol:
+                return True
+            if x > y + tol:
+                return False
+        return True
+
+    scorer = make_move_scorer(m, names, CFG)
+    vector_fn = make_cost_vector_fn(m, names, CFG)
+    n_rejected = 0
+    for step in range(6):
+        base_vec = tuple(float(x) for x in np.asarray(state.cost_vec))
+        out = _anneal_step_batched(
+            state, jnp.asarray(1e-9), jnp.asarray(step, jnp.int32),
+            jnp.zeros(1, jnp.int32), jnp.asarray(0, jnp.int32),
+            m=m, pp=pp, hard_arr=hard_arr, weights=weights,
+            moves_per_step=8, scorer=scorer, swap_scorer=lying_swap,
+            vector_fn=vector_fn, group=group,
+        )
+        same = np.array_equal(
+            np.asarray(out.assignment), np.asarray(state.assignment)
+        ) and np.array_equal(
+            np.asarray(out.leader_slot), np.asarray(state.leader_slot)
+        )
+        if same:
+            n_rejected += 1
+        # EXACT re-eval of the step's resulting placement: never lex-worse
+        # than the step's base (the lying predictions must not leak through)
+        from ccx.search.state import with_placement
+        s_exact = evaluate_stack(with_placement(m, out), CFG, names)
+        exact_vec = tuple(float(x) for x in np.asarray(s_exact.costs))
+        assert lex_le(exact_vec, base_vec), (step, exact_vec, base_vec)
+        state = out
+    # the guard must have actually fired at least once for this seed —
+    # random candidates scored as "perfect" otherwise always apply
+    assert n_rejected > 0
