@@ -103,15 +103,21 @@ def _on_signal(signum, frame):
     os.kill(os.getpid(), signum)
 
 
-#: rung name -> (chains, steps, polish_iters); moves_per_step is shared.
+#: rung name -> (chains, steps, moves_per_step, polish_iters).
+#: moves_per_step picked from the round-4 probe (docs/perf-notes.md): on CPU
+#: the batched step's per-proposal cost plateaus at ~1.7 ms from 8 moves up
+#: (vs 2.5 ms sequential), so more moves buys latency, not efficiency —
+#: lean stays at 8 (round-2-comparable wall-clock), full takes 16 for 2x
+#: churn at equal per-proposal cost. Round 3's silent 8 -> 32 lean change
+#: (3.5x wall-clock for ~1.1x efficiency) is reverted by measurement.
 #: "custom" is the collapsed single rung used when CCX_BENCH_CHAINS/STEPS/
 #: POLISH_ITERS are ALL overridden — running lean+full then would execute
 #: the identical workload twice (round-3 ADVICE, bench.py effort ladder).
 RUNGS = {
-    "smoke": (8, 100, 10),
-    "lean": (16, 1500, 200),
-    "full": (32, 3000, 400),
-    "custom": (32, 3000, 400),
+    "smoke": (8, 100, 1, 10),
+    "lean": (16, 1500, 8, 200),
+    "full": (32, 3000, 16, 400),
+    "custom": (32, 3000, 16, 400),
 }
 
 
@@ -137,15 +143,15 @@ def run_config(name: str, rung: str) -> dict:
         if name == "B1"
         else DEFAULT_GOAL_ORDER
     )
-    d_chains, d_steps, d_polish = RUNGS[rung]
+    d_chains, d_steps, d_moves, d_polish = RUNGS[rung]
     if smoke:
-        n_chains, n_steps, moves, polish_iters = d_chains, d_steps, 1, d_polish
+        n_chains, n_steps, moves, polish_iters = d_chains, d_steps, d_moves, d_polish
     else:
         n_chains = int(os.environ.get("CCX_BENCH_CHAINS", d_chains))
         n_steps = int(os.environ.get("CCX_BENCH_STEPS", d_steps))
-        # proposals per chain-step: churn must scale with partition count;
-        # they are applied as a disjoint batch (AnnealOptions.batched)
-        moves = int(os.environ.get("CCX_BENCH_MOVES", "32"))
+        # proposals per chain-step, applied as a disjoint batch
+        # (AnnealOptions.batched); per-rung defaults measured, see RUNGS
+        moves = int(os.environ.get("CCX_BENCH_MOVES", d_moves))
         polish_iters = int(os.environ.get("CCX_BENCH_POLISH_ITERS", d_polish))
     opts = OptimizeOptions(
         anneal=AnnealOptions(
