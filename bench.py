@@ -113,11 +113,15 @@ def _on_signal(signum, frame):
 #: "custom" is the collapsed single rung used when CCX_BENCH_CHAINS/STEPS/
 #: POLISH_ITERS are ALL overridden — running lean+full then would execute
 #: the identical workload twice (round-3 ADVICE, bench.py effort ladder).
+#: full/custom polish 1600: measured at B5, polish iterations are the
+#: cheapest quality lever by far (~70 ms/iter; +1200 iters cut
+#: DiskUsage violations 387 -> 28 and ReplicaDistribution 252 -> 21 for
+#: ~60 s) — the 400-iter budget was starving count convergence.
 RUNGS = {
     "smoke": (8, 100, 1, 10),
     "lean": (16, 1500, 8, 200),
-    "full": (32, 3000, 16, 400),
-    "custom": (32, 3000, 16, 400),
+    "full": (32, 3000, 16, 1600),
+    "custom": (32, 3000, 16, 1600),
 }
 
 
@@ -292,10 +296,13 @@ def main() -> None:
     rungs = ["lean", "full"]
     if all(
         os.environ.get(k)
-        for k in ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_POLISH_ITERS")
+        for k in ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
+                  "CCX_BENCH_POLISH_ITERS")
     ):
-        # full effort override: lean and full would run the identical
-        # workload twice — collapse the ladder to one honestly-labeled rung
+        # every effort knob overridden: lean and full would run the
+        # identical workload twice — collapse to one honestly-labeled rung.
+        # (All FOUR knobs must be set: moves has per-rung defaults, so a
+        # partial override still leaves two distinct workloads.)
         rungs = ["custom"]
     if backend_forced and os.environ.get("CCX_BENCH_FULL") != "1":
         rungs = rungs[:1]

@@ -19,6 +19,10 @@ Minutes-scale on the CPU backend -> marked ``nightly`` (excluded from
 default runs; `pytest -m nightly` executes it).
 """
 
+import json
+import pathlib
+import time
+
 import numpy as np
 import pytest
 
@@ -33,6 +37,11 @@ pytestmark = [pytest.mark.nightly, pytest.mark.slow]
 
 CFG = GoalConfig()
 
+#: every nightly run banks its per-goal table here (committed artifact —
+#: VERDICT r3 "Next round" #4: a test that encodes the done-bar but never
+#: records a run is documentation, not evidence)
+ARTIFACT = pathlib.Path(__file__).resolve().parent.parent / "PARITY_B5.json"
+
 
 def _lex_leq(a, b, tol=1e-4):
     for x, y in zip(np.asarray(a), np.asarray(b)):
@@ -45,22 +54,51 @@ def _lex_leq(a, b, tol=1e-4):
 
 def test_b5_pipeline_matches_or_beats_oracle_full_effort():
     m = random_cluster(bench_spec("B5"))
-    polish = GreedyOptions(n_candidates=256, max_iters=400, patience=8)
+    # bench full-rung budgets (bench.py RUNGS): 16 moves/step measured
+    # equal-efficiency to 32 at half the step cost; polish 1600 because
+    # counts converge through the polish (~70 ms/iter at B5; 400 iters left
+    # DiskUsage at a 45% cut, +1200 more took it to 96% —
+    # docs/perf-notes.md round 4)
+    polish = GreedyOptions(n_candidates=256, max_iters=1600, patience=16)
+    sa = AnnealOptions(n_chains=32, n_steps=3000, moves_per_step=16, seed=42)
     res = optimize(
         m,
         CFG,
         DEFAULT_GOAL_ORDER,
-        OptimizeOptions(
-            anneal=AnnealOptions(
-                n_chains=32, n_steps=3000, moves_per_step=32, seed=42
-            ),
-            polish=polish,
-        ),
+        OptimizeOptions(anneal=sa, polish=polish),
     )
     oracle = greedy_optimize(m, CFG, DEFAULT_GOAL_ORDER, polish)
 
     before = res.stack_before.by_name()
     after = res.stack_after.by_name()
+    oracle_after = oracle.stack_after.by_name()
+
+    # bank the artifact BEFORE asserting — a failing run must still record
+    # its table (it becomes the work-list)
+    ARTIFACT.write_text(json.dumps({
+        "config": "B5 (1000 brokers / 100k partitions), full default stack",
+        # derived from the options actually run, never hand-copied
+        "effort": {"chains": sa.n_chains, "steps": sa.n_steps,
+                   "moves": sa.moves_per_step,
+                   "polish_iters": polish.max_iters},
+        "backend": "cpu",
+        "unix_time": int(time.time()),
+        "wall_seconds": round(res.wall_seconds, 1),
+        "verified": bool(res.verification.ok),
+        "verification_failures": list(res.verification.failures),
+        "goals": {
+            n: {
+                "violations": [float(before[n][0]), float(after[n][0])],
+                "oracle_violations": float(oracle_after[n][0]),
+                "cost": [
+                    round(float(before[n][1]), 4),
+                    round(float(after[n][1]), 4),
+                ],
+                "oracle_cost": round(float(oracle_after[n][1]), 4),
+            }
+            for n in res.stack_after.names
+        },
+    }, indent=1))
 
     # pipeline >= oracle lexicographically (portfolio guarantees it; this
     # asserts the guarantee holds at B5 scale, full effort)
