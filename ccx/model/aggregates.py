@@ -30,6 +30,19 @@ class BrokerAggregates:
 
 
 def broker_aggregates(m: TensorClusterModel) -> BrokerAggregates:
+    # On TPU the segment-sum scatter-adds below serialize; the Pallas
+    # kernel reformulates them as tiled one-hot MXU matmuls
+    # (ccx/ops/mxu_aggregates.py). Takes effect only on the TPU backend
+    # AND with CCX_MXU_AGGREGATES=1 set before process start (opt-in until
+    # first validated on live hardware — see mxu_aggregates_enabled).
+    from ccx.ops.mxu_aggregates import broker_aggregates_mxu, mxu_aggregates_enabled
+
+    if mxu_aggregates_enabled():
+        return broker_aggregates_mxu(m)
+    return _broker_aggregates_xla(m)
+
+
+def _broker_aggregates_xla(m: TensorClusterModel) -> BrokerAggregates:
     B, T, D = m.B, m.num_topics, m.D
     valid = m.replica_valid                      # [P, R]
     is_leader = m.is_leader                      # [P, R]

@@ -1,0 +1,11 @@
+"""TPU kernels (Pallas) for the framework's hot ops.
+
+Each kernel ships with a pure-XLA twin in its caller's module; dispatch
+requires the TPU backend plus a per-kernel opt-in env flag until the kernel
+has run on live hardware once (see each kernel's ``*_enabled``).
+Correctness is pinned by interpret-mode tests that run on CPU.
+"""
+
+from ccx.ops.mxu_aggregates import broker_aggregates_mxu, mxu_aggregates_enabled
+
+__all__ = ["broker_aggregates_mxu", "mxu_aggregates_enabled"]

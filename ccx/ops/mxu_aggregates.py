@@ -1,0 +1,226 @@
+"""Pallas TPU kernel: broker aggregates as tiled one-hot MXU matmuls.
+
+``ccx.model.aggregates.broker_aggregates`` is the framework's hottest O(P*R)
+full pass (stack evaluation, search-state init, every repair sweep). Its XLA
+form is a family of ``segment_sum`` scatter-adds — correct everywhere, but
+on TPU a scatter-add serializes: the MXU sits idle while rows trickle
+through the permutation unit. The TPU-native formulation is a *matmul
+against a one-hot segment matrix*:
+
+    out[F, B]  = feat[F, N]    @ onehot_b[N, B]      (per-broker features)
+    out[T, B]  = onehot_t[T,N] @ (onehot_b * w)      (topic x broker counts)
+    out[B, D]  = onehot_b[B,N] @ (onehot_d * w)      (broker x disk loads)
+
+all of which run on the 128x128 systolic array. This kernel tiles the
+flattened (partition x slot) axis N, materializes the one-hot blocks in
+VMEM on the fly (they never touch HBM), and accumulates every output across
+the sequential TPU grid in one pass over the inputs.
+
+VMEM budget at B5 scale (B=1024, T=512, TILE=256, f32): the [T, B]
+accumulators are 2 MB each, onehot_b is 1 MB, onehot_t 0.5 MB — ~6 MB
+total, comfortably under the ~16 MB/core budget. Larger T*B products need a
+second grid axis over topic tiles; until a fixture needs it, one axis keeps
+the kernel simple.
+
+Dispatch: ``ccx.model.aggregates.broker_aggregates`` routes here only on
+the TPU backend with ``CCX_MXU_AGGREGATES=1`` set before process start
+(see ``mxu_aggregates_enabled`` for why it is opt-in). Interpret-mode
+tests (tests/test_ops_mxu.py) pin exact agreement with the XLA twin on
+CPU via the explicit ``interpret=True`` parameter.
+
+Reference parity: the aggregates themselves mirror
+``model/ClusterModelStats.java`` inputs (SURVEY.md C4); this module only
+changes how the sums are scheduled onto the hardware.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable installs; interpret mode needs nothing
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover - CPU-only wheels
+    pltpu = None
+    _VMEM = None
+
+from ccx.common.resources import NUM_RESOURCES, Resource
+from ccx.model.tensor_model import TensorClusterModel
+
+#: flattened (partition x slot) tile — the MXU contraction dim. 256 keeps
+#: the VMEM one-hots small while amortizing grid overhead; must stay a
+#: multiple of 8 (f32 sublane).
+TILE_N = 256
+
+
+#: resolved ONCE at import: broker_aggregates is jitted at import in several
+#: modules, so a mid-process flag flip would be silently ignored for
+#: already-traced shapes anyway — set the env before the process starts.
+_OPT_IN = os.environ.get("CCX_MXU_AGGREGATES") == "1"
+
+
+def mxu_aggregates_enabled() -> bool:
+    """True when broker_aggregates should take the Pallas path.
+
+    Requires BOTH the TPU backend and the ``CCX_MXU_AGGREGATES=1`` opt-in
+    (read once at import). Opt-in because the kernel has not yet executed
+    on real TPU hardware: the driver compile-checks the flagship entry
+    point on the live chip, and routing it through a never-hardware-run
+    kernel by default would put that check at risk; the backend gate keeps
+    a wedge-window CPU fallback from dragging the whole B5 bench through
+    the (orders-of-magnitude slower) Pallas interpreter. The kernel is
+    interpret-validated on CPU via the explicit ``interpret=True`` test
+    path (tests/test_ops_mxu.py). First healthy tunnel window: run
+    ``CCX_MXU_AGGREGATES=1 python bench.py`` to A/B against the XLA
+    segment-sum path, then flip the default to plain backend-gating.
+    """
+    return _OPT_IN and jax.default_backend() == "tpu"
+
+
+def _kernel(seg_ref, top_ref, dsk_ref, lead_ref, dw_ref, feat_ref,
+            out_feat, out_tr, out_tl, out_disk, *, B, T, D):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_feat[:] = jnp.zeros_like(out_feat)
+        out_tr[:] = jnp.zeros_like(out_tr)
+        out_tl[:] = jnp.zeros_like(out_tl)
+        out_disk[:] = jnp.zeros_like(out_disk)
+
+    seg = seg_ref[0, :]                                    # int32[TILE]
+    # one-hot over brokers: invalid slots carry seg == B and never match
+    # (the drop-bucket trick of the XLA twin, without the extra column)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, B), 1)
+    oh_b = (seg[:, None] == iota_b).astype(jnp.float32)    # [TILE, B]
+
+    # per-broker feature rows: [F, TILE] @ [TILE, B] on the MXU
+    out_feat[:] += jnp.dot(
+        feat_ref[:], oh_b, preferred_element_type=jnp.float32
+    )
+
+    # (topic x broker) counts: outer products accumulated as matmuls
+    iota_t = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, T), 1)
+    oh_t = (top_ref[0, :][:, None] == iota_t).astype(jnp.float32)
+    out_tr[:] += jnp.dot(
+        oh_t.T, oh_b, preferred_element_type=jnp.float32
+    )
+    lead = lead_ref[0, :].astype(jnp.float32)
+    out_tl[:] += jnp.dot(
+        (oh_t * lead[:, None]).T, oh_b, preferred_element_type=jnp.float32
+    )
+
+    # (broker x disk) load: [B, TILE] @ [TILE, D]
+    iota_d = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, D), 1)
+    oh_d = (dsk_ref[0, :][:, None] == iota_d).astype(jnp.float32)
+    out_disk[:] += jnp.dot(
+        oh_b.T, oh_d * dw_ref[0, :][:, None],
+        preferred_element_type=jnp.float32,
+    )
+
+
+def broker_aggregates_mxu(
+    m: TensorClusterModel, interpret: bool | None = None
+):
+    """BrokerAggregates via the one-hot-matmul kernel (see module docstring).
+
+    Bit-compatible with ``ccx.model.aggregates.broker_aggregates`` for the
+    integer counts; float sums agree up to reduction order (tile-major here,
+    segment-major there). ``interpret`` defaults to the Pallas interpreter
+    on non-TPU backends (the CPU test path; CCX_MXU_AGGREGATES=1 without a
+    TPU would otherwise fail to lower) and to compiled on TPU.
+    """
+    from ccx.model.aggregates import BrokerAggregates
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    B, T, D = m.B, m.num_topics, m.D
+    P, R = m.P, m.R
+    valid = m.replica_valid                                   # [P, R]
+    is_leader = m.is_leader                                   # [P, R]
+
+    seg = jnp.where(valid, m.assignment, B).reshape(-1)       # [N]
+    top = jnp.where(valid, m.partition_topic[:, None], T).reshape(-1)
+    lead = is_leader.reshape(-1)
+    disk_ok = valid & (m.replica_disk >= 0)
+    dsk = jnp.where(disk_ok, m.replica_disk, D).reshape(-1)
+    slot_load = m.replica_load                                # [RES, P, R]
+    disk_w = jnp.where(disk_ok, slot_load[Resource.DISK], 0.0).reshape(-1)
+
+    pot = jnp.where(valid, m.leader_load[Resource.NW_OUT][:, None], 0.0)
+    lbi = jnp.where(is_leader, m.leader_load[Resource.NW_IN][:, None], 0.0)
+    feat = jnp.concatenate(
+        [
+            slot_load.reshape(NUM_RESOURCES, -1),             # broker_load
+            valid.astype(jnp.float32).reshape(1, -1),         # replica_count
+            is_leader.astype(jnp.float32).reshape(1, -1),     # leader_count
+            pot.reshape(1, -1),                               # potential_nw_out
+            lbi.reshape(1, -1),                               # leader_bytes_in
+        ],
+        axis=0,
+    )                                                         # [F, N]
+    F = feat.shape[0]
+    # pad F to the f32 sublane multiple; pad N to the tile multiple with
+    # drop-bucket ids so padded slots match no one-hot column
+    Fp = -(-F // 8) * 8
+    feat = jnp.pad(feat, ((0, Fp - F), (0, 0)))
+    N = P * R
+    Np = -(-N // TILE_N) * TILE_N
+    pad = Np - N
+    seg = jnp.pad(seg, (0, pad), constant_values=B)
+    top = jnp.pad(top, (0, pad), constant_values=T)
+    dsk = jnp.pad(dsk, (0, pad), constant_values=D)
+    lead = jnp.pad(lead, (0, pad))
+    disk_w = jnp.pad(disk_w, (0, pad))
+    feat = jnp.pad(feat, ((0, 0), (0, pad)))
+
+    grid = (Np // TILE_N,)
+    row = lambda: pl.BlockSpec((1, TILE_N), lambda i: (0, i))  # noqa: E731
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))  # noqa: E731
+    import functools
+
+    out_feat, out_tr, out_tl, out_disk = pl.pallas_call(
+        functools.partial(_kernel, B=B, T=T, D=D),
+        grid=grid,
+        in_specs=[
+            row(),                                            # seg
+            row(),                                            # top
+            row(),                                            # dsk
+            row(),                                            # lead
+            row(),                                            # disk_w
+            pl.BlockSpec((Fp, TILE_N), lambda i: (0, i)),     # feat
+        ],
+        out_specs=[
+            full((Fp, B)),
+            full((T, B)),
+            full((T, B)),
+            full((B, D)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Fp, B), jnp.float32),
+            jax.ShapeDtypeStruct((T, B), jnp.float32),
+            jax.ShapeDtypeStruct((T, B), jnp.float32),
+            jax.ShapeDtypeStruct((B, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        seg.reshape(1, -1), top.reshape(1, -1), dsk.reshape(1, -1),
+        lead.reshape(1, -1).astype(jnp.int32), disk_w.reshape(1, -1), feat,
+    )
+
+    return BrokerAggregates(
+        broker_load=out_feat[:NUM_RESOURCES],
+        replica_count=out_feat[NUM_RESOURCES].astype(jnp.int32),
+        leader_count=out_feat[NUM_RESOURCES + 1].astype(jnp.int32),
+        potential_nw_out=out_feat[NUM_RESOURCES + 2],
+        leader_bytes_in=out_feat[NUM_RESOURCES + 3],
+        topic_replica_count=out_tr.astype(jnp.int32),
+        topic_leader_count=out_tl.astype(jnp.int32),
+        disk_load=out_disk,
+    )

@@ -23,6 +23,7 @@ import json
 import pathlib
 import time
 
+import jax
 import numpy as np
 import pytest
 
@@ -77,11 +78,12 @@ def test_b5_pipeline_matches_or_beats_oracle_full_effort():
     # its table (it becomes the work-list)
     ARTIFACT.write_text(json.dumps({
         "config": "B5 (1000 brokers / 100k partitions), full default stack",
-        # derived from the options actually run, never hand-copied
+        # derived from the options/backend actually run, never hand-copied
         "effort": {"chains": sa.n_chains, "steps": sa.n_steps,
                    "moves": sa.moves_per_step,
-                   "polish_iters": polish.max_iters},
-        "backend": "cpu",
+                   "polish_iters": polish.max_iters,
+                   "polish_patience": polish.patience},
+        "backend": jax.default_backend(),
         "unix_time": int(time.time()),
         "wall_seconds": round(res.wall_seconds, 1),
         "verified": bool(res.verification.ok),
