@@ -30,7 +30,12 @@ from ccx.proposals import ExecutionProposal, diff
 from ccx.goals.stack import evaluate_stack
 from ccx.search.annealer import AnnealOptions, anneal
 from ccx.search.greedy import GreedyOptions, greedy_optimize
-from ccx.search.repair import finalize_preferred_leaders, hard_repair
+from ccx.search.annealer import allows_inter_broker
+from ccx.search.repair import (
+    finalize_preferred_leaders,
+    hard_repair,
+    topic_rebalance,
+)
 from ccx.verify import Verification, verify_optimization
 
 
@@ -141,6 +146,17 @@ class OptimizeOptions:
     #: pipeline never ends with fixable preferred-leader / leader-balance
     #: debris. Skipped automatically for intra-broker (disk-only) stacks.
     run_leader_pass: bool = True
+    #: sweep+polish rounds for the targeted TopicReplicaDistribution stage
+    #: (repair.topic_rebalance): each round enumerates over-band
+    #: (topic, broker) cells directly, re-polishes, and is adopted only on
+    #: full-vector lex improvement. Iterating ratchets: the re-polish may
+    #: trade some of the sweep's TRD cut back for higher-tier (usage)
+    #: gains — legitimate under goal priority — but each cycle leaves the
+    #: higher tiers closer to their floor, so the next sweep's cut sticks
+    #: better. 0 disables. Cost per round: one topic_rebalance call (which
+    #: itself sweeps to convergence, up to its max_sweeps=16 at ~3 s/sweep
+    #: at B5 — typically a handful) + one polish run.
+    topic_rebalance_rounds: int = 2
     #: optional iteration cap for the final leadership-only pass (None =
     #: inherit polish.max_iters). Measured at B5 full effort: leadership-only
     #: iterations are CHEAP (~11 ms vs ~70 ms placement polish) and the pass
@@ -260,8 +276,31 @@ def optimize(
                 # abandoned SA path's
                 n_polish = cold.n_moves
         phases["portfolio"] = time.monotonic() - t
-    from ccx.search.annealer import allows_inter_broker
-
+    if (
+        opts.topic_rebalance_rounds > 0
+        and "TopicReplicaDistributionGoal" in goal_names
+        and allows_inter_broker(goal_names)
+    ):
+        # targeted TopicReplicaDistribution stage: enumerate over-band
+        # (topic, broker) cells directly (random proposals almost never
+        # align topic and destination — repair.topic_rebalance docstring),
+        # re-polish, and adopt only on full-vector lexicographic
+        # improvement — a soft-goal sweep must never cost a higher tier.
+        # Runs AFTER the portfolio selection so it applies to whichever
+        # candidate won (a cold-greedy winner needs the stage most).
+        t = _enter("topic-rebalance")
+        with annotate("ccx:topic-rebalance"):
+            for _ in range(opts.topic_rebalance_rounds):
+                swept, n_swept = topic_rebalance(model, cfg)
+                if not n_swept:
+                    break
+                cand = greedy_optimize(swept, cfg, goal_names, opts.polish)
+                if not _lex_better(cand.stack_after, stack_after):
+                    break
+                model = cand.model
+                stack_after = cand.stack_after
+                n_polish += n_swept + cand.n_moves
+        phases["topic-rebalance"] = time.monotonic() - t
     leadership_scored = LEADERSHIP_GOALS & set(goal_names)
     if (
         opts.run_leader_pass

@@ -310,6 +310,205 @@ def canonicalize_preferred_leaders(
     return out, int(idx.size)
 
 
+def topic_rebalance(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    max_sweeps: int = 16,
+    rounds_per_sweep: int = 16,
+    seed: int = 23,
+) -> tuple[TensorClusterModel, int]:
+    """Targeted TopicReplicaDistribution sweep: shed (topic, broker) cells
+    above their per-topic band by relocating follower replicas to brokers
+    with topic room, never violating any hard constraint.
+
+    Motivation (ref TopicReplicaDistributionGoal, SURVEY.md C17): TRD
+    violations are (topic, broker) cells outside the per-topic band — at B5
+    scale ~45k cells, by far the largest count in the stack. Random search
+    proposals almost never align a drawn partition's topic with a
+    topic-underloaded destination, so SA + polish barely move the count
+    (round-4 parity: 45.8k -> 44.8k at full effort). This pass enumerates
+    the offending cells directly — the same design as ``hard_repair``'s
+    sweeps, but for a soft goal, so it must be adopted lex-guarded (the
+    optimizer polishes the swept placement and keeps it only if the full
+    cost vector improves; see optimize()).
+
+    Per sweep: recompute (topic, broker) counts, per-topic band uppers,
+    role-resolved broker loads and replica counts; pick one follower
+    replica per over cell (one per partition); route each to its topic's
+    best destination — topic room, rack-distinct, not already hosting,
+    alive+receiving, strictly under effective capacity on EVERY resource,
+    under the replica-count band and ReplicaCapacity cap, utilization
+    < 0.9 (keeps the usage tiers from absorbing the shed load). One move
+    per destination per round makes the capacity/count checks exact.
+
+    Leadership never moves (followers only) and leader loads never shift,
+    so the leader tiers and PLE are bit-unchanged. Host-side numpy like
+    ``canonicalize_preferred_leaders`` (one [P, R] transfer; ~3 s at B5).
+    Returns (model, moves applied).
+    """
+    a = np.asarray(m.assignment).copy()
+    dsk = np.asarray(m.replica_disk).copy()
+    pvalid = np.asarray(m.partition_valid)
+    topic = np.asarray(m.partition_topic)
+    alive = np.asarray(m.broker_alive & m.broker_valid)
+    recv_ok = alive & ~np.asarray(m.broker_excl_replicas)
+    imm = np.asarray(m.partition_immovable)
+    rack = np.asarray(m.broker_rack)
+    lslot = np.asarray(m.leader_slot)
+    T, B, P, R = m.num_topics, m.B, m.P, m.R
+    from ccx.common.resources import NUM_RESOURCES, Resource
+
+    thr = cfg.topic_replica_balance_threshold
+    capthr = np.asarray(cfg.capacity_threshold)
+    cap_eff = np.asarray(m.broker_capacity) * capthr[:, None]    # [RES, B]
+    cap_eff = np.where(cap_eff > 0, cap_eff, np.inf)
+    lead_load = np.asarray(m.leader_load)                        # [RES, P]
+    foll_load = np.asarray(m.follower_load)
+    rng = np.random.default_rng(seed)
+    total_moved = 0
+
+    is_l = np.zeros((P, R), bool)
+    is_l[np.arange(P), np.clip(lslot, 0, R - 1)] = True
+    # sweep-invariant: leadership never moves, so role-resolved slot loads
+    # and the topic matrix are fixed for the whole call ([RES, P, R] is
+    # tens of MB at B5 — build once)
+    tmat = np.repeat(topic, R).reshape(P, R)
+    slot_load = np.where(
+        is_l[None], lead_load[:, :, None], foll_load[:, :, None]
+    )                                                            # [RES, P, R]
+    D = m.D
+    disk_alive = np.asarray(m.disk_alive)                        # [B, D]
+
+    for _ in range(max_sweeps):
+        valid = (a >= 0) & pvalid[:, None]
+        counts = np.zeros((T, B), np.int64)
+        np.add.at(counts, (tmat[valid], a[valid]), 1)
+        counts[:, ~alive] = 0
+        tot = counts.sum(1).astype(np.float64)
+        avg = tot / max(int(alive.sum()), 1)
+        upper = np.ceil(avg * thr)
+
+        bload = np.zeros((NUM_RESOURCES, B))
+        for res in range(NUM_RESOURCES):
+            np.add.at(bload[res], a[valid], slot_load[res][valid])
+        # per-disk DISK load for JBOD-safe placement of moved replicas
+        dload = np.zeros((B, D))
+        dvalid = valid & (dsk >= 0)
+        np.add.at(
+            dload,
+            (a[dvalid], np.clip(dsk, 0, D - 1)[dvalid]),
+            slot_load[int(Resource.DISK)][dvalid],
+        )
+        util = np.max(bload / cap_eff, axis=0)
+        rc = np.bincount(a[valid], minlength=B).astype(np.int64)
+        rc_avg = rc[alive].sum() / max(int(alive.sum()), 1)
+        rc_cap = min(
+            int(np.floor(rc_avg * cfg.replica_balance_threshold)),
+            int(cfg.max_replicas_per_broker),
+        )
+
+        over = counts > upper[:, None]
+        cand = (
+            valid
+            & over[tmat, np.clip(a, 0, B - 1)]
+            & ~imm[:, None]
+            & ~is_l                                  # followers only
+        )
+        ps, rs = np.nonzero(cand)
+        if ps.size == 0:
+            break
+        # one candidate per partition AND per (topic, src broker) cell
+        order = rng.permutation(ps.size)
+        ps, rs = ps[order], rs[order]
+        _, fp = np.unique(ps, return_index=True)
+        ps, rs = ps[fp], rs[fp]
+        cell = topic[ps].astype(np.int64) * B + a[ps, rs]
+        _, fc = np.unique(cell, return_index=True)
+        ps, rs = ps[fc], rs[fc]
+        ts = topic[ps]
+
+        room = np.where(
+            recv_ok[None, :], np.maximum(upper[:, None] - counts, 0), 0
+        )
+        dest_ok_b = (
+            (rc[None, :] < rc_cap)
+            & (util[None, :] < 0.9)
+            & (room > 0)
+            & disk_alive.any(axis=1)[None, :]   # needs a live disk to land on
+        )
+        dest_score = np.where(
+            dest_ok_b, room + (0.9 - util[None, :]), -np.inf
+        )
+        # top destinations per topic; per-round dedupe keeps checks exact
+        # (width is min(B, rounds) — small clusters have fewer brokers than
+        # rounds, so the round loop runs over the actual width)
+        top_dest = np.argsort(-dest_score, axis=1)[:, :rounds_per_sweep]
+        intake = np.zeros((T, B), np.int64)
+        rc_now = rc.copy()
+        moved = 0
+        for k in range(top_dest.shape[1]):
+            if ps.size == 0:
+                break
+            dest = top_dest[ts, k]
+            ok = np.isfinite(dest_score[ts, dest])
+            ok &= (room[ts, dest] - intake[ts, dest]) > 0
+            ok &= rc_now[dest] < rc_cap
+            ok &= ~(a[ps] == dest[:, None]).any(axis=1)
+            rrows = np.where(a[ps] >= 0, rack[np.clip(a[ps], 0, B - 1)], -1)
+            rrows[np.arange(ps.size), rs] = -1
+            ok &= ~(rrows == rack[dest][:, None]).any(axis=1)
+            ok &= np.all(
+                bload[:, dest] + foll_load[:, ps] <= cap_eff[:, dest], axis=0
+            )
+            if ok.any():
+                # strictly one accepted move per destination this round —
+                # the capacity / count checks above are then exact
+                oi = np.nonzero(ok)[0]
+                _, fdest = np.unique(dest[oi], return_index=True)
+                oi = oi[fdest]
+                ai, ri, di = ps[oi], rs[oi], dest[oi]
+                src = a[ai, ri]
+                old_d = dsk[ai, ri]
+                for res in range(NUM_RESOURCES):
+                    np.subtract.at(bload[res], src, foll_load[res, ai])
+                    np.add.at(bload[res], di, foll_load[res, ai])
+                a[ai, ri] = di
+                # JBOD-safe disk choice: the destination's least-loaded
+                # ALIVE disk (same policy as _sweep); one move per dest per
+                # round keeps dload per-move exact
+                np.subtract.at(
+                    dload,
+                    (src, np.clip(old_d, 0, D - 1)),
+                    np.where(old_d >= 0, foll_load[int(Resource.DISK), ai], 0.0),
+                )
+                dchoice = np.where(disk_alive[di], dload[di], np.inf)
+                best_d = np.argmin(dchoice, axis=1).astype(dsk.dtype)
+                dsk[ai, ri] = best_d
+                np.add.at(
+                    dload, (di, best_d), foll_load[int(Resource.DISK), ai]
+                )
+                np.add.at(intake, (ts[oi], di), 1)
+                np.subtract.at(rc_now, src, 1)
+                np.add.at(rc_now, di, 1)
+                moved += oi.size
+                keep = np.ones(ps.size, bool)
+                keep[oi] = False
+                ps, rs, ts = ps[keep], rs[keep], ts[keep]
+            # candidates that found no destination this round retry the
+            # next-ranked destination in the following round
+        total_moved += moved
+        if moved == 0:
+            break
+
+    if total_moved == 0:
+        return m, 0
+    out = m.replace(
+        assignment=jnp.asarray(a, dtype=m.assignment.dtype),
+        replica_disk=jnp.asarray(dsk, dtype=m.replica_disk.dtype),
+    )
+    return out, total_moved
+
+
 def finalize_preferred_leaders(
     model: TensorClusterModel,
     cfg: GoalConfig,

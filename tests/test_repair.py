@@ -228,3 +228,66 @@ def test_bounded_sweeps_still_evacuate_with_capacity_oscillation():
     alive_after = np.asarray(fixed.broker_alive & fixed.broker_valid)
     assert alive_after[hosted].all(), "dead-broker replicas left behind"
     assert stack_v(fixed)["StructuralFeasibility"] == 0
+
+
+def test_topic_rebalance_cuts_trd_without_hard_damage():
+    """Targeted TopicReplicaDistribution sweep (repair.topic_rebalance):
+    must cut over-band (topic, broker) cells substantially while never
+    introducing a hard violation, never moving leadership, and preserving
+    replication factors."""
+    from ccx.search.repair import topic_rebalance
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=32, n_racks=4, n_topics=8, n_partitions=512, seed=19
+    ))
+    s0 = evaluate_stack(m, GoalConfig(), DEFAULT_GOAL_ORDER).by_name()
+    m2, n = topic_rebalance(m, GoalConfig())
+    assert n > 0
+    s1 = evaluate_stack(m2, GoalConfig(), DEFAULT_GOAL_ORDER).by_name()
+    trd0 = s0["TopicReplicaDistributionGoal"][0]
+    trd1 = s1["TopicReplicaDistributionGoal"][0]
+    assert trd1 <= 0.7 * trd0, (trd0, trd1)
+    for g in ("StructuralFeasibility", "RackAwareGoal", "DiskCapacityGoal",
+              "CpuCapacityGoal", "ReplicaCapacityGoal",
+              "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+              "MinTopicLeadersPerBrokerGoal"):
+        assert s1[g][0] <= s0[g][0], (g, s0[g][0], s1[g][0])
+    np.testing.assert_array_equal(
+        np.asarray(m.leader_slot), np.asarray(m2.leader_slot)
+    )
+    a0, a1 = np.asarray(m.assignment), np.asarray(m2.assignment)
+    np.testing.assert_array_equal((a0 >= 0).sum(1), (a1 >= 0).sum(1))
+    # leader BROKER also unchanged (followers-only moves)
+    rows = np.arange(m.P)
+    l = np.asarray(m.leader_slot)
+    np.testing.assert_array_equal(a0[rows, l], a1[rows, l])
+
+
+def test_topic_rebalance_jbod_lands_on_alive_disks():
+    """On multi-disk clusters the sweep must place moved replicas on an
+    ALIVE disk of the destination (least-loaded, _sweep's policy) — never
+    the dead disk-0 of an otherwise eligible broker."""
+    from ccx.search.repair import topic_rebalance
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=16, n_racks=4, n_topics=4, n_partitions=256, seed=21,
+        n_disks=3,
+    ))
+    # kill disk 0 on half the brokers
+    da = np.asarray(m.disk_alive).copy()
+    da[::2, 0] = False
+    m = m.replace(disk_alive=np.asarray(da))
+    s0 = stack_v(m)
+    m2, n = topic_rebalance(m, GoalConfig())
+    assert n > 0
+    s1 = stack_v(m2)
+    assert s1["TopicReplicaDistributionGoal"] < s0["TopicReplicaDistributionGoal"]
+    # every MOVED replica landed on an alive disk (pre-existing placements
+    # on the freshly-killed disks are hard_repair's job, not this sweep's)
+    a0 = np.asarray(m.assignment)
+    a = np.asarray(m2.assignment)
+    d = np.asarray(m2.replica_disk)
+    moved = (a != a0) & (a >= 0)
+    assert moved.any()
+    assert da[a[moved], d[moved]].all(), "moved replica on a dead disk"
+    assert s1["StructuralFeasibility"] <= s0["StructuralFeasibility"]
