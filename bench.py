@@ -162,8 +162,13 @@ def run_config(name: str, rung: str) -> dict:
         moves = int(os.environ.get("CCX_BENCH_MOVES", d_moves))
         polish_iters = int(os.environ.get("CCX_BENCH_POLISH_ITERS", d_polish))
     opts = OptimizeOptions(
+        # chunk_steps=500: lean (1000) and full (3000) step budgets run the
+        # SAME compiled 500-step chunk program per (chains, moves) shape —
+        # step-count retunes stop costing a multi-minute TPU recompile
+        # (bit-exact vs the single scan, tests/test_search.py)
         anneal=AnnealOptions(
-            n_chains=n_chains, n_steps=n_steps, moves_per_step=moves, seed=42
+            n_chains=n_chains, n_steps=n_steps, moves_per_step=moves, seed=42,
+            chunk_steps=0 if smoke else 500,
         ),
         # patience 16 matches tests/test_parity_b5.py so the official bench
         # reproduces the banked PARITY_B5.json quality (patience 8 can

@@ -184,10 +184,23 @@ def analyzer_config_def() -> ConfigDef:
              "batch on large clusters (AnnealOptions.batched) — total churn "
              "budget is chains * steps * this.", at_least(1))
     d.define("optimizer.seed", Type.INT, 42, Importance.LOW, "SA PRNG seed.")
+    d.define("optimizer.chunk.steps", Type.INT, 500, Importance.LOW,
+             "Run the SA scan in fixed chunks of this many steps so one "
+             "compiled program serves every optimizer.num.steps budget "
+             "(TPU compiles at scale are minutes per distinct step count); "
+             "0 = single scan keyed on the full step count. Results are "
+             "bit-exact either way. Applies to the single-device path only "
+             "(mesh-sharded runs keep their own program cache).",
+             at_least(0))
     d.define("optimizer.polish.candidates", Type.INT, 256, Importance.LOW,
              "Greedy polish candidate moves per iteration.", at_least(1))
     d.define("optimizer.polish.max.iters", Type.INT, 400, Importance.LOW,
              "Greedy polish iteration cap.", at_least(1))
+    d.define("optimizer.topic.rebalance.rounds", Type.INT, 2, Importance.LOW,
+             "Sweep+polish rounds of the targeted TopicReplicaDistribution "
+             "stage (each enumerates over-band (topic, broker) cells, "
+             "re-polishes, and is adopted only on full-vector lex "
+             "improvement). 0 disables.", at_least(0))
     d.define("optimizer.polish.batch.moves", Type.INT, 16, Importance.LOW,
              "Non-conflicting improving moves applied per polish iteration "
              "(disjoint partitions/topics/broker sets; 1 = classic "

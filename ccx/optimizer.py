@@ -28,9 +28,8 @@ from ccx.model.stats import ClusterModelStats, balancedness_score, cluster_model
 from ccx.model.tensor_model import TensorClusterModel
 from ccx.proposals import ExecutionProposal, diff
 from ccx.goals.stack import evaluate_stack
-from ccx.search.annealer import AnnealOptions, anneal
+from ccx.search.annealer import AnnealOptions, allows_inter_broker, anneal
 from ccx.search.greedy import GreedyOptions, greedy_optimize
-from ccx.search.annealer import allows_inter_broker
 from ccx.search.repair import (
     finalize_preferred_leaders,
     hard_repair,

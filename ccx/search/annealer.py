@@ -88,6 +88,16 @@ class AnnealOptions:
     #: count-preserving barriers single moves cannot (ref ActionType,
     #: SURVEY.md C20); 0 disables (intra-broker stacks set 0).
     p_swap: float = 0.15
+    #: >0: run the scan in fixed chunks of this many steps with the global
+    #: step index passed as data, so ONE compiled program (per chains/moves
+    #: shape) serves every n_steps — TPU B5 compiles are minutes apiece and
+    #: the effort ladder/retunes stop paying them per rung. 0 (default):
+    #: single scan of n_steps (compile keyed on it). Results are bit-exact
+    #: either way (same step body, same f32 temperature schedule).
+    #: Chunking applies only to the single-device path: ``anneal(mesh=...)``
+    #: falls back to the one-shot scan (the sharded runner in ccx.parallel
+    #: keeps its own program cache keyed on static config).
+    chunk_steps: int = 0
     seed: int = 0
 
 
@@ -1008,32 +1018,27 @@ def _anneal_step_batched(
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("goal_names", "cfg", "opts", "p_real", "b_real", "max_pt"),
-)
-def _run_chains(
+def _build_step(
     m: TensorClusterModel,
-    keys: jnp.ndarray,
-    evac: jnp.ndarray,
-    n_evac: jnp.ndarray,
-    *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
     opts: AnnealOptions,
     p_real: int,
     b_real: int,
     max_pt: int,
-) -> SearchState:
+):
+    """Construct the per-step transition (called inside a trace).
+
+    Shared by the one-shot scan (`_run_chains`) and the fixed-chunk runner
+    (`_run_chunk`) so both compile the identical step body. Returns
+    ``(step, group)``; ``opts.n_steps`` is never read here — the cooling
+    schedule is the caller's business — so a chunk-runner static key with
+    ``n_steps`` zeroed still builds the exact same transition.
+    """
     group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
-    state0 = init_search_state(m, cfg, goal_names, keys[0], group=group)
-    states = jax.vmap(lambda k: state0.replace(key=k))(keys)
     hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
     hard_arr = jnp.asarray(hard_mask)
     weights = soft_weights(hard_mask)
-
-    n = max(opts.n_steps, 1)
-    decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
 
     allow_inter = allows_inter_broker(goal_names)
     pp = ProposalParams(
@@ -1083,6 +1088,94 @@ def _run_chains(
             else {}
         ),
     )
+    return step, group
+
+
+@functools.partial(jax.jit, static_argnames=("goal_names", "cfg", "max_pt"))
+def _init_chains(
+    m: TensorClusterModel,
+    keys: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    max_pt: int,
+) -> SearchState:
+    group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
+    state0 = init_search_state(m, cfg, goal_names, keys[0], group=group)
+    return jax.vmap(lambda k: state0.replace(key=k))(keys)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "goal_names", "cfg", "opts", "p_real", "b_real", "max_pt", "chunk",
+    ),
+    donate_argnums=(0,),
+)
+def _run_chunk(
+    states: SearchState,
+    m: TensorClusterModel,
+    evac: jnp.ndarray,
+    n_evac: jnp.ndarray,
+    t_offset: jnp.ndarray,
+    decay: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    opts: AnnealOptions,
+    p_real: int,
+    b_real: int,
+    max_pt: int,
+    chunk: int,
+) -> SearchState:
+    """Fixed-length scan segment with the global step index passed as data.
+
+    The caller zeroes ``opts.n_steps`` in the static key and feeds the
+    cooling schedule in as traced scalars (``t_offset``, ``decay``), so
+    EVERY step budget reuses one compiled program per chunk shape. On TPU a
+    B5-scale anneal compile is minutes (measured 155-379 s per distinct
+    n_steps on v5e); chunking pays it once per (chains, moves) shape instead
+    of once per rung/retune. Bit-exact vs `_run_chains`: the step body is
+    identical (`_build_step`) and ``temp = t0 * decay**t`` sees the same
+    f32 values — XLA folds the unchunked path's python-float decay to f32
+    exactly as `jnp.float32(decay)` does here.
+    """
+    step, _ = _build_step(m, goal_names, cfg, opts, p_real, b_real, max_pt)
+
+    def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
+        temp = opts.t0 * decay**t
+        ss = jax.vmap(step, in_axes=(0, None, None, None, None))(
+            ss, temp, t, evac, n_evac
+        )
+        return ss, None
+
+    states, _ = jax.lax.scan(body, states, t_offset + jnp.arange(chunk))
+    return states
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("goal_names", "cfg", "opts", "p_real", "b_real", "max_pt"),
+)
+def _run_chains(
+    m: TensorClusterModel,
+    keys: jnp.ndarray,
+    evac: jnp.ndarray,
+    n_evac: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    opts: AnnealOptions,
+    p_real: int,
+    b_real: int,
+    max_pt: int,
+) -> SearchState:
+    step, group = _build_step(m, goal_names, cfg, opts, p_real, b_real, max_pt)
+    state0 = init_search_state(m, cfg, goal_names, keys[0], group=group)
+    states = jax.vmap(lambda k: state0.replace(key=k))(keys)
+
+    n = max(opts.n_steps, 1)
+    decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
         temp = opts.t0 * decay**t
@@ -1143,12 +1236,36 @@ def anneal(
         m = jax.tree.map(
             lambda a: jax.device_put(a, NamedSharding(mesh, PartitionSpec())), m
         )
-    states = _run_chains(
-        m, keys, jnp.asarray(evac), jnp.asarray(n_evac, jnp.int32),
-        goal_names=goal_names, cfg=cfg, opts=opts,
-        p_real=p_real, b_real=b_real,
-        max_pt=max_partitions_per_topic(m),
-    )
+    max_pt = max_partitions_per_topic(m)
+    if mesh is None and opts.chunk_steps > 0:
+        # Chunked path: one compiled chunk program serves every step budget
+        # (see _run_chunk). A trailing remainder chunk compiles separately,
+        # so pick n_steps % chunk_steps == 0 where compile time matters.
+        # With a mesh this gate falls through to the one-shot scan —
+        # chunk_steps documents the restriction.
+        n = max(opts.n_steps, 1)
+        decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
+        opts_key = dataclasses.replace(opts, n_steps=0, seed=0)
+        states = _init_chains(
+            m, keys, goal_names=goal_names, cfg=cfg, max_pt=max_pt
+        )
+        evac_j = jnp.asarray(evac)
+        n_evac_j = jnp.asarray(n_evac, jnp.int32)
+        for off in range(0, n, opts.chunk_steps):
+            states = _run_chunk(
+                states, m, evac_j, n_evac_j,
+                jnp.asarray(off, jnp.int32), jnp.asarray(decay, jnp.float32),
+                goal_names=goal_names, cfg=cfg, opts=opts_key,
+                p_real=p_real, b_real=b_real, max_pt=max_pt,
+                chunk=int(min(opts.chunk_steps, n - off)),
+            )
+    else:
+        states = _run_chains(
+            m, keys, jnp.asarray(evac), jnp.asarray(n_evac, jnp.int32),
+            goal_names=goal_names, cfg=cfg, opts=opts,
+            p_real=p_real, b_real=b_real,
+            max_pt=max_pt,
+        )
 
     best = best_chain_index(np.asarray(states.cost_vec))
     pick = jax.tree.map(lambda a: a[best], states)

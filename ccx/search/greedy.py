@@ -142,6 +142,8 @@ def _greedy_loop(
     evac: jnp.ndarray,
     n_evac: jnp.ndarray,
     key0: jnp.ndarray,
+    max_iters: jnp.ndarray,
+    patience: jnp.ndarray,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
@@ -149,6 +151,11 @@ def _greedy_loop(
     opts: GreedyOptions,
     max_pt: int,
 ):
+    # max_iters/patience arrive as traced scalars (and are ZEROED in the
+    # static `opts` key by the caller): iteration budgets are while_loop
+    # bound data, not program shape, so lean polish (400 iters) and full
+    # polish (1600) share ONE compiled program — a B5-scale greedy compile
+    # is >10 min on TPU v5e.
     group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
     scorer = make_move_scorer(m, goal_names, cfg)
     vector_fn = make_cost_vector_fn(m, goal_names, cfg)
@@ -161,7 +168,7 @@ def _greedy_loop(
 
     def cond(carry):
         _, it, stale, _ = carry
-        return (it < opts.max_iters) & (stale < opts.patience)
+        return (it < max_iters) & (stale < patience)
 
     def body(carry):
         ss, it, stale, moves = carry
@@ -416,10 +423,14 @@ def greedy_optimize(
         jnp.asarray(evac_np),
         jnp.asarray(n_evac_i, jnp.int32),
         jax.random.PRNGKey(opts.seed + 1),
+        jnp.asarray(opts.max_iters, jnp.int32),
+        jnp.asarray(opts.patience, jnp.int32),
         goal_names=goal_names,
         cfg=cfg,
         pp=pp,
-        opts=opts,
+        # iteration budgets are traced operands; zero them (and the RNG
+        # seed, which only enters via PRNGKey data) in the compile key
+        opts=dataclasses.replace(opts, max_iters=0, patience=0, seed=0),
         max_pt=max_pt,
     )
 

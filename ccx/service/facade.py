@@ -118,6 +118,7 @@ class CruiseControl:
             n_steps=self.config["optimizer.num.steps"],
             moves_per_step=self.config["optimizer.moves.per.step"],
             seed=self.config["optimizer.seed"],
+            chunk_steps=self.config["optimizer.chunk.steps"],
         )
         polish = GreedyOptions(
             n_candidates=self.config["optimizer.polish.candidates"],
@@ -147,6 +148,12 @@ class CruiseControl:
         return OptimizeOptions(
             anneal=anneal, polish=polish,
             check_evacuation=not disk_only,
+            # the targeted TRD stage only applies to full placement stacks —
+            # leadership-/disk-only paths never move topic replica counts
+            topic_rebalance_rounds=(
+                0 if (leadership_only or disk_only)
+                else self.config["optimizer.topic.rebalance.rounds"]
+            ),
             # the portfolio candidate roughly doubles polish-phase cost;
             # never pay it on the leadership-/disk-only fast paths
             run_cold_greedy=(

@@ -116,12 +116,16 @@ class OptimizerSidecar:
                 n_steps=int(o.get("steps", 3000)),
                 moves_per_step=int(o.get("moves_per_step", 8)),
                 seed=int(o.get("seed", 42)),
+                # resident sidecar: one compiled chunk program serves any
+                # requested step budget (see AnnealOptions.chunk_steps)
+                chunk_steps=int(o.get("chunk_steps", 500)),
             ),
             polish=GreedyOptions(
                 n_candidates=int(o.get("polish_candidates", 256)),
                 max_iters=int(o.get("polish_max_iters", 400)),
             ),
             check_evacuation=bool(o.get("check_evacuation", True)),
+            topic_rebalance_rounds=int(o.get("topic_rebalance_rounds", 2)),
         )
         yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}
         res = optimize(model, self.goal_config, goals, opts)

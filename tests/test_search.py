@@ -141,6 +141,50 @@ def test_anneal_improves_and_is_consistent(annealed, small_model):
     assert not verify_model_consistency(res.model)
 
 
+def test_chunked_anneal_bitexact(annealed, small_model):
+    """chunk_steps partitions the scan WITHOUT changing results: the chunk
+    runner's static key excludes n_steps (one compiled program serves every
+    step budget — TPU B5 compiles are minutes per distinct n_steps), and the
+    traced f32 cooling schedule must reproduce the single-scan run
+    bit-exactly."""
+    r2 = anneal(
+        small_model,
+        CFG,
+        DEFAULT_GOAL_ORDER,
+        dataclasses.replace(SMALL_OPTS, chunk_steps=500),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(annealed.model.assignment), np.asarray(r2.model.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(annealed.model.leader_slot), np.asarray(r2.model.leader_slot)
+    )
+    assert annealed.n_accepted == r2.n_accepted
+
+
+def test_greedy_budget_is_data_not_shape(small_model):
+    """max_iters/patience are while_loop data (zeroed in the compile key so
+    lean/full polish share one compiled program); the bound must still be
+    honored exactly, including the zero-budget edge."""
+    frozen = greedy_optimize(
+        small_model,
+        CFG,
+        DEFAULT_GOAL_ORDER,
+        GreedyOptions(n_candidates=64, max_iters=0, patience=4),
+    )
+    assert frozen.n_iters == 0 and frozen.n_moves == 0
+    np.testing.assert_array_equal(
+        np.asarray(frozen.model.assignment), np.asarray(small_model.assignment)
+    )
+    bounded = greedy_optimize(
+        small_model,
+        CFG,
+        DEFAULT_GOAL_ORDER,
+        GreedyOptions(n_candidates=64, max_iters=7, patience=7),
+    )
+    assert bounded.n_iters <= 7
+
+
 def test_anneal_reaches_hard_feasibility(annealed):
     hard = float(annealed.stack_after.hard_cost)
     offenders = {
