@@ -39,7 +39,10 @@ the device-probe timeout; CCX_BENCH_FULL=1 forces the full rung even on the
 CPU fallback (by default the fallback stops after the lean rung to fit the
 driver timeout on a much slower backend — fallback numbers are NOT
 same-workload comparable with full-effort runs and are marked
-"lean": true).
+"lean": true); CCX_BENCH_CPU_FIRST=0 disables the banking of a CPU lean
+baseline (subprocess, CCX_BENCH_CPU_FIRST_TIMEOUT, default 900 s) before
+the TPU ladder on a healthy device (CCX_BENCH_SUBRUN marks that internal
+subprocess and is not for operators).
 """
 
 from __future__ import annotations
@@ -267,6 +270,69 @@ def main() -> None:
             probe_failed = True
     if backend_forced:
         log(f"FALLING BACK to {backend_forced}")
+
+    # TPU healthy: FIRST bank a guaranteed number by running the CPU lean
+    # rung in a subprocess (its compiles are cached from prior runs), THEN
+    # climb the TPU ladder in this process. A cold TPU cache means minutes
+    # of compile per program on this 1-core host — if the driver's timeout
+    # lands mid-compile, SIGTERM/atexit re-emits this banked line instead
+    # of a numberless partial dump (round-3 failure mode, VERDICT.md #2).
+    # Skip: CCX_BENCH_CPU_FIRST=0; the subprocess marks itself with
+    # CCX_BENCH_SUBRUN to avoid recursion.
+    if (
+        not backend_forced
+        and os.environ.get("CCX_BENCH_CPU_FIRST", "1") == "1"
+        and os.environ.get("CCX_BENCH_SUBRUN") != "1"
+    ):
+        enter_phase("cpu-baseline")
+        env = dict(
+            os.environ,
+            CCX_BENCH_CPU="1",
+            CCX_BENCH_SUBRUN="1",
+            CCX_BENCH_SKIP_SMOKE="1",
+            # the baseline is strictly the lean rung — an inherited
+            # CCX_BENCH_FULL=1 must not bypass the CPU fallback truncation
+            CCX_BENCH_FULL="0",
+        )
+
+        def bank_line(out: str | None) -> bool:
+            # COMPLETED rungs only: a crashed subprocess's atexit partial
+            # dump also starts with '{' and carries "metric" but has
+            # "partial": true and a null value — banking it would re-create
+            # the numberless-final-line failure this block exists to prevent.
+            for ln in reversed((out or "").splitlines()):
+                ln = ln.strip()
+                if (
+                    ln.startswith("{")
+                    and '"metric"' in ln
+                    and '"partial"' not in ln
+                ):
+                    _state["done"] = True
+                    _state["final_json"] = ln
+                    print(ln, flush=True)
+                    return True
+            return False
+
+        try:
+            sub = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=int(os.environ.get("CCX_BENCH_CPU_FIRST_TIMEOUT", "900")),
+            )
+            if bank_line(sub.stdout):
+                log("cpu-baseline banked; climbing TPU ladder")
+            else:
+                tail = "\n".join(sub.stderr.splitlines()[-3:])
+                log(f"cpu-baseline yielded no JSON (rc={sub.returncode}): {tail}")
+        except subprocess.TimeoutExpired as e:
+            # the subprocess may have printed a completed lean line before
+            # overrunning (e.g. a slow cold cache) — salvage it
+            if bank_line(e.stdout if isinstance(e.stdout, str) else None):
+                log("cpu-baseline timed out AFTER banking a lean line")
+            else:
+                log("cpu-baseline timed out; continuing with TPU ladder")
 
     enter_phase("jax-init")
     import jax
