@@ -294,13 +294,18 @@ def main() -> None:
             # CCX_BENCH_FULL=1 must not bypass the CPU fallback truncation
             CCX_BENCH_FULL="0",
         )
+        # ... and inherited effort overrides must not turn it into a
+        # full-effort 'custom' rung on the ~50x slower backend
+        for k in ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
+                  "CCX_BENCH_POLISH_ITERS"):
+            env.pop(k, None)
 
-        def bank_line(out: str | None) -> bool:
+        def bank_line(out: str) -> bool:
             # COMPLETED rungs only: a crashed subprocess's atexit partial
             # dump also starts with '{' and carries "metric" but has
             # "partial": true and a null value — banking it would re-create
             # the numberless-final-line failure this block exists to prevent.
-            for ln in reversed((out or "").splitlines()):
+            for ln in reversed(out.splitlines()):
                 ln = ln.strip()
                 if (
                     ln.startswith("{")
@@ -313,26 +318,38 @@ def main() -> None:
                     return True
             return False
 
-        try:
-            sub = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=int(os.environ.get("CCX_BENCH_CPU_FIRST_TIMEOUT", "900")),
-            )
-            if bank_line(sub.stdout):
-                log("cpu-baseline banked; climbing TPU ladder")
-            else:
-                tail = "\n".join(sub.stderr.splitlines()[-3:])
-                log(f"cpu-baseline yielded no JSON (rc={sub.returncode}): {tail}")
-        except subprocess.TimeoutExpired as e:
-            # the subprocess may have printed a completed lean line before
-            # overrunning (e.g. a slow cold cache) — salvage it
-            if bank_line(e.stdout if isinstance(e.stdout, str) else None):
+        # stdout/stderr go to real files (not PIPEs): TimeoutExpired does
+        # not surface captured output on this platform, and a completed
+        # lean line printed BEFORE a timeout must still be salvageable.
+        import tempfile
+
+        with tempfile.TemporaryFile("w+") as out_f, \
+                tempfile.TemporaryFile("w+") as err_f:
+            try:
+                sub = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env,
+                    stdout=out_f,
+                    stderr=err_f,
+                    timeout=int(
+                        os.environ.get("CCX_BENCH_CPU_FIRST_TIMEOUT", "900")
+                    ),
+                )
+                rc: int | None = sub.returncode
+            except subprocess.TimeoutExpired:
+                rc = None
+            out_f.seek(0)
+            banked = bank_line(out_f.read())
+            if banked and rc is None:
                 log("cpu-baseline timed out AFTER banking a lean line")
-            else:
+            elif banked:
+                log("cpu-baseline banked; climbing TPU ladder")
+            elif rc is None:
                 log("cpu-baseline timed out; continuing with TPU ladder")
+            else:
+                err_f.seek(0)
+                tail = "\n".join(err_f.read().splitlines()[-3:])
+                log(f"cpu-baseline yielded no JSON (rc={rc}): {tail}")
 
     enter_phase("jax-init")
     import jax

@@ -1,0 +1,89 @@
+"""A/B probe for the Pallas MXU broker-aggregates kernel on live TPU.
+
+Measures, in the CURRENT process env (CCX_MXU_AGGREGATES is read once at
+import, so the campaign script runs this twice — env 0 and env 1):
+
+* broker_aggregates wall (jitted, warm) at B5 scale,
+* full goal-stack evaluation wall (the aggregate pass's hottest consumer),
+* when the MXU kernel is active, max-abs disagreement vs the XLA twin —
+  the live-hardware validation gate `mxu_aggregates_enabled` asks for
+  before the kernel can become the backend-gated default.
+
+Usage: [CCX_MXU_AGGREGATES=1] python tools/probe_mxu.py [B5|B2|...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("PROBE_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np
+
+
+def timed(label, fn, *a, reps=5):
+    # drain the async warmup fully before the clock starts — on TPU the
+    # warmup call returns while the device is still executing, and its
+    # tail would otherwise be charged to the ms-scale timed window
+    jax.block_until_ready(fn(*a))
+    t0 = time.monotonic()
+    for _ in range(reps):
+        r = fn(*a)
+    jax.block_until_ready(jax.tree.leaves(r))
+    dt = (time.monotonic() - t0) / reps
+    print(f"[mxu-probe] {label}: {dt * 1e3:.2f} ms (warm, avg of {reps})",
+          flush=True)
+    return r
+
+
+def main():
+    from ccx.goals.base import GoalConfig
+    from ccx.goals.stack import DEFAULT_GOAL_ORDER, evaluate_stack
+    from ccx.model.aggregates import _broker_aggregates_xla, broker_aggregates
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.ops.mxu_aggregates import mxu_aggregates_enabled
+
+    name = sys.argv[1] if len(sys.argv) > 1 else "B5"
+    print(
+        f"[mxu-probe] backend={jax.default_backend()} "
+        f"mxu_kernel={'ON' if mxu_aggregates_enabled() else 'off'}",
+        flush=True,
+    )
+    m = random_cluster(bench_spec(name))
+    print(f"[mxu-probe] {name}: P={m.P} B={m.B} T={m.num_topics}", flush=True)
+
+    agg = timed("broker_aggregates", jax.jit(broker_aggregates), m)
+    timed(
+        "evaluate_stack (full goal stack)",
+        jax.jit(evaluate_stack, static_argnums=(1, 2)),
+        m, GoalConfig(), DEFAULT_GOAL_ORDER,
+    )
+
+    if mxu_aggregates_enabled():
+        ref = jax.jit(_broker_aggregates_xla)(m)
+        worst = 0.0
+        for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(ref)):
+            worst = max(
+                worst,
+                float(np.max(np.abs(np.asarray(a, np.float64)
+                                    - np.asarray(b, np.float64)))),
+            )
+        print(f"[mxu-probe] max|mxu - xla| = {worst:.3e} "
+              f"({'OK' if worst < 1e-3 else 'MISMATCH'})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

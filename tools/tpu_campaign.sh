@@ -1,0 +1,41 @@
+#!/bin/bash
+# One-shot TPU measurement campaign for a healthy tunnel window.
+#
+# The axon tunnel has been wedged for most of rounds 1-4; when a window
+# opens, this script banks everything the perf story needs, in priority
+# order, so a re-wedge mid-campaign still leaves the most valuable
+# artifacts: (1) a bench pass that populates .jax_cache with every
+# program the driver's end-of-round bench will need, (2) a warm-cache
+# bench pass for the official-style TPU numbers, (3) the Pallas MXU
+# aggregates kernel A/B + live-hardware validation, (4) the batched-SA
+# moves sweep the round-3 verdict asked to re-measure on TPU.
+#
+# Usage: tools/tpu_campaign.sh [logfile]   (appends; default tpu_campaign.log)
+set -u
+cd "$(dirname "$0")/.."
+L="${1:-tpu_campaign.log}"
+{
+  echo "=== TPU campaign start $(date -u +%FT%TZ) ==="
+  echo "--- probe ---"
+  if ! timeout 90 python -c "import jax; print(jax.devices())"; then
+    echo "device probe FAILED — tunnel wedged; aborting campaign"
+    exit 1
+  fi
+  echo "--- bench pass 1 (cold compiles -> persistent cache) ---"
+  CCX_BENCH_CPU_FIRST=0 timeout 5400 python bench.py
+  echo "bench pass 1 rc=$?"
+  echo "--- bench pass 2 (warm cache; official-style numbers) ---"
+  CCX_BENCH_CPU_FIRST=0 timeout 2400 python bench.py
+  echo "bench pass 2 rc=$?"
+  echo "--- MXU aggregates A/B at B5 ---"
+  CCX_MXU_AGGREGATES=0 timeout 1200 python tools/probe_mxu.py B5
+  echo "xla rc=$?"
+  CCX_MXU_AGGREGATES=1 timeout 1800 python tools/probe_mxu.py B5
+  echo "mxu rc=$?"
+  echo "--- batched-SA moves sweep (16 then 32 moves/step) ---"
+  PROBE_BATCHED=1 PROBE_MOVES=16 PROBE_CHAINS=16 timeout 1800 python tools/probe_b5.py B5
+  echo "moves-16 rc=$?"
+  PROBE_BATCHED=1 PROBE_MOVES=32 PROBE_CHAINS=16 timeout 1800 python tools/probe_b5.py B5
+  echo "moves-32 rc=$?"
+  echo "=== TPU campaign end $(date -u +%FT%TZ) ==="
+} >> "$L" 2>&1
