@@ -256,16 +256,29 @@ def main() -> None:
     if os.environ.get("CCX_BENCH_CPU") == "1":
         backend_forced = "cpu (CCX_BENCH_CPU=1)"
     else:
+        # SIGTERM + grace, never a straight SIGKILL: subprocess.run's
+        # timeout path kills the child outright, and a probe client
+        # SIGKILLed while holding the device claim is exactly what wedges
+        # the axon relay for every later client (perf-notes wedge
+        # etiology). terminate() lets the claim be released.
+        probe = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
         try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=int(os.environ.get("CCX_BENCH_PROBE_TIMEOUT", "120")),
-                capture_output=True,
+            rc = probe.wait(
+                timeout=int(os.environ.get("CCX_BENCH_PROBE_TIMEOUT", "120"))
             )
-            if probe.returncode != 0:
-                backend_forced = f"cpu (device probe rc={probe.returncode})"
+            if rc != 0:
+                backend_forced = f"cpu (device probe rc={rc})"
                 probe_failed = True
         except subprocess.TimeoutExpired:
+            probe.terminate()
+            try:
+                probe.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                probe.kill()
             backend_forced = "cpu (device probe timed out — TPU wedged?)"
             probe_failed = True
     if backend_forced:

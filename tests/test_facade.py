@@ -101,6 +101,28 @@ def test_add_brokers_moves_load_onto_new(tmp_path):
         assert gained <= {3}
 
 
+def test_optimizer_option_plumbing(tmp_path):
+    """Every optimizer.* config key must land in OptimizeOptions — option
+    fields silently dropped in a branch was a real bug class (round-3 C35
+    fix); the newer chunk/TRD knobs get the same regression guard."""
+    cc, _, _ = make_cc(
+        tmp_path,
+        **{
+            "optimizer.chunk.steps": 123,
+            "optimizer.topic.rebalance.rounds": 5,
+        },
+    )
+    opts = cc._optimize_options()
+    assert opts.anneal.chunk_steps == 123
+    assert opts.topic_rebalance_rounds == 5
+    lead = cc._optimize_options(leadership_only=True)
+    assert lead.topic_rebalance_rounds == 0  # cannot move replica counts
+    disk = cc._optimize_options(disk_only=True)
+    assert disk.topic_rebalance_rounds == 0
+    # fast paths keep the chunking (it is placement-stack agnostic)
+    assert lead.anneal.chunk_steps == 123
+
+
 def test_demote_brokers_sheds_leadership(tmp_path):
     cc, sim, clock = make_cc(tmp_path)
     res = cc.demote_brokers((0,), dryrun=False, reason="maintenance")

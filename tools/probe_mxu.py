@@ -81,8 +81,13 @@ def main():
                 float(np.max(np.abs(np.asarray(a, np.float64)
                                     - np.asarray(b, np.float64)))),
             )
+        ok = worst < 1e-3
         print(f"[mxu-probe] max|mxu - xla| = {worst:.3e} "
-              f"({'OK' if worst < 1e-3 else 'MISMATCH'})", flush=True)
+              f"({'OK' if ok else 'MISMATCH'})", flush=True)
+        if not ok:
+            # the campaign log gates on rc — a silent rc=0 would read as a
+            # passed validation for flipping the kernel default
+            sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -4,11 +4,13 @@
 # The axon tunnel has been wedged for most of rounds 1-4; when a window
 # opens, this script banks everything the perf story needs, in priority
 # order, so a re-wedge mid-campaign still leaves the most valuable
-# artifacts: (1) a bench pass that populates .jax_cache with every
-# program the driver's end-of-round bench will need, (2) a warm-cache
-# bench pass for the official-style TPU numbers, (3) the Pallas MXU
-# aggregates kernel A/B + live-hardware validation, (4) the batched-SA
-# moves sweep the round-3 verdict asked to re-measure on TPU.
+# artifacts: (1) a B5 bench pass that populates .jax_cache with the
+# programs the driver's end-of-round `python bench.py` (default B5 +
+# B1 smoke) will need, (2) a warm-cache B5 pass for the official-style
+# TPU numbers (T1 is the B5 config), (3) the Pallas MXU aggregates
+# kernel A/B + live-hardware validation, (4) the batched-SA moves sweep
+# the round-3 verdict asked to re-measure on TPU, (5) B1-B4 on hardware
+# for the BASELINE.md table.
 #
 # Usage: tools/tpu_campaign.sh [logfile]   (appends; default tpu_campaign.log)
 set -u
@@ -17,8 +19,13 @@ L="${1:-tpu_campaign.log}"
 {
   echo "=== TPU campaign start $(date -u +%FT%TZ) ==="
   echo "--- probe ---"
-  if ! timeout 90 python -c "import jax; print(jax.devices())"; then
-    echo "device probe FAILED — tunnel wedged; aborting campaign"
+  # Require an actual TPU device: a missing/failed axon plugin makes jax
+  # fall back to CPU with rc=0, which would bank hours of CPU numbers as
+  # "TPU" artifacts. (timeout(1) sends SIGTERM, not SIGKILL — a stuck
+  # probe client gets to release its device claim; see perf-notes wedge
+  # etiology.)
+  if ! timeout 90 python -c "import jax; print(jax.devices())" | grep -qi tpu; then
+    echo "device probe FAILED or non-TPU backend — aborting campaign"
     exit 1
   fi
   echo "--- bench pass 1 (cold compiles -> persistent cache) ---"
@@ -37,5 +44,10 @@ L="${1:-tpu_campaign.log}"
   echo "moves-16 rc=$?"
   PROBE_BATCHED=1 PROBE_MOVES=32 PROBE_CHAINS=16 timeout 1800 python tools/probe_b5.py B5
   echo "moves-32 rc=$?"
+  echo "--- remaining BASELINE configs on hardware (B1-B4 lean) ---"
+  for c in B1 B2 B3 B4; do
+    CCX_BENCH="$c" CCX_BENCH_CPU_FIRST=0 timeout 1800 python bench.py
+    echo "$c rc=$?"
+  done
   echo "=== TPU campaign end $(date -u +%FT%TZ) ==="
 } >> "$L" 2>&1
