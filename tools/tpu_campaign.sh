@@ -24,7 +24,9 @@ L="${1:-tpu_campaign.log}"
   # "TPU" artifacts. (timeout(1) sends SIGTERM, not SIGKILL — a stuck
   # probe client gets to release its device claim; see perf-notes wedge
   # etiology.)
-  if ! timeout 90 python -c "import jax; print(jax.devices())" | grep -qi tpu; then
+  probe_out="$(timeout 90 python -c "import jax; print(jax.devices())" 2>&1)"
+  echo "$probe_out"
+  if ! grep -qi tpu <<<"$probe_out"; then
     echo "device probe FAILED or non-TPU backend — aborting campaign"
     exit 1
   fi
@@ -44,9 +46,15 @@ L="${1:-tpu_campaign.log}"
   echo "moves-16 rc=$?"
   PROBE_BATCHED=1 PROBE_MOVES=32 PROBE_CHAINS=16 timeout 1800 python tools/probe_b5.py B5
   echo "moves-32 rc=$?"
-  echo "--- remaining BASELINE configs on hardware (B1-B4 lean) ---"
+  echo "--- remaining BASELINE configs on hardware (B1-B4, lean effort) ---"
+  # pin all four effort knobs to the lean values: bench collapses to ONE
+  # honestly-labeled "custom" rung per config instead of climbing
+  # smoke+lean+full (the full-rung cold compile would eat the window
+  # before B2-B4 ever ran)
   for c in B1 B2 B3 B4; do
-    CCX_BENCH="$c" CCX_BENCH_CPU_FIRST=0 timeout 1800 python bench.py
+    CCX_BENCH="$c" CCX_BENCH_CPU_FIRST=0 \
+      CCX_BENCH_CHAINS=16 CCX_BENCH_STEPS=1000 CCX_BENCH_MOVES=8 \
+      CCX_BENCH_POLISH_ITERS=400 timeout 1800 python bench.py
     echo "$c rc=$?"
   done
   echo "=== TPU campaign end $(date -u +%FT%TZ) ==="
