@@ -24,7 +24,11 @@ L="${1:-tpu_campaign.log}"
   # "TPU" artifacts. (timeout(1) sends SIGTERM, not SIGKILL — a stuck
   # probe client gets to release its device claim; see perf-notes wedge
   # etiology.)
-  probe_out="$(timeout 90 python -c "import jax; print(jax.devices())" 2>&1)"
+  # grep STDOUT only: stderr init-failure text can itself mention "tpu"
+  # (e.g. "Unable to initialize backend 'tpu'") and must not pass the gate
+  probe_err="$(mktemp)"
+  probe_out="$(timeout 90 python -c "import jax; print(jax.devices())" 2>"$probe_err")"
+  cat "$probe_err"; rm -f "$probe_err"
   echo "$probe_out"
   if ! grep -qi tpu <<<"$probe_out"; then
     echo "device probe FAILED or non-TPU backend — aborting campaign"
@@ -54,7 +58,8 @@ L="${1:-tpu_campaign.log}"
   for c in B1 B2 B3 B4; do
     CCX_BENCH="$c" CCX_BENCH_CPU_FIRST=0 \
       CCX_BENCH_CHAINS=16 CCX_BENCH_STEPS=1000 CCX_BENCH_MOVES=8 \
-      CCX_BENCH_POLISH_ITERS=400 timeout 1800 python bench.py
+      CCX_BENCH_POLISH_ITERS=400 CCX_BENCH_PORTFOLIO=0 \
+      timeout 1800 python bench.py
     echo "$c rc=$?"
   done
   echo "=== TPU campaign end $(date -u +%FT%TZ) ==="
