@@ -195,11 +195,15 @@ def run_config(name: str, rung: str) -> dict:
         # the portfolio's 5-6 s bought an identical end state. The full
         # rung keeps the guarantee (quality-max setting, and it is the
         # config PARITY_B5.json was banked under). CCX_BENCH_PORTFOLIO=0
-        # drops it from the custom rung too (the campaign's pinned-effort
-        # B1-B4 pass uses this to stay lean-comparable).
+        # drops it from the CUSTOM rung only (the campaign's pinned-effort
+        # B1-B4 pass uses this to stay lean-comparable) — the full rung
+        # must stay the config the parity artifact was banked under.
         run_cold_greedy=(
-            rung not in ("target", "lean", "smoke")
-            and os.environ.get("CCX_BENCH_PORTFOLIO") != "0"
+            rung == "full"
+            or (
+                rung == "custom"
+                and os.environ.get("CCX_BENCH_PORTFOLIO") != "0"
+            )
         ),
         # latency-floor settings for the T1 chase; every other rung keeps
         # the pipeline defaults
@@ -260,6 +264,10 @@ def run_config(name: str, rung: str) -> dict:
         "effort": {
             "chains": n_chains, "steps": n_steps, "moves": moves,
             "polish_iters": polish_iters,
+            # pipeline-stage state, so rung lines are self-describing and
+            # never silently compared across different stage sets
+            "portfolio": opts.run_cold_greedy,
+            "trd_rounds": opts.topic_rebalance_rounds,
         },
     }
 

@@ -332,14 +332,16 @@ def topic_rebalance(
     optimizer polishes the swept placement and keeps it only if the full
     cost vector improves; see optimize()).
 
-    Per sweep: recompute (topic, broker) counts, per-topic band uppers,
-    role-resolved broker loads and replica counts; pick one follower
-    replica per over cell (one per partition); route each to its topic's
-    best destination — topic room, rack-distinct, not already hosting,
-    alive+receiving, strictly under effective capacity on EVERY resource,
-    under the replica-count band and ReplicaCapacity cap, utilization
-    < 0.9 (keeps the usage tiers from absorbing the shed load). One move
-    per destination per round makes the capacity/count checks exact.
+    Aggregates ((topic, broker) counts, role-resolved broker loads, replica
+    counts, per-disk loads) are built ONCE per call and maintained per
+    accepted move; per-topic totals — and so the band uppers and the
+    replica-count cap — are move-invariant and hoisted. Per sweep: pick one
+    follower replica per over cell (one per partition); route each to its
+    topic's best destination — live band room, rack-distinct, not already
+    hosting, alive+receiving, strictly under effective capacity on EVERY
+    resource, under the replica-count band and ReplicaCapacity cap,
+    utilization < 0.9 (keeps the usage tiers from absorbing the shed load).
+    One move per destination per round makes the capacity checks exact.
 
     Leadership never moves (followers only) and leader loads never shift,
     so the leader tiers and PLE are bit-unchanged. Host-side numpy like
@@ -379,34 +381,40 @@ def topic_rebalance(
     D = m.D
     disk_alive = np.asarray(m.disk_alive)                        # [B, D]
 
+    # Aggregates are built ONCE and maintained incrementally by the move
+    # loop (counts/bload/rc/dload all update per accepted move) — the
+    # round-4 profile showed the per-sweep O(P*R) scatter rebuilds were
+    # ~2.9 s of the 3.75 s call at B5 while every sweep after the first
+    # moves only hundreds of replicas. Per-topic totals (and so the band
+    # uppers and the replica-count cap) are invariant under moves.
+    valid = (a >= 0) & pvalid[:, None]   # moves never invalidate a slot
+    counts = np.zeros((T, B), np.int64)
+    np.add.at(counts, (tmat[valid], a[valid]), 1)
+    counts[:, ~alive] = 0
+    tot = counts.sum(1).astype(np.float64)
+    avg = tot / max(int(alive.sum()), 1)
+    upper = np.ceil(avg * thr)
+
+    bload = np.zeros((NUM_RESOURCES, B))
+    for res in range(NUM_RESOURCES):
+        np.add.at(bload[res], a[valid], slot_load[res][valid])
+    # per-disk DISK load for JBOD-safe placement of moved replicas
+    dload = np.zeros((B, D))
+    dvalid = valid & (dsk >= 0)
+    np.add.at(
+        dload,
+        (a[dvalid], np.clip(dsk, 0, D - 1)[dvalid]),
+        slot_load[int(Resource.DISK)][dvalid],
+    )
+    rc = np.bincount(a[valid], minlength=B).astype(np.int64)
+    rc_avg = rc[alive].sum() / max(int(alive.sum()), 1)
+    rc_cap = min(
+        int(np.floor(rc_avg * cfg.replica_balance_threshold)),
+        int(cfg.max_replicas_per_broker),
+    )
+
     for _ in range(max_sweeps):
-        valid = (a >= 0) & pvalid[:, None]
-        counts = np.zeros((T, B), np.int64)
-        np.add.at(counts, (tmat[valid], a[valid]), 1)
-        counts[:, ~alive] = 0
-        tot = counts.sum(1).astype(np.float64)
-        avg = tot / max(int(alive.sum()), 1)
-        upper = np.ceil(avg * thr)
-
-        bload = np.zeros((NUM_RESOURCES, B))
-        for res in range(NUM_RESOURCES):
-            np.add.at(bload[res], a[valid], slot_load[res][valid])
-        # per-disk DISK load for JBOD-safe placement of moved replicas
-        dload = np.zeros((B, D))
-        dvalid = valid & (dsk >= 0)
-        np.add.at(
-            dload,
-            (a[dvalid], np.clip(dsk, 0, D - 1)[dvalid]),
-            slot_load[int(Resource.DISK)][dvalid],
-        )
         util = np.max(bload / cap_eff, axis=0)
-        rc = np.bincount(a[valid], minlength=B).astype(np.int64)
-        rc_avg = rc[alive].sum() / max(int(alive.sum()), 1)
-        rc_cap = min(
-            int(np.floor(rc_avg * cfg.replica_balance_threshold)),
-            int(cfg.max_replicas_per_broker),
-        )
-
         over = counts > upper[:, None]
         cand = (
             valid
@@ -443,16 +451,16 @@ def topic_rebalance(
         # (width is min(B, rounds) — small clusters have fewer brokers than
         # rounds, so the round loop runs over the actual width)
         top_dest = np.argsort(-dest_score, axis=1)[:, :rounds_per_sweep]
-        intake = np.zeros((T, B), np.int64)
-        rc_now = rc.copy()
         moved = 0
         for k in range(top_dest.shape[1]):
             if ps.size == 0:
                 break
             dest = top_dest[ts, k]
             ok = np.isfinite(dest_score[ts, dest])
-            ok &= (room[ts, dest] - intake[ts, dest]) > 0
-            ok &= rc_now[dest] < rc_cap
+            # counts is maintained per move, so the band-room check is
+            # live (the old intake side-array measured vs sweep-start room)
+            ok &= (upper[ts] - counts[ts, dest]) > 0
+            ok &= rc[dest] < rc_cap
             ok &= ~(a[ps] == dest[:, None]).any(axis=1)
             rrows = np.where(a[ps] >= 0, rack[np.clip(a[ps], 0, B - 1)], -1)
             rrows[np.arange(ps.size), rs] = -1
@@ -487,9 +495,13 @@ def topic_rebalance(
                 np.add.at(
                     dload, (di, best_d), foll_load[int(Resource.DISK), ai]
                 )
-                np.add.at(intake, (ts[oi], di), 1)
-                np.subtract.at(rc_now, src, 1)
-                np.add.at(rc_now, di, 1)
+                # sources are always alive (dead-broker columns are zeroed
+                # in counts, so they are never over-band), so the live
+                # count update stays consistent with the init-time zeroing
+                np.subtract.at(counts, (ts[oi], src), 1)
+                np.add.at(counts, (ts[oi], di), 1)
+                np.subtract.at(rc, src, 1)
+                np.add.at(rc, di, 1)
                 moved += oi.size
                 keep = np.ones(ps.size, bool)
                 keep[oi] = False
