@@ -290,6 +290,7 @@ def main() -> None:
 
     backend_forced = None
     probe_failed = False
+    probe_saw_tpu = False
     if os.environ.get("CCX_BENCH_CPU") == "1":
         backend_forced = "cpu (CCX_BENCH_CPU=1)"
     else:
@@ -302,15 +303,21 @@ def main() -> None:
         # path, so no error can orphan a claim-holding child.
         probe_timeout = int(os.environ.get("CCX_BENCH_PROBE_TIMEOUT", "120"))
         probe = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL,
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
+            text=True,
         )
         try:
             rc = probe.wait(timeout=probe_timeout)
             if rc != 0:
                 backend_forced = f"cpu (device probe rc={rc})"
                 probe_failed = True
+            elif probe.stdout is not None:
+                # record whether an actual TPU answered — probe success
+                # alone also covers CPU-only hosts (jax falls back with
+                # rc=0), which must not trigger the TPU-ladder extras
+                probe_saw_tpu = "tpu" in (probe.stdout.read() or "").lower()
         except subprocess.TimeoutExpired:
             backend_forced = "cpu (device probe timed out — TPU wedged?)"
             probe_failed = True
@@ -340,7 +347,8 @@ def main() -> None:
     # Skip: CCX_BENCH_CPU_FIRST=0; the subprocess marks itself with
     # CCX_BENCH_SUBRUN to avoid recursion.
     if (
-        not backend_forced
+        probe_saw_tpu
+        and not backend_forced
         and os.environ.get("CCX_BENCH_CPU_FIRST", "1") == "1"
         and os.environ.get("CCX_BENCH_SUBRUN") != "1"
     ):

@@ -1,0 +1,66 @@
+"""Driver-contract regression test for bench.py.
+
+The driver runs ``python bench.py`` and parses the LAST line of COMBINED
+stdout+stderr output as the result JSON (BENCH_r{N}.json). Round 3 lost its
+official perf number to two stray log lines trailing the JSON; this test
+pins the contract so it can never silently regress again:
+
+* rc == 0,
+* the last combined-output line parses as JSON,
+* it carries a numeric "value"/"vs_baseline" and is a COMPLETED rung
+  (never a partial dump),
+* the effort dict is self-describing (chains/steps/moves/polish/portfolio).
+
+Runs the real bench end-to-end (B1, CPU, tiny custom effort) in a
+subprocess — ~30-60 s warm via the shared .jax_cache.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_last_combined_line_is_result_json():
+    env = dict(
+        os.environ,
+        CCX_BENCH="B1",
+        CCX_BENCH_CPU="1",
+        CCX_BENCH_SKIP_SMOKE="1",
+        # all four knobs -> one collapsed "custom" rung, tiny and fast
+        CCX_BENCH_CHAINS="4",
+        CCX_BENCH_STEPS="50",
+        CCX_BENCH_MOVES="2",
+        CCX_BENCH_POLISH_ITERS="10",
+    )
+    # tests/conftest pins JAX_PLATFORMS=cpu in THIS process; the subprocess
+    # must make its own choice (CCX_BENCH_CPU=1 above)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,  # the driver parses COMBINED output
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    last = lines[-1]
+    r = json.loads(last)  # the contract: last combined line IS the JSON
+    assert "partial" not in r, last
+    assert isinstance(r["value"], (int, float)) and r["value"] > 0
+    assert isinstance(r["vs_baseline"], (int, float))
+    assert r["metric"].startswith("B1 ")
+    assert r["rung"] == "custom"
+    assert {"chains", "steps", "moves", "polish_iters", "portfolio"} <= set(
+        r["effort"]
+    )
+    assert r["effort"]["chains"] == 4 and r["effort"]["steps"] == 50

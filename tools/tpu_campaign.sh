@@ -27,7 +27,7 @@ L="${1:-tpu_campaign.log}"
   # grep STDOUT only: stderr init-failure text can itself mention "tpu"
   # (e.g. "Unable to initialize backend 'tpu'") and must not pass the gate
   probe_err="$(mktemp)"
-  probe_out="$(timeout 90 python -c "import jax; print(jax.devices())" 2>"$probe_err")"
+  probe_out="$(timeout -k 60 90 python -c "import jax; print(jax.devices())" 2>"$probe_err")"
   cat "$probe_err"; rm -f "$probe_err"
   echo "$probe_out"
   if ! grep -qi tpu <<<"$probe_out"; then
@@ -35,20 +35,20 @@ L="${1:-tpu_campaign.log}"
     exit 1
   fi
   echo "--- bench pass 1 (cold compiles -> persistent cache) ---"
-  CCX_BENCH_CPU_FIRST=0 timeout 5400 python bench.py
+  CCX_BENCH_CPU_FIRST=0 timeout -k 60 5400 python bench.py
   echo "bench pass 1 rc=$?"
   echo "--- bench pass 2 (warm cache; official-style numbers) ---"
-  CCX_BENCH_CPU_FIRST=0 timeout 2400 python bench.py
+  CCX_BENCH_CPU_FIRST=0 timeout -k 60 2400 python bench.py
   echo "bench pass 2 rc=$?"
   echo "--- MXU aggregates A/B at B5 ---"
-  CCX_MXU_AGGREGATES=0 timeout 1200 python tools/probe_mxu.py B5
+  CCX_MXU_AGGREGATES=0 timeout -k 60 1200 python tools/probe_mxu.py B5
   echo "xla rc=$?"
-  CCX_MXU_AGGREGATES=1 timeout 1800 python tools/probe_mxu.py B5
+  CCX_MXU_AGGREGATES=1 timeout -k 60 1800 python tools/probe_mxu.py B5
   echo "mxu rc=$?"
   echo "--- batched-SA moves sweep (16 then 32 moves/step) ---"
-  PROBE_BATCHED=1 PROBE_MOVES=16 PROBE_CHAINS=16 timeout 1800 python tools/probe_b5.py B5
+  PROBE_BATCHED=1 PROBE_MOVES=16 PROBE_CHAINS=16 timeout -k 60 1800 python tools/probe_b5.py B5
   echo "moves-16 rc=$?"
-  PROBE_BATCHED=1 PROBE_MOVES=32 PROBE_CHAINS=16 timeout 1800 python tools/probe_b5.py B5
+  PROBE_BATCHED=1 PROBE_MOVES=32 PROBE_CHAINS=16 timeout -k 60 1800 python tools/probe_b5.py B5
   echo "moves-32 rc=$?"
   echo "--- remaining BASELINE configs on hardware (B1-B4, lean effort) ---"
   # pin all four effort knobs to the lean values: bench collapses to ONE
@@ -59,7 +59,7 @@ L="${1:-tpu_campaign.log}"
     CCX_BENCH="$c" CCX_BENCH_CPU_FIRST=0 \
       CCX_BENCH_CHAINS=16 CCX_BENCH_STEPS=1000 CCX_BENCH_MOVES=8 \
       CCX_BENCH_POLISH_ITERS=400 CCX_BENCH_PORTFOLIO=0 \
-      timeout 1800 python bench.py
+      timeout -k 60 1800 python bench.py
     echo "$c rc=$?"
   done
   echo "=== TPU campaign end $(date -u +%FT%TZ) ==="
