@@ -1,0 +1,85 @@
+"""Accelerator liveness safeguard shared by the long-running entry points.
+
+The axon TPU tunnel can wedge such that ``jax.devices()`` hangs forever in
+ANY process (docs/perf-notes.md wedge etiology). A service or sidecar that
+initializes the backend lazily would boot, serve its first status endpoint,
+and then hang every optimizer call — a hung service instead of a degraded
+one. ``ensure_responsive_backend()`` is called before first backend use by
+``python -m ccx`` (service) and ``python -m ccx.sidecar.server``:
+
+* ``CCX_JAX_PLATFORM`` set -> apply it (the operator escape hatch; plain
+  ``JAX_PLATFORMS`` is ignored because sitecustomize preloads jax) and skip
+  the probe;
+* otherwise probe ``jax.devices()`` in a SUBPROCESS with a timeout
+  (``CCX_DEVICE_PROBE_TIMEOUT`` seconds, default 60, 0/invalid-value-safe);
+  on rc!=0 or timeout, force the CPU platform and log a warning.
+
+The probe child is SIGTERMed with grace and only then killed — killing a
+client outright mid device claim is what CAUSES the wedge — and reaping is
+bounded so a child stuck in uninterruptible device I/O can never hang the
+caller. Mirrors bench.py's probe (the reference pattern).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+
+log = logging.getLogger(__name__)
+
+
+def ensure_responsive_backend(timeout_s: int | None = None) -> bool:
+    """Apply CCX_JAX_PLATFORM or probe the accelerator; force CPU on
+    failure. Returns True when the configured/probed backend is usable
+    without forcing a fallback."""
+    forced = os.environ.get("CCX_JAX_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+        log.info("jax platform forced to %s (CCX_JAX_PLATFORM)", forced)
+        return True
+
+    if timeout_s is None:
+        raw = os.environ.get("CCX_DEVICE_PROBE_TIMEOUT", "60")
+        try:
+            timeout_s = int(raw)
+        except ValueError:
+            log.warning(
+                "CCX_DEVICE_PROBE_TIMEOUT=%r is not an integer; using 60",
+                raw,
+            )
+            timeout_s = 60
+    if timeout_s <= 0:
+        return True
+
+    probe = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        if probe.wait(timeout=timeout_s) == 0:
+            return True
+        reason = f"device probe rc={probe.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = "device probe timed out — accelerator wedged?"
+    finally:
+        if probe.poll() is None:
+            probe.terminate()
+            try:
+                probe.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                probe.kill()
+                try:
+                    probe.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    log.warning("%s; optimizer falling back to the CPU backend", reason)
+    return False

@@ -17,11 +17,11 @@ cluster adapter is the production path.
 from __future__ import annotations
 
 import logging
-import os
 import signal
 import sys
 
 from ccx.config import CruiseControlConfig
+from ccx.common.device import ensure_responsive_backend
 from ccx.servlet.server import CruiseControlApp
 from ccx.service.facade import CruiseControl
 
@@ -46,16 +46,11 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    # Operator backend override (e.g. CCX_JAX_PLATFORM=cpu when the TPU
-    # tunnel is unavailable). Must go through jax.config before first
-    # backend use — the environment preloads jax via sitecustomize, so
-    # JAX_PLATFORMS alone is ignored.
-    platform = os.environ.get("CCX_JAX_PLATFORM")
-    if platform:
-        import jax
-
-        jax.config.update("jax_platforms", platform)
-        logging.info("jax platform forced to %s (CCX_JAX_PLATFORM)", platform)
+    # Operator backend override (CCX_JAX_PLATFORM=cpu) or, absent one, a
+    # wedged-accelerator probe with CPU fallback — without this the service
+    # would boot, serve /state, and then hang every optimizer verb on first
+    # backend use (ccx.common.device docstring).
+    ensure_responsive_backend()
     if argv:
         cfg = CruiseControlConfig.from_properties_file(argv[0])
     else:

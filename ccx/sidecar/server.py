@@ -194,6 +194,12 @@ def main(argv=None) -> int:
     ap.add_argument("--address", default="127.0.0.1:50051")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # same wedged-accelerator safeguard as the service entry point: a hung
+    # jax.devices() must degrade the sidecar to CPU, not hang every RPC
+    # (applies CCX_JAX_PLATFORM too — ccx.common.device)
+    from ccx.common.device import ensure_responsive_backend
+
+    ensure_responsive_backend()
     server, port = make_grpc_server(address=args.address)
     server.start()
     log.info("optimizer sidecar listening on port %s", port)
