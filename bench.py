@@ -294,47 +294,25 @@ def main() -> None:
     if os.environ.get("CCX_BENCH_CPU") == "1":
         backend_forced = "cpu (CCX_BENCH_CPU=1)"
     else:
-        # SIGTERM + grace, never a straight SIGKILL: subprocess.run's
-        # timeout path kills the child outright, and a probe client
-        # SIGKILLed while holding the device claim is exactly what wedges
-        # the axon relay for every later client (perf-notes wedge
-        # etiology). terminate() lets the claim be released. The timeout is
-        # parsed BEFORE the probe spawns and the finally-block reaps every
-        # path, so no error can orphan a claim-holding child.
+        # The probe/reap discipline (SIGTERM + grace, never a straight
+        # SIGKILL — killing a client mid device claim is what wedges the
+        # axon relay) lives in ONE place: ccx.common.device.probe_devices,
+        # shared with the service/sidecar startup safeguard.
+        from ccx.common.device import probe_devices
+
         probe_timeout = int(os.environ.get("CCX_BENCH_PROBE_TIMEOUT", "120"))
-        probe = subprocess.Popen(
-            [sys.executable, "-c", "import jax; print(jax.devices())"],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            text=True,
-        )
-        try:
-            rc = probe.wait(timeout=probe_timeout)
-            if rc != 0:
-                backend_forced = f"cpu (device probe rc={rc})"
-                probe_failed = True
-            elif probe.stdout is not None:
-                # record whether an actual TPU answered — probe success
-                # alone also covers CPU-only hosts (jax falls back with
-                # rc=0), which must not trigger the TPU-ladder extras
-                probe_saw_tpu = "tpu" in (probe.stdout.read() or "").lower()
-        except subprocess.TimeoutExpired:
+        rc, probe_out = probe_devices(probe_timeout, capture_stdout=True)
+        if rc is None:
             backend_forced = "cpu (device probe timed out — TPU wedged?)"
             probe_failed = True
-        finally:
-            if probe.poll() is None:
-                probe.terminate()
-                try:
-                    probe.wait(timeout=15)
-                except subprocess.TimeoutExpired:
-                    probe.kill()
-                    try:
-                        # a child stuck in uninterruptible device I/O can
-                        # survive SIGKILL until the kernel releases it —
-                        # never let reaping block the fallback run
-                        probe.wait(timeout=5)
-                    except subprocess.TimeoutExpired:
-                        pass
+        elif rc != 0:
+            backend_forced = f"cpu (device probe rc={rc})"
+            probe_failed = True
+        else:
+            # record whether an actual TPU answered — probe success alone
+            # also covers CPU-only hosts (jax falls back with rc=0), which
+            # must not trigger the TPU-ladder extras
+            probe_saw_tpu = "tpu" in probe_out.lower()
     if backend_forced:
         log(f"FALLING BACK to {backend_forced}")
 

@@ -30,6 +30,43 @@ import sys
 log = logging.getLogger(__name__)
 
 
+def probe_devices(timeout_s: int, capture_stdout: bool = False):
+    """Probe ``jax.devices()`` in a subprocess with the wedge-safe reap
+    ladder. Returns ``(rc, stdout)``: rc is the child's exit code or None
+    on timeout; stdout is the captured device listing ("" unless
+    ``capture_stdout``). This is the ONE implementation of the
+    SIGTERM-grace-then-kill discipline — bench.py and the service/sidecar
+    entry points all route through it, so etiology learnings land once."""
+    probe = subprocess.Popen(
+        [sys.executable, "-c", "import jax; print(jax.devices())"],
+        stdout=subprocess.PIPE if capture_stdout else subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    rc: int | None
+    out = ""
+    try:
+        rc = probe.wait(timeout=timeout_s)
+        if capture_stdout and probe.stdout is not None:
+            out = probe.stdout.read() or ""
+    except subprocess.TimeoutExpired:
+        rc = None
+    finally:
+        if probe.poll() is None:
+            probe.terminate()
+            try:
+                probe.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                probe.kill()
+                try:
+                    # a child stuck in uninterruptible device I/O can
+                    # survive SIGKILL — never let reaping block the caller
+                    probe.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+    return rc, out
+
+
 def ensure_responsive_backend(timeout_s: int | None = None) -> bool:
     """Apply CCX_JAX_PLATFORM or probe the accelerator; force CPU on
     failure. Returns True when the configured/probed backend is usable
@@ -52,32 +89,24 @@ def ensure_responsive_backend(timeout_s: int | None = None) -> bool:
                 raw,
             )
             timeout_s = 60
-    if timeout_s <= 0:
+        if timeout_s < 0:
+            # only an explicit 0 disables the safeguard — a negative value
+            # is a typo/templating bug, not a request to run unprotected
+            log.warning(
+                "CCX_DEVICE_PROBE_TIMEOUT=%s is negative; using 60", timeout_s
+            )
+            timeout_s = 60
+    if timeout_s == 0:
         return True
 
-    probe = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
+    rc, _ = probe_devices(timeout_s)
+    if rc == 0:
+        return True
+    reason = (
+        "device probe timed out — accelerator wedged?"
+        if rc is None
+        else f"device probe rc={rc}"
     )
-    try:
-        if probe.wait(timeout=timeout_s) == 0:
-            return True
-        reason = f"device probe rc={probe.returncode}"
-    except subprocess.TimeoutExpired:
-        reason = "device probe timed out — accelerator wedged?"
-    finally:
-        if probe.poll() is None:
-            probe.terminate()
-            try:
-                probe.wait(timeout=15)
-            except subprocess.TimeoutExpired:
-                probe.kill()
-                try:
-                    probe.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    pass
-
     import jax
 
     jax.config.update("jax_platforms", "cpu")

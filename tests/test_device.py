@@ -1,0 +1,141 @@
+"""ccx.common.device — wedged-accelerator safeguard unit tests.
+
+The probe subprocess itself cannot be exercised against a real wedge in CI,
+so these tests pin the decision logic around it: override precedence, the
+invalid-timeout guard, rc/timeout fallback paths (via a monkeypatched
+Popen), and the bounded-reap discipline (terminate before kill, never a
+bare SIGKILL first — killing a client mid device claim is what causes the
+wedge, docs/perf-notes.md).
+"""
+
+import subprocess
+
+import pytest
+
+from ccx.common import device
+
+
+class FakeProbe:
+    def __init__(self, rc=None, hang=False):
+        self._rc = rc
+        self._hang = hang
+        self.calls = []
+
+    @property
+    def returncode(self):
+        return self._rc
+
+    def wait(self, timeout=None):
+        self.calls.append(("wait", timeout))
+        if self._hang:
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+        return self._rc
+
+    def poll(self):
+        self.calls.append(("poll",))
+        return self._rc
+
+    def terminate(self):
+        self.calls.append(("terminate",))
+        self._rc = -15  # reaped after SIGTERM
+
+    def kill(self):
+        self.calls.append(("kill",))
+        self._rc = -9
+
+
+@pytest.fixture
+def no_env(monkeypatch):
+    monkeypatch.delenv("CCX_JAX_PLATFORM", raising=False)
+    monkeypatch.delenv("CCX_DEVICE_PROBE_TIMEOUT", raising=False)
+
+
+def _patch_probe(monkeypatch, probe):
+    monkeypatch.setattr(
+        device.subprocess, "Popen", lambda *a, **k: probe
+    )
+
+
+@pytest.fixture
+def config_updates(monkeypatch):
+    """Record jax.config.update calls — the suite conftest already pins
+    jax_platforms='cpu', so asserting the config VALUE would pass even if
+    the module never touched it."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: calls.append((k, v))
+    )
+    return calls
+
+
+def test_override_applies_platform_and_skips_probe(monkeypatch, config_updates):
+    monkeypatch.setenv("CCX_JAX_PLATFORM", "cpu")
+    called = []
+    monkeypatch.setattr(
+        device.subprocess, "Popen",
+        lambda *a, **k: called.append(1) or (_ for _ in ()).throw(
+            AssertionError("probe must not run under override")
+        ),
+    )
+    assert device.ensure_responsive_backend() is True
+    assert not called
+    assert ("jax_platforms", "cpu") in config_updates
+
+
+def test_zero_timeout_disables_probe(no_env, monkeypatch):
+    monkeypatch.setenv("CCX_DEVICE_PROBE_TIMEOUT", "0")
+    _patch_probe(monkeypatch, FakeProbe(rc=1))
+    assert device.ensure_responsive_backend() is True  # probe skipped
+
+
+def test_invalid_timeout_defaults_instead_of_crashing(no_env, monkeypatch):
+    monkeypatch.setenv("CCX_DEVICE_PROBE_TIMEOUT", "60s")
+    probe = FakeProbe(rc=0)
+    _patch_probe(monkeypatch, probe)
+    assert device.ensure_responsive_backend() is True
+    assert ("wait", 60) in probe.calls  # fell back to the 60 s default
+
+
+def test_negative_timeout_warns_and_defaults(no_env, monkeypatch):
+    monkeypatch.setenv("CCX_DEVICE_PROBE_TIMEOUT", "-60")
+    probe = FakeProbe(rc=0)
+    _patch_probe(monkeypatch, probe)
+    assert device.ensure_responsive_backend() is True
+    assert ("wait", 60) in probe.calls  # negative != disable; only 0 is
+
+
+def test_healthy_probe_keeps_backend(no_env, monkeypatch):
+    probe = FakeProbe(rc=0)
+    _patch_probe(monkeypatch, probe)
+    assert device.ensure_responsive_backend(timeout_s=5) is True
+    assert ("terminate",) not in probe.calls
+
+
+def test_failed_probe_forces_cpu(no_env, monkeypatch, config_updates):
+    probe = FakeProbe(rc=3)
+    _patch_probe(monkeypatch, probe)
+    assert device.ensure_responsive_backend(timeout_s=5) is False
+    assert ("jax_platforms", "cpu") in config_updates
+
+
+def test_hung_probe_terminates_with_grace_then_falls_back(no_env, monkeypatch, config_updates):
+    probe = FakeProbe(hang=True)
+
+    # first wait() raises TimeoutExpired (the probe timeout); the reaper's
+    # grace wait must succeed after terminate()
+    orig_wait = probe.wait
+
+    def wait(timeout=None):
+        if ("terminate",) in probe.calls:
+            probe.calls.append(("wait", timeout))
+            return -15
+        return orig_wait(timeout)
+
+    probe.wait = wait
+    _patch_probe(monkeypatch, probe)
+    assert device.ensure_responsive_backend(timeout_s=5) is False
+    assert ("terminate",) in probe.calls
+    assert ("kill",) not in probe.calls  # SIGTERM sufficed; no SIGKILL
+    assert ("jax_platforms", "cpu") in config_updates
