@@ -208,11 +208,16 @@ def run_config(name: str, rung: str) -> dict:
                 and os.environ.get("CCX_BENCH_PORTFOLIO") != "0"
             )
         ),
-        # latency-floor settings for the T1 chase; every other rung keeps
-        # the pipeline defaults
+        # latency-floor settings for the T1 chase; lean — and custom, which
+        # the campaign pins to lean effort for comparability — bound the
+        # TRD shed at 128 sweeps/round (measured: 2x128 matches one
+        # converged round's end state at -15 s); full keeps the converged
+        # default
         **(
             {"topic_rebalance_rounds": 0, "leader_pass_max_iters": 150}
             if rung == "target"
+            else {"topic_rebalance_max_sweeps": 128}
+            if rung in ("lean", "custom")
             else {}
         ),
     )

@@ -201,6 +201,13 @@ def analyzer_config_def() -> ConfigDef:
              "stage (each enumerates over-band (topic, broker) cells, "
              "re-polishes, and is adopted only on full-vector lex "
              "improvement). 0 disables.", at_least(0))
+    d.define("optimizer.topic.rebalance.max.sweeps", Type.INT, 1024,
+             Importance.LOW,
+             "Per-round sweep cap for the topic-rebalance stage. The sweep "
+             "loop stops on its own when no move lands, so this is a "
+             "latency bound, not a convergence knob; the default lets a "
+             "round run to convergence. Latency-critical callers lower it.",
+             at_least(1))
     d.define("optimizer.polish.batch.moves", Type.INT, 16, Importance.LOW,
              "Non-conflicting improving moves applied per polish iteration "
              "(disjoint partitions/topics/broker sets; 1 = classic "

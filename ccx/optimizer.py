@@ -152,10 +152,17 @@ class OptimizeOptions:
     #: trade some of the sweep's TRD cut back for higher-tier (usage)
     #: gains — legitimate under goal priority — but each cycle leaves the
     #: higher tiers closer to their floor, so the next sweep's cut sticks
-    #: better. 0 disables. Cost per round: one topic_rebalance call (which
-    #: itself sweeps to convergence, up to its max_sweeps=16 at ~3 s/sweep
-    #: at B5 — typically a handful) + one polish run.
+    #: better. 0 disables. Cost per round: one topic_rebalance call
+    #: (bounded by topic_rebalance_max_sweeps below — a converged round is
+    #: ~14 s / 43k moves at B5) + one polish run.
     topic_rebalance_rounds: int = 2
+    #: per-round sweep cap for repair.topic_rebalance. The sweep loop is
+    #: self-limiting (stops at moved==0), so this is a latency bound, not a
+    #: convergence knob: 1024 lets a round run to convergence (B5 from a
+    #: raw snapshot: 43k moves / ~14 s, TRD 45.8k -> 10.4k WITH usage and
+    #: rack side-improvements — round 4 measured; the old 16 was starving
+    #: the shed at ~5k moves). Latency-critical callers lower it.
+    topic_rebalance_max_sweeps: int = 1024
     #: optional iteration cap for the final leadership-only pass (None =
     #: inherit polish.max_iters). Measured at B5 full effort: leadership-only
     #: iterations are CHEAP (~11 ms vs ~70 ms placement polish) and the pass
@@ -290,7 +297,9 @@ def optimize(
         t = _enter("topic-rebalance")
         with annotate("ccx:topic-rebalance"):
             for _ in range(opts.topic_rebalance_rounds):
-                swept, n_swept = topic_rebalance(model, cfg)
+                swept, n_swept = topic_rebalance(
+                    model, cfg, max_sweeps=opts.topic_rebalance_max_sweeps
+                )
                 if not n_swept:
                     break
                 cand = greedy_optimize(swept, cfg, goal_names, opts.polish)

@@ -313,7 +313,10 @@ def canonicalize_preferred_leaders(
 def topic_rebalance(
     m: TensorClusterModel,
     cfg: GoalConfig,
-    max_sweeps: int = 16,
+    # latency bound only — the loop stops at moved==0; 1024 lets a call run
+    # to convergence (43k moves / ~14 s at B5; 16 was starving the shed at
+    # ~5.3k moves, the round-4 sweep-budget finding in docs/perf-notes.md)
+    max_sweeps: int = 1024,
     rounds_per_sweep: int = 16,
     seed: int = 23,
 ) -> tuple[TensorClusterModel, int]:

@@ -154,6 +154,9 @@ class CruiseControl:
                 0 if (leadership_only or disk_only)
                 else self.config["optimizer.topic.rebalance.rounds"]
             ),
+            topic_rebalance_max_sweeps=self.config[
+                "optimizer.topic.rebalance.max.sweeps"
+            ],
             # the portfolio candidate roughly doubles polish-phase cost;
             # never pay it on the leadership-/disk-only fast paths
             run_cold_greedy=(

@@ -126,6 +126,9 @@ class OptimizerSidecar:
             ),
             check_evacuation=bool(o.get("check_evacuation", True)),
             topic_rebalance_rounds=int(o.get("topic_rebalance_rounds", 2)),
+            topic_rebalance_max_sweeps=int(
+                o.get("topic_rebalance_max_sweeps", 1024)
+            ),
         )
         yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}
         res = optimize(model, self.goal_config, goals, opts)

@@ -110,11 +110,13 @@ def test_optimizer_option_plumbing(tmp_path):
         **{
             "optimizer.chunk.steps": 123,
             "optimizer.topic.rebalance.rounds": 5,
+            "optimizer.topic.rebalance.max.sweeps": 77,
         },
     )
     opts = cc._optimize_options()
     assert opts.anneal.chunk_steps == 123
     assert opts.topic_rebalance_rounds == 5
+    assert opts.topic_rebalance_max_sweeps == 77
     lead = cc._optimize_options(leadership_only=True)
     assert lead.topic_rebalance_rounds == 0  # cannot move replica counts
     disk = cc._optimize_options(disk_only=True)
