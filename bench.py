@@ -210,13 +210,18 @@ def run_config(name: str, rung: str) -> dict:
         ),
         # latency-floor settings for the T1 chase; lean — and custom, which
         # the campaign pins to lean effort for comparability — bound the
-        # TRD shed at 128 sweeps/round (measured: 2x128 matches one
-        # converged round's end state at -15 s); full keeps the converged
-        # default
+        # TRD shed at 128 sweeps/round with the followers-only mode
+        # (measured: leadership transfers only pay at deep sweep budgets;
+        # at 2x128 they crowd out cheaper follower moves — 99 s for TRD
+        # 5.9k vs 55 s for 11.9k). full keeps the converged leader-moving
+        # default (TRD 5.7k, leader tiers BETTER via the final leader pass).
         **(
             {"topic_rebalance_rounds": 0, "leader_pass_max_iters": 150}
             if rung == "target"
-            else {"topic_rebalance_max_sweeps": 128}
+            else {
+                "topic_rebalance_max_sweeps": 128,
+                "topic_rebalance_move_leaders": False,
+            }
             if rung in ("lean", "custom")
             else {}
         ),

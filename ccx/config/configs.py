@@ -201,6 +201,13 @@ def analyzer_config_def() -> ConfigDef:
              "stage (each enumerates over-band (topic, broker) cells, "
              "re-polishes, and is adopted only on full-vector lex "
              "improvement). 0 disables.", at_least(0))
+    d.define("optimizer.topic.rebalance.move.leaders", Type.BOOLEAN, True,
+             Importance.LOW,
+             "Let the topic-rebalance stage shed leader-held over cells by "
+             "transferring leadership to a co-replica first (hard-safe; "
+             "the final leadership pass rebalances afterwards). Disable "
+             "for latency-bounded sweeps where follower moves are "
+             "cheaper.")
     d.define("optimizer.topic.rebalance.max.sweeps", Type.INT, 1024,
              Importance.LOW,
              "Per-round sweep cap for the topic-rebalance stage. The sweep "

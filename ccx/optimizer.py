@@ -163,6 +163,13 @@ class OptimizeOptions:
     #: rack side-improvements — round 4 measured; the old 16 was starving
     #: the shed at ~5k moves). Latency-critical callers lower it.
     topic_rebalance_max_sweeps: int = 1024
+    #: let the TRD shed move leader-held over cells via leadership transfer
+    #: (repair.topic_rebalance move_leaders). Measured at B5 full effort:
+    #: TRD end state 13.7k -> 5.7k with leader tiers BETTER (the final
+    #: leader pass rebalances what the transfers disturb). At shallow sweep
+    #: budgets the transfers crowd out cheaper follower moves — the bench
+    #: lean rung disables this and keeps the followers-only shed.
+    topic_rebalance_move_leaders: bool = True
     #: optional iteration cap for the final leadership-only pass (None =
     #: inherit polish.max_iters). Measured at B5 full effort: leadership-only
     #: iterations are CHEAP (~11 ms vs ~70 ms placement polish) and the pass
@@ -298,7 +305,9 @@ def optimize(
         with annotate("ccx:topic-rebalance"):
             for _ in range(opts.topic_rebalance_rounds):
                 swept, n_swept = topic_rebalance(
-                    model, cfg, max_sweeps=opts.topic_rebalance_max_sweeps
+                    model, cfg,
+                    max_sweeps=opts.topic_rebalance_max_sweeps,
+                    move_leaders=opts.topic_rebalance_move_leaders,
                 )
                 if not n_swept:
                     break

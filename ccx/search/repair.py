@@ -319,6 +319,11 @@ def topic_rebalance(
     max_sweeps: int = 1024,
     rounds_per_sweep: int = 16,
     seed: int = 23,
+    #: allow shedding leader-held over cells by transferring leadership to a
+    #: co-replica first (round-4 diagnosis: after the followers-only shed
+    #: converges, EVERY residual over-cell replica is a leader). False
+    #: restores the leadership-untouched contract.
+    move_leaders: bool = True,
 ) -> tuple[TensorClusterModel, int]:
     """Targeted TopicReplicaDistribution sweep: shed (topic, broker) cells
     above their per-topic band by relocating follower replicas to brokers
@@ -346,9 +351,16 @@ def topic_rebalance(
     utilization < 0.9 (keeps the usage tiers from absorbing the shed load).
     One move per destination per round makes the capacity checks exact.
 
-    Leadership never moves (followers only) and leader loads never shift,
-    so the leader tiers and PLE are bit-unchanged. Host-side numpy like
-    ``canonicalize_preferred_leaders`` (one [P, R] transfer; ~3 s at B5).
+    Followers are always preferred; with ``move_leaders`` (default) a
+    leader-held over cell is shed by first transferring leadership to a
+    co-replica (hard-safe: the new-leader broker must accept leadership,
+    absorb the leader-load delta within strict capacity, and MTL-flagged
+    topics keep their per-broker leader minimum at the source). Leader
+    tiers may shift — they sit BELOW TopicReplicaDistribution in the goal
+    order, the optimizer adopts rounds lex-guarded, and the pipeline's
+    final leadership pass rebalances them. With ``move_leaders=False``
+    leadership and leader loads are bit-unchanged. Host-side numpy like
+    ``canonicalize_preferred_leaders`` (one [P, R] transfer).
     Returns (model, moves applied).
     """
     a = np.asarray(m.assignment).copy()
@@ -359,7 +371,7 @@ def topic_rebalance(
     recv_ok = alive & ~np.asarray(m.broker_excl_replicas)
     imm = np.asarray(m.partition_immovable)
     rack = np.asarray(m.broker_rack)
-    lslot = np.asarray(m.leader_slot)
+    lslot = np.asarray(m.leader_slot).copy()
     T, B, P, R = m.num_topics, m.B, m.P, m.R
     from ccx.common.resources import NUM_RESOURCES, Resource
 
@@ -374,9 +386,10 @@ def topic_rebalance(
 
     is_l = np.zeros((P, R), bool)
     is_l[np.arange(P), np.clip(lslot, 0, R - 1)] = True
-    # sweep-invariant: leadership never moves, so role-resolved slot loads
-    # and the topic matrix are fixed for the whole call ([RES, P, R] is
-    # tens of MB at B5 — build once)
+    # role-resolved slot loads and the topic matrix ([RES, P, R] is tens of
+    # MB at B5 — build once). NOT invariant: with move_leaders, a
+    # leadership transfer updates is_l/slot_load in place for the two
+    # affected slots; never cache anything derived from them across moves.
     tmat = np.repeat(topic, R).reshape(P, R)
     slot_load = np.where(
         is_l[None], lead_load[:, :, None], foll_load[:, :, None]
@@ -416,27 +429,66 @@ def topic_rebalance(
         int(cfg.max_replicas_per_broker),
     )
 
+    # leadership-transfer support (move_leaders): the followers-only shed
+    # converges with EVERY residual over-cell replica being its partition's
+    # leader (round-4 diagnosis: 21,860 of 21,860 at B5 — the binding
+    # constraint was role, not room/rack/capacity). A leader candidate is
+    # moved by first transferring leadership to a co-replica (the reference
+    # expresses this as a LEADERSHIP_MOVEMENT + replica move; leader tiers
+    # sit BELOW TopicReplicaDistribution in the goal order, so the trade is
+    # lex-legitimate and the pipeline's final leader pass rebalances
+    # leadership afterwards). Hard-goal safety: the new-leader broker must
+    # accept leadership (not excluded), absorb the leader-load delta within
+    # strict capacity, and — for topics under MinTopicLeadersPerBroker —
+    # the source broker must keep >= k leaders of the topic.
+    excl_lead = np.asarray(m.broker_excl_leadership)
+    tmin = np.asarray(m.topic_min_leaders)
+    need_tlc = move_leaders and bool(tmin.any())
+    if need_tlc:
+        tlc = np.zeros((T, B), np.int64)
+        lv = valid & is_l
+        np.add.at(tlc, (tmat[lv], a[lv]), 1)
+        k_min = int(cfg.min_topic_leaders_per_broker)
+
     for _ in range(max_sweeps):
         util = np.max(bload / cap_eff, axis=0)
         over = counts > upper[:, None]
-        cand = (
-            valid
-            & over[tmat, np.clip(a, 0, B - 1)]
-            & ~imm[:, None]
-            & ~is_l                                  # followers only
+        on_over = (
+            valid & over[tmat, np.clip(a, 0, B - 1)] & ~imm[:, None]
         )
-        ps, rs = np.nonzero(cand)
-        if ps.size == 0:
+        cand_f = on_over & ~is_l
+        pf, rf = np.nonzero(cand_f)
+        if move_leaders:
+            # leaders need a co-replica to hand leadership to
+            cand_l = on_over & is_l & (valid.sum(1) >= 2)[:, None]
+            pl, rl = np.nonzero(cand_l)
+        else:
+            pl = rl = np.zeros(0, np.int64)
+        if pf.size + pl.size == 0:
             break
-        # one candidate per partition AND per (topic, src broker) cell
-        order = rng.permutation(ps.size)
-        ps, rs = ps[order], rs[order]
-        _, fp = np.unique(ps, return_index=True)
+        # one candidate per partition AND per (topic, src broker) cell —
+        # followers FIRST so a cell with both sheds the cheaper follower
+        # (no leader-tier disturbance); permutation keeps cell picks fair
+        of = rng.permutation(pf.size)
+        ol = rng.permutation(pl.size)
+        ps = np.concatenate([pf[of], pl[ol]])
+        rs = np.concatenate([rf[of], rl[ol]])
+        # np.unique picks each value's FIRST occurrence but returns indices
+        # in value order — np.sort restores array order so the
+        # followers-before-leaders priority actually survives both dedups
+        fp = np.sort(np.unique(ps, return_index=True)[1])
         ps, rs = ps[fp], rs[fp]
         cell = topic[ps].astype(np.int64) * B + a[ps, rs]
-        _, fc = np.unique(cell, return_index=True)
+        fc = np.sort(np.unique(cell, return_index=True)[1])
         ps, rs = ps[fc], rs[fc]
         ts = topic[ps]
+        lead_row = is_l[ps, rs]
+        # new-leader slot: first OTHER valid replica slot (leader pass
+        # re-optimizes placement later); b2 = its broker
+        ov = valid[ps].copy()
+        ov[np.arange(ps.size), rs] = False
+        nl = np.argmax(ov, axis=1)
+        b2 = np.where(lead_row, a[ps, nl], -1)
 
         room = np.where(
             recv_ok[None, :], np.maximum(upper[:, None] - counts, 0), 0
@@ -471,18 +523,90 @@ def topic_rebalance(
             ok &= np.all(
                 bload[:, dest] + foll_load[:, ps] <= cap_eff[:, dest], axis=0
             )
+            if move_leaders and lead_row.any():
+                # leader rows additionally need the new-leader broker to be
+                # eligible and to absorb the (leader - follower) load delta
+                # strictly within capacity, and MTL-flagged topics must
+                # keep >= k leaders of the topic on the source broker
+                b2c = np.clip(b2, 0, B - 1)
+                delta = lead_load[:, ps] - foll_load[:, ps]
+                b2_ok = (
+                    alive[b2c]
+                    & ~excl_lead[b2c]
+                    & np.all(
+                        bload[:, b2c] + delta <= cap_eff[:, b2c], axis=0
+                    )
+                )
+                if need_tlc:
+                    srcb = np.clip(a[ps, rs], 0, B - 1)
+                    b2_ok &= ~tmin[ts] | (tlc[ts, srcb] - 1 >= k_min)
+                ok &= np.where(lead_row, b2_ok, True)
             if ok.any():
                 # strictly one accepted move per destination this round —
                 # the capacity / count checks above are then exact
                 oi = np.nonzero(ok)[0]
                 _, fdest = np.unique(dest[oi], return_index=True)
                 oi = oi[fdest]
+                if move_leaders:
+                    # also one leadership transfer per NEW-LEADER broker
+                    # per round, and no broker may be both a dest and a
+                    # new-leader target this round — gains stay exact
+                    b2o = np.where(
+                        lead_row[oi], b2[oi],
+                        -1 - np.arange(oi.size, dtype=np.int64),
+                    )
+                    _, fb2 = np.unique(b2o, return_index=True)
+                    oi = oi[fb2]
+                    lead_o = lead_row[oi]
+                    cross = (
+                        lead_o & np.isin(b2[oi], dest[oi])
+                    ) | np.isin(dest[oi], b2[oi][lead_o])
+                    oi = oi[~cross]
+                if oi.size == 0:
+                    continue
                 ai, ri, di = ps[oi], rs[oi], dest[oi]
+                lr = lead_row[oi]
                 src = a[ai, ri]
                 old_d = dsk[ai, ri]
+                # source sheds its CURRENT role-resolved load (leader rows
+                # were carrying leader load); dest always gains follower
+                # load; a leader row's new-leader broker gains the
+                # (leader - follower) delta
+                cur = slot_load[:, ai, ri]          # [RES, n] role-resolved
                 for res in range(NUM_RESOURCES):
-                    np.subtract.at(bload[res], src, foll_load[res, ai])
+                    np.subtract.at(bload[res], src, cur[res])
                     np.add.at(bload[res], di, foll_load[res, ai])
+                if lr.any():
+                    ail, nll = ai[lr], nl[oi][lr]
+                    b2l = a[ail, nll]
+                    for res in range(NUM_RESOURCES):
+                        np.add.at(
+                            bload[res], b2l,
+                            lead_load[res, ail] - foll_load[res, ail],
+                        )
+                    # new leader's existing disk now carries leader disk
+                    # load instead of follower disk load
+                    d2 = dsk[ail, nll]
+                    np.add.at(
+                        dload,
+                        (b2l, np.clip(d2, 0, D - 1)),
+                        np.where(
+                            d2 >= 0,
+                            lead_load[int(Resource.DISK), ail]
+                            - foll_load[int(Resource.DISK), ail],
+                            0.0,
+                        ),
+                    )
+                    if need_tlc:
+                        np.subtract.at(tlc, (topic[ail], a[ail, ri[lr]]), 1)
+                        np.add.at(tlc, (topic[ail], b2l), 1)
+                    # role bookkeeping: leadership transfers to slot nl
+                    lslot[ail] = nll
+                    is_l[ail, ri[lr]] = False
+                    is_l[ail, nll] = True
+                    for res in range(NUM_RESOURCES):
+                        slot_load[res, ail, ri[lr]] = foll_load[res, ail]
+                        slot_load[res, ail, nll] = lead_load[res, ail]
                 a[ai, ri] = di
                 # JBOD-safe disk choice: the destination's least-loaded
                 # ALIVE disk (same policy as _sweep); one move per dest per
@@ -490,7 +614,9 @@ def topic_rebalance(
                 np.subtract.at(
                     dload,
                     (src, np.clip(old_d, 0, D - 1)),
-                    np.where(old_d >= 0, foll_load[int(Resource.DISK), ai], 0.0),
+                    # source sheds the CURRENT role-resolved disk load —
+                    # leader rows were carrying leader disk load
+                    np.where(old_d >= 0, cur[int(Resource.DISK)], 0.0),
                 )
                 dchoice = np.where(disk_alive[di], dload[di], np.inf)
                 best_d = np.argmin(dchoice, axis=1).astype(dsk.dtype)
@@ -509,6 +635,7 @@ def topic_rebalance(
                 keep = np.ones(ps.size, bool)
                 keep[oi] = False
                 ps, rs, ts = ps[keep], rs[keep], ts[keep]
+                lead_row, b2, nl = lead_row[keep], b2[keep], nl[keep]
             # candidates that found no destination this round retry the
             # next-ranked destination in the following round
         total_moved += moved
@@ -520,6 +647,7 @@ def topic_rebalance(
     out = m.replace(
         assignment=jnp.asarray(a, dtype=m.assignment.dtype),
         replica_disk=jnp.asarray(dsk, dtype=m.replica_disk.dtype),
+        leader_slot=jnp.asarray(lslot, dtype=m.leader_slot.dtype),
     )
     return out, total_moved
 

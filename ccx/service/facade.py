@@ -157,6 +157,9 @@ class CruiseControl:
             topic_rebalance_max_sweeps=self.config[
                 "optimizer.topic.rebalance.max.sweeps"
             ],
+            topic_rebalance_move_leaders=self.config[
+                "optimizer.topic.rebalance.move.leaders"
+            ],
             # the portfolio candidate roughly doubles polish-phase cost;
             # never pay it on the leadership-/disk-only fast paths
             run_cold_greedy=(

@@ -129,6 +129,9 @@ class OptimizerSidecar:
             topic_rebalance_max_sweeps=int(
                 o.get("topic_rebalance_max_sweeps", 1024)
             ),
+            topic_rebalance_move_leaders=bool(
+                o.get("topic_rebalance_move_leaders", True)
+            ),
         )
         yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}
         res = optimize(model, self.goal_config, goals, opts)

@@ -111,12 +111,14 @@ def test_optimizer_option_plumbing(tmp_path):
             "optimizer.chunk.steps": 123,
             "optimizer.topic.rebalance.rounds": 5,
             "optimizer.topic.rebalance.max.sweeps": 77,
+            "optimizer.topic.rebalance.move.leaders": False,
         },
     )
     opts = cc._optimize_options()
     assert opts.anneal.chunk_steps == 123
     assert opts.topic_rebalance_rounds == 5
     assert opts.topic_rebalance_max_sweeps == 77
+    assert opts.topic_rebalance_move_leaders is False
     lead = cc._optimize_options(leadership_only=True)
     assert lead.topic_rebalance_rounds == 0  # cannot move replica counts
     disk = cc._optimize_options(disk_only=True)

@@ -233,15 +233,16 @@ def test_bounded_sweeps_still_evacuate_with_capacity_oscillation():
 def test_topic_rebalance_cuts_trd_without_hard_damage():
     """Targeted TopicReplicaDistribution sweep (repair.topic_rebalance):
     must cut over-band (topic, broker) cells substantially while never
-    introducing a hard violation, never moving leadership, and preserving
-    replication factors."""
+    introducing a hard violation, and preserving replication factors.
+    With move_leaders=False leadership must be bit-unchanged (the old
+    followers-only contract)."""
     from ccx.search.repair import topic_rebalance
 
     m = random_cluster(RandomClusterSpec(
         n_brokers=32, n_racks=4, n_topics=8, n_partitions=512, seed=19
     ))
     s0 = evaluate_stack(m, GoalConfig(), DEFAULT_GOAL_ORDER).by_name()
-    m2, n = topic_rebalance(m, GoalConfig())
+    m2, n = topic_rebalance(m, GoalConfig(), move_leaders=False)
     assert n > 0
     s1 = evaluate_stack(m2, GoalConfig(), DEFAULT_GOAL_ORDER).by_name()
     trd0 = s0["TopicReplicaDistributionGoal"][0]
@@ -261,6 +262,75 @@ def test_topic_rebalance_cuts_trd_without_hard_damage():
     rows = np.arange(m.P)
     l = np.asarray(m.leader_slot)
     np.testing.assert_array_equal(a0[rows, l], a1[rows, l])
+
+
+def test_topic_rebalance_moves_leaders_via_transfer():
+    """With move_leaders (default) the sweep sheds leader-held over cells
+    by transferring leadership to a co-replica first — the round-4 finding
+    that the followers-only shed stalls with every residual over-cell
+    replica being a leader. The deeper cut must stay hard-safe and every
+    leader_slot must still point at a valid replica of its partition."""
+    from ccx.search.repair import topic_rebalance
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=32, n_racks=4, n_topics=8, n_partitions=512, seed=19
+    ))
+    cfg = GoalConfig()
+    s0 = evaluate_stack(m, cfg, DEFAULT_GOAL_ORDER).by_name()
+    m_f, n_f = topic_rebalance(m, cfg, move_leaders=False)
+    m_l, n_l = topic_rebalance(m, cfg)
+    assert n_l > n_f  # the leader-held residual became movable
+    sf = evaluate_stack(m_f, cfg, DEFAULT_GOAL_ORDER).by_name()
+    sl = evaluate_stack(m_l, cfg, DEFAULT_GOAL_ORDER).by_name()
+    assert (
+        sl["TopicReplicaDistributionGoal"][0]
+        < sf["TopicReplicaDistributionGoal"][0]
+    )
+    for g in ("StructuralFeasibility", "RackAwareGoal", "DiskCapacityGoal",
+              "CpuCapacityGoal", "ReplicaCapacityGoal",
+              "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+              "MinTopicLeadersPerBrokerGoal"):
+        assert sl[g][0] <= s0[g][0], (g, s0[g][0], sl[g][0])
+    # structural sanity: leadership always points at a live replica slot,
+    # replication factors preserved, model internally consistent
+    a1 = np.asarray(m_l.assignment)
+    l1 = np.asarray(m_l.leader_slot)
+    pv = np.asarray(m_l.partition_valid)
+    rows = np.arange(m.P)[pv]
+    assert (a1[rows, l1[pv]] >= 0).all()
+    a0 = np.asarray(m.assignment)
+    np.testing.assert_array_equal((a0 >= 0).sum(1), (a1 >= 0).sum(1))
+    from ccx.verify import verify_model_consistency
+
+    assert not verify_model_consistency(m_l)
+
+
+def test_topic_rebalance_leader_moves_respect_mtl():
+    """When topics are flagged for MinTopicLeadersPerBroker, the
+    leadership-transfer guard (tlc bookkeeping + the source-broker
+    k_min check) must keep the HARD goal from regressing — the flagged
+    path is otherwise never exercised (fixtures default to no flags)."""
+    from ccx.search.repair import topic_rebalance
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=32, n_racks=4, n_topics=8, n_partitions=512, seed=19
+    ))
+    m = m.replace(topic_min_leaders=np.ones(m.num_topics, bool))
+    cfg = GoalConfig()
+    s0 = evaluate_stack(m, cfg, DEFAULT_GOAL_ORDER).by_name()
+    m2, n = topic_rebalance(m, cfg)
+    assert n > 0
+    s1 = evaluate_stack(m2, cfg, DEFAULT_GOAL_ORDER).by_name()
+    assert s1["MinTopicLeadersPerBrokerGoal"][0] <= s0[
+        "MinTopicLeadersPerBrokerGoal"
+    ][0]
+    assert (
+        s1["TopicReplicaDistributionGoal"][0]
+        < s0["TopicReplicaDistributionGoal"][0]
+    )
+    from ccx.verify import verify_model_consistency
+
+    assert not verify_model_consistency(m2)
 
 
 def test_topic_rebalance_jbod_lands_on_alive_disks():
