@@ -208,19 +208,27 @@ def run_config(name: str, rung: str) -> dict:
                 and os.environ.get("CCX_BENCH_PORTFOLIO") != "0"
             )
         ),
-        # latency-floor settings for the T1 chase; lean — and custom, which
-        # the campaign pins to lean effort for comparability — bound the
-        # TRD shed at 128 sweeps/round with the followers-only mode
-        # (measured: leadership transfers only pay at deep sweep budgets;
-        # at 2x128 they crowd out cheaper follower moves — 99 s for TRD
-        # 5.9k vs 55 s for 11.9k). full keeps the converged leader-moving
-        # default (TRD 5.7k, leader tiers BETTER via the final leader pass).
+        # latency-floor settings for the T1 chase. lean — and custom, which
+        # the campaign pins to lean effort for comparability — run the
+        # round-5 shed-first operating point: ONE converged leader-moving
+        # shed (the batched-intake sweep converges in ~6 s at B5) with the
+        # pre-shed polish SKIPPED and the budget moved into a 700-iter
+        # trd-GUARDED re-polish — the shed relocates ~55k replicas, so the
+        # cleanup needs the iters far more than the pre-shed state did.
+        # Measured at B5 (docs/perf-notes.md round 5): 49.3 s warm, TRD
+        # 45.8k -> 0, ReplicaDist/Disk/NwIn all better than the round-4
+        # lean point, verified. Stacks without TopicReplicaDistributionGoal
+        # (B1) keep the plain polish — there is no shed stage to re-polish.
         **(
             {"topic_rebalance_rounds": 0, "leader_pass_max_iters": 150}
             if rung == "target"
             else {
-                "topic_rebalance_max_sweeps": 128,
-                "topic_rebalance_move_leaders": False,
+                "topic_rebalance_rounds": 1,
+                "topic_rebalance_max_sweeps": 1024,
+                "topic_rebalance_move_leaders": True,
+                "topic_rebalance_polish_iters": 700,
+                "leader_pass_max_iters": 300,
+                "run_polish": "TopicReplicaDistributionGoal" not in goal_names,
             }
             if rung in ("lean", "custom")
             else {}

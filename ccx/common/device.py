@@ -46,9 +46,12 @@ def probe_devices(timeout_s: int, capture_stdout: bool = False):
     rc: int | None
     out = ""
     try:
-        rc = probe.wait(timeout=timeout_s)
-        if capture_stdout and probe.stdout is not None:
-            out = probe.stdout.read() or ""
+        # communicate() drains the pipe concurrently — wait() + read-after
+        # would deadlock a child whose output exceeds the OS pipe buffer
+        # (misclassifying a healthy device as wedged)
+        out, _ = probe.communicate(timeout=timeout_s)
+        out = out or ""
+        rc = probe.returncode
     except subprocess.TimeoutExpired:
         rc = None
     finally:

@@ -170,6 +170,20 @@ class OptimizeOptions:
     #: budgets the transfers crowd out cheaper follower moves — the bench
     #: lean rung disables this and keeps the followers-only shed.
     topic_rebalance_move_leaders: bool = True
+    #: run each round's re-polish with the greedy trd-guard first (veto
+    #: moves that worsen the TopicReplicaDistribution tier), falling back to
+    #: an unguarded polish when the guarded one fails lex adoption. The
+    #: guard keeps the usage re-polish from trading the shed's topic cells
+    #: back — the round-4 loss mechanism (raw converged shed TRD 24 vs 6.7k
+    #: surviving the unguarded re-polish). False restores round-4 mechanics.
+    topic_rebalance_guarded: bool = True
+    #: iteration budget for the stage's re-polish (None = inherit
+    #: polish.max_iters). A converged leader-ful shed relocates ~55k
+    #: replicas at B5 — the post-shed cleanup needs MORE budget than the
+    #: pre-shed polish, so latency-tuned callers shift iters here (the
+    #: bench lean rung runs a small pre-shed polish + a larger guarded
+    #: re-polish at equal total budget).
+    topic_rebalance_polish_iters: int | None = None
     #: optional iteration cap for the final leadership-only pass (None =
     #: inherit polish.max_iters). Measured at B5 full effort: leadership-only
     #: iterations are CHEAP (~11 ms vs ~70 ms placement polish) and the pass
@@ -276,6 +290,19 @@ def optimize(
                 model = polish.model
                 stack_after = polish.stack_after
                 n_polish += polish.n_moves
+    else:
+        # hard-violation recovery must not hinge on the polish flag: the
+        # lean rung skips the pre-shed polish (the topic-rebalance stage
+        # re-polishes instead), but residual post-SA hard violations still
+        # get the repair retries the polish block would have run
+        for _ in range(max(opts.max_repair_rounds - 1, 0)):
+            if float(stack_after.hard_violations) <= 0:
+                break
+            model, n_r = hard_repair(model, cfg, goal_names)
+            if n_r == 0:
+                break
+            n_polish += n_r
+            stack_after = evaluate_stack(model, cfg, goal_names)
     phases["polish"] = time.monotonic() - t
     if opts.run_cold_greedy:
         t = _enter("portfolio")
@@ -303,6 +330,13 @@ def optimize(
         # candidate won (a cold-greedy winner needs the stage most).
         t = _enter("topic-rebalance")
         with annotate("ccx:topic-rebalance"):
+            repolish = (
+                opts.polish
+                if opts.topic_rebalance_polish_iters is None
+                else dataclasses.replace(
+                    opts.polish, max_iters=opts.topic_rebalance_polish_iters
+                )
+            )
             for _ in range(opts.topic_rebalance_rounds):
                 swept, n_swept = topic_rebalance(
                     model, cfg,
@@ -311,7 +345,20 @@ def optimize(
                 )
                 if not n_swept:
                     break
-                cand = greedy_optimize(swept, cfg, goal_names, opts.polish)
+                # trd-guarded re-polish first: recover the usage tiers the
+                # shed disturbed WITHOUT trading its topic cells back (the
+                # round-4 ratchet lost most of the shed this way — raw
+                # converged TRD 24 vs 6.7k after unguarded re-polish). If
+                # the guarded move space cannot reach lex adoption, fall
+                # back to the unguarded polish, which is the proven path.
+                cand = greedy_optimize(
+                    swept, cfg, goal_names, repolish,
+                    trd_guard=opts.topic_rebalance_guarded,
+                )
+                if opts.topic_rebalance_guarded and not _lex_better(
+                    cand.stack_after, stack_after
+                ):
+                    cand = greedy_optimize(swept, cfg, goal_names, repolish)
                 if not _lex_better(cand.stack_after, stack_after):
                     break
                 model = cand.model

@@ -144,6 +144,7 @@ def _greedy_loop(
     key0: jnp.ndarray,
     max_iters: jnp.ndarray,
     patience: jnp.ndarray,
+    guard_on: jnp.ndarray,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
@@ -160,6 +161,15 @@ def _greedy_loop(
     scorer = make_move_scorer(m, goal_names, cfg)
     vector_fn = make_cost_vector_fn(m, goal_names, cfg)
     hard_arr = jnp.asarray(tuple(GOAL_REGISTRY[n].hard for n in goal_names))
+    # trd-guard column mask: with guard_on (a traced scalar, so guarded and
+    # unguarded polish share ONE compiled program) candidates that
+    # significantly RAISE the TopicReplicaDistribution tier are vetoed like
+    # hard regressions. TRD sits below the usage tiers in lex priority, so
+    # an unguarded polish legally trades freshly-shed topic cells back for
+    # usage cells — the round-4 shed/re-polish ratchet's loss mechanism.
+    guard_cols = jnp.asarray(
+        tuple(n == "TopicReplicaDistributionGoal" for n in goal_names)
+    )
     n_swap = int(opts.n_candidates * opts.swap_fraction) if pp.p_swap > 0 else 0
     n_single = max(opts.n_candidates - n_swap, 1)
     n_batch = max(min(opts.batch_moves, n_single), 1)
@@ -189,7 +199,15 @@ def _greedy_loop(
         d_all = deltas.cost_vec - ss.cost_vec[None, :]
         sig_all = jnp.abs(d_all) > goal_tols(ss.cost_vec)[None, :]
         hard_up = jnp.any(sig_all & hard_arr[None, :] & (d_all > 0), axis=1)
-        better = feas & ~hard_up & _lex_lt_batch(deltas.cost_vec, ss.cost_vec)
+        guard_up = guard_on & jnp.any(
+            sig_all & guard_cols[None, :] & (d_all > 0), axis=1
+        )
+        better = (
+            feas
+            & ~hard_up
+            & ~guard_up
+            & _lex_lt_batch(deltas.cost_vec, ss.cost_vec)
+        )
         any_single = jnp.any(better)
         best = _lex_argmin(deltas.cost_vec, better)
         pick = lambda tree: jax.tree.map(lambda a: a[best], tree)  # noqa: E731
@@ -275,9 +293,18 @@ def _greedy_loop(
             # is recomputed exactly here; when it is not lex-better than the
             # iteration base, fall back to the best single move, which IS
             # exactly lex-improving.
-            batch_ok = (n_sel <= 1) | _lex_lt_batch(
-                cost_full[None, :], s.cost_vec
-            )[0]
+            d_full = cost_full - s.cost_vec
+            full_guard_up = guard_on & jnp.any(
+                (jnp.abs(d_full) > goal_tols(s.cost_vec))
+                & guard_cols
+                & (d_full > 0)
+            )
+            batch_ok = (n_sel <= 1) | (
+                _lex_lt_batch(cost_full[None, :], s.cost_vec)[0]
+                # members are individually guard-safe but the trd normalizer
+                # coupling is not sum-decomposable — re-check the composition
+                & ~full_guard_up
+            )
             agg, part, mtl, trd, totals = jax.tree.map(
                 lambda a, b: jnp.where(batch_ok, a, b), full, first
             )
@@ -324,9 +351,13 @@ def _greedy_loop(
             sw_hard_up = jnp.any(
                 sw_sig & hard_arr[None, :] & (sw_d > 0), axis=1
             )
+            sw_guard_up = guard_on & jnp.any(
+                sw_sig & guard_cols[None, :] & (sw_d > 0), axis=1
+            )
             sw_better = (
                 sw_ok
                 & ~sw_hard_up
+                & ~sw_guard_up
                 & _lex_lt_batch(sw_delta.cost_vec, ss.cost_vec)
             )
             any_swap = jnp.any(sw_better)
@@ -377,8 +408,17 @@ def greedy_optimize(
     cfg: GoalConfig = GoalConfig(),
     goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
     opts: GreedyOptions = GreedyOptions(),
+    trd_guard: bool = False,
 ) -> GreedyResult:
-    """Hill-climb the lexicographic goal-cost vector to a local optimum."""
+    """Hill-climb the lexicographic goal-cost vector to a local optimum.
+
+    ``trd_guard`` additionally vetoes candidates that significantly worsen
+    the TopicReplicaDistribution tier (a traced flag — no extra compiled
+    program). Used by the optimizer's topic-rebalance stage so the usage
+    re-polish cannot trade the shed's topic cells back (docs/perf-notes.md
+    round-4 "shed/re-polish interplay"); plain polish keeps the full move
+    space.
+    """
     stack_before = evaluate_stack(m, cfg, goal_names)
     p_real = int(np.asarray(m.partition_valid).sum())
     bv = np.asarray(m.broker_valid)
@@ -425,6 +465,7 @@ def greedy_optimize(
         jax.random.PRNGKey(opts.seed + 1),
         jnp.asarray(opts.max_iters, jnp.int32),
         jnp.asarray(opts.patience, jnp.int32),
+        jnp.asarray(trd_guard, bool),
         goal_names=goal_names,
         cfg=cfg,
         pp=pp,

@@ -349,7 +349,9 @@ def topic_rebalance(
     hosting, alive+receiving, strictly under effective capacity on EVERY
     resource, under the replica-count band and ReplicaCapacity cap,
     utilization < 0.9 (keeps the usage tiers from absorbing the shed load).
-    One move per destination per round makes the capacity checks exact.
+    Destinations take BATCHED intake per round under cumulative band-room /
+    replica-count / capacity checks that are exactly as safe as the old
+    one-move-per-dest rule (see the intake comment in the accept block).
 
     Followers are always preferred; with ``move_leaders`` (default) a
     leader-held over cell is shed by first transferring leadership to a
@@ -483,11 +485,18 @@ def topic_rebalance(
         ps, rs = ps[fc], rs[fc]
         ts = topic[ps]
         lead_row = is_l[ps, rs]
-        # new-leader slot: first OTHER valid replica slot (leader pass
-        # re-optimizes placement later); b2 = its broker
+        # new-leader slot: the first OTHER valid replica slot whose broker
+        # can actually accept leadership (alive, not leadership-excluded) —
+        # pinning the first valid slot regardless left R>=3 cells unshed for
+        # the whole sweep when that one co-replica happened to be dead or
+        # excluded. Capacity eligibility is still checked per-round (b2_ok);
+        # the leader pass re-optimizes leadership placement later.
         ov = valid[ps].copy()
         ov[np.arange(ps.size), rs] = False
-        nl = np.argmax(ov, axis=1)
+        ab = np.clip(a[ps], 0, B - 1)
+        elig = ov & alive[ab] & ~excl_lead[ab]
+        nl = np.where(elig.any(axis=1), np.argmax(elig, axis=1),
+                      np.argmax(ov, axis=1))
         b2 = np.where(lead_row, a[ps, nl], -1)
 
         room = np.where(
@@ -502,16 +511,43 @@ def topic_rebalance(
         dest_score = np.where(
             dest_ok_b, room + (0.9 - util[None, :]), -np.inf
         )
-        # top destinations per topic; per-round dedupe keeps checks exact
-        # (width is min(B, rounds) — small clusters have fewer brokers than
-        # rounds, so the round loop runs over the actual width)
-        top_dest = np.argsort(-dest_score, axis=1)[:, :rounds_per_sweep]
+        # top destinations per topic, W wide. dest_score is nearly
+        # topic-independent (room is mostly 0/1 mid-shed, so coolness
+        # dominates), which made every topic's rank-k pick the SAME few
+        # coolest brokers — the per-dest rc/capacity serialization that
+        # capped rounds at ~30 accepted moves. Each topic therefore starts
+        # at its own rotation offset into its top-W list (deterministic,
+        # all entries still room>0 & cool), spreading the ~500 topics
+        # across ~W distinct destinations per round.
+        width = min(B, max(rounds_per_sweep, 64))
+        top_dest = np.argsort(-dest_score, axis=1)[:, :width]
         moved = 0
-        for k in range(top_dest.shape[1]):
+        kf = kl = 0
+        for k in range(min(rounds_per_sweep, top_dest.shape[1])):
             if ps.size == 0:
                 break
-            dest = top_dest[ts, k]
+            have_f = bool((~lead_row).any())
+            have_l = move_leaders and bool(lead_row.any())
+            if not (have_f or have_l):
+                break
+            # alternate follower and leader rounds (when both classes have
+            # candidates): follower rounds run plain batched intake; leader
+            # rounds draw a random broker bipartition so the dest set
+            # (heads) and the new-leader set (tails) are disjoint BY
+            # CONSTRUCTION — the b2 capacity check then stays exact under
+            # batched intake because no new-leader broker can also receive
+            # dest load this round. (A pairwise dest/b2 cross-filter
+            # collapses once intake is batched: tens of thousands of
+            # leader rows' b2 values blanket every broker.) Each class
+            # keeps its own destination-rank cursor.
+            lead_round = have_l and (not have_f or k % 2 == 1)
+            if lead_round:
+                rank_k, kl = kl, kl + 1
+            else:
+                rank_k, kf = kf, kf + 1
+            dest = top_dest[ts, (rank_k + ts) % top_dest.shape[1]]
             ok = np.isfinite(dest_score[ts, dest])
+            ok &= lead_row if lead_round else ~lead_row
             # counts is maintained per move, so the band-room check is
             # live (the old intake side-array measured vs sweep-start room)
             ok &= (upper[ts] - counts[ts, dest]) > 0
@@ -523,7 +559,7 @@ def topic_rebalance(
             ok &= np.all(
                 bload[:, dest] + foll_load[:, ps] <= cap_eff[:, dest], axis=0
             )
-            if move_leaders and lead_row.any():
+            if lead_round:
                 # leader rows additionally need the new-leader broker to be
                 # eligible and to absorb the (leader - follower) load delta
                 # strictly within capacity, and MTL-flagged topics must
@@ -540,28 +576,48 @@ def topic_rebalance(
                 if need_tlc:
                     srcb = np.clip(a[ps, rs], 0, B - 1)
                     b2_ok &= ~tmin[ts] | (tlc[ts, srcb] - 1 >= k_min)
-                ok &= np.where(lead_row, b2_ok, True)
+                coin = rng.integers(0, 2, B).astype(bool)
+                ok &= b2_ok & ~coin[dest] & coin[b2c]
             if ok.any():
-                # strictly one accepted move per destination this round —
-                # the capacity / count checks above are then exact
                 oi = np.nonzero(ok)[0]
-                _, fdest = np.unique(dest[oi], return_index=True)
-                oi = oi[fdest]
-                if move_leaders:
-                    # also one leadership transfer per NEW-LEADER broker
-                    # per round, and no broker may be both a dest and a
-                    # new-leader target this round — gains stay exact
-                    b2o = np.where(
-                        lead_row[oi], b2[oi],
-                        -1 - np.arange(oi.size, dtype=np.int64),
-                    )
-                    _, fb2 = np.unique(b2o, return_index=True)
-                    oi = oi[fb2]
-                    lead_o = lead_row[oi]
-                    cross = (
-                        lead_o & np.isin(b2[oi], dest[oi])
-                    ) | np.isin(dest[oi], b2[oi][lead_o])
-                    oi = oi[~cross]
+                if lead_round:
+                    # one leadership transfer per NEW-LEADER broker per
+                    # round: exactly one delta lands on each b2 broker
+                    _, fb2 = np.unique(b2[oi], return_index=True)
+                    oi = oi[np.sort(fb2)]
+                if oi.size == 0:
+                    continue
+                # batched intake: MULTIPLE accepted moves per destination
+                # per round, with cumulative checks that keep the old
+                # one-per-dest rule's exactness: within each dest group
+                # ((dest, topic)-sorted) a row is taken only while the live
+                # (topic, dest) band room, the replica-count cap, and EVERY
+                # resource capacity still hold with all earlier group rows'
+                # loads included. Cumulative sums also count group rows that
+                # end up rejected, which can only UNDER-accept — never
+                # overshoot; rejected rows retry the next-ranked destination
+                # next round. (The one-per-dest rule serialized the B5
+                # leader-ful converged shed to ~18 moves/round x 3k rounds.)
+                order = np.lexsort((ts[oi], dest[oi]))
+                ois = oi[order]
+                d_s, t_s = dest[ois], ts[ois]
+                idx = np.arange(ois.size)
+                seg_d = np.r_[True, d_s[1:] != d_s[:-1]]
+                start_d = np.maximum.accumulate(np.where(seg_d, idx, 0))
+                rank_d = idx - start_d
+                seg_td = seg_d | np.r_[True, t_s[1:] != t_s[:-1]]
+                start_td = np.maximum.accumulate(np.where(seg_td, idx, 0))
+                rank_td = idx - start_td
+                load_s = foll_load[:, ps[ois]]               # [RES, n]
+                cum = np.cumsum(load_s, axis=1)
+                grp_base = (cum - load_s)[:, start_d]
+                cum_within = cum - grp_base                  # incl. self
+                take = rank_td < (upper[t_s] - counts[t_s, d_s])
+                take &= rank_d < (rc_cap - rc[d_s])
+                take &= np.all(
+                    bload[:, d_s] + cum_within <= cap_eff[:, d_s], axis=0
+                )
+                oi, rank_acc = ois[take], rank_d[take]
                 if oi.size == 0:
                     continue
                 ai, ri, di = ps[oi], rs[oi], dest[oi]
@@ -618,8 +674,16 @@ def topic_rebalance(
                     # leader rows were carrying leader disk load
                     np.where(old_d >= 0, cur[int(Resource.DISK)], 0.0),
                 )
+                # k-th least-loaded alive disk for the k-th intake of the
+                # dest this round: one argmin per row would stack every
+                # batched intake onto the same disk (quality-only — the
+                # default stack's DiskCapacityGoal is broker-level)
                 dchoice = np.where(disk_alive[di], dload[di], np.inf)
-                best_d = np.argmin(dchoice, axis=1).astype(dsk.dtype)
+                ranked = np.argsort(dchoice, axis=1)
+                n_alive_d = np.maximum(disk_alive[di].sum(axis=1), 1)
+                best_d = ranked[
+                    np.arange(di.size), rank_acc % n_alive_d
+                ].astype(dsk.dtype)
                 dsk[ai, ri] = best_d
                 np.add.at(
                     dload, (di, best_d), foll_load[int(Resource.DISK), ai]

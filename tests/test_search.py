@@ -419,3 +419,67 @@ def test_batched_step_rejects_mispredicted_composition():
     # the guard must have actually fired at least once for this seed —
     # random candidates scored as "perfect" otherwise always apply
     assert n_rejected > 0
+
+
+def test_trd_guard_preserves_shed_topic_cells():
+    """``greedy_optimize(trd_guard=True)`` must never significantly raise
+    the TopicReplicaDistribution tier it starts from. This is the round-5
+    mechanism that lets the lean pipeline KEEP the converged shed's TRD
+    cut: TRD sits below the usage tiers in lex priority, so an unguarded
+    polish legally trades freshly-shed topic cells back for usage-tier
+    gains (the round-4 ratchet lost the shed's 45.8k -> 24 down to ~6.7k
+    that way). The guard is a traced veto — same compiled program both
+    ways — applied to singles, swaps, AND the batch-composition recheck."""
+    from ccx.search.repair import topic_rebalance
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=32, n_racks=4, n_topics=8, n_partitions=512, seed=19
+    ))
+    swept, n = topic_rebalance(m, CFG)
+    assert n > 0
+    trd_swept = float(
+        evaluate_stack(swept, CFG, DEFAULT_GOAL_ORDER)
+        .by_name()["TopicReplicaDistributionGoal"][0]
+    )
+    polish = GreedyOptions(n_candidates=128, max_iters=120, patience=8, seed=3)
+    guarded = greedy_optimize(
+        swept, CFG, DEFAULT_GOAL_ORDER, polish, trd_guard=True
+    )
+    trd_guarded = float(
+        guarded.stack_after.by_name()["TopicReplicaDistributionGoal"][0]
+    )
+    assert guarded.n_moves > 0  # the guard restricts, it must not paralyze
+    assert trd_guarded <= trd_swept, (trd_swept, trd_guarded)
+    # the same polish UNGUARDED trades TRD cells back on this fixture —
+    # the guard is exercised, not vacuous (equal counts would mean the
+    # veto never fired and this test pins nothing)
+    unguarded = greedy_optimize(swept, CFG, DEFAULT_GOAL_ORDER, polish)
+    trd_unguarded = float(
+        unguarded.stack_after.by_name()["TopicReplicaDistributionGoal"][0]
+    )
+    assert trd_unguarded > trd_guarded, (trd_unguarded, trd_guarded)
+
+
+def test_optimize_guarded_lean_shape_reaches_low_trd():
+    """The lean-rung pipeline shape (no pre-shed polish, one converged
+    leader-moving shed, guarded re-polish via topic_rebalance_polish_iters)
+    must verify and keep most of the shed's TRD cut end-to-end — also
+    covers the run_polish=False hard-recovery branch in optimize()."""
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=32, n_racks=4, n_topics=8, n_partitions=512, seed=19
+    ))
+    opts = OptimizeOptions(
+        anneal=AnnealOptions(n_chains=4, n_steps=200, seed=7),
+        polish=GreedyOptions(n_candidates=128, max_iters=120, patience=8),
+        run_polish=False,
+        run_cold_greedy=False,
+        topic_rebalance_rounds=1,
+        topic_rebalance_max_sweeps=1024,
+        topic_rebalance_move_leaders=True,
+        topic_rebalance_polish_iters=80,
+    )
+    res = optimize(m, CFG, DEFAULT_GOAL_ORDER, opts)
+    assert res.verification.ok, res.verification.failures
+    before = res.stack_before.by_name()["TopicReplicaDistributionGoal"][0]
+    after = res.stack_after.by_name()["TopicReplicaDistributionGoal"][0]
+    assert after <= 0.25 * before, (before, after)

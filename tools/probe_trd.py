@@ -1,0 +1,111 @@
+"""B5-scale shed/re-polish interplay probe (round-5 lean-rung tuning).
+
+Runs the FULL optimize() pipeline at lean anneal effort with the
+topic-rebalance knobs taken from env, printing phase seconds and the
+before/after violation counts of the tiers the stage trades between
+(usage distribution vs TopicReplicaDistribution). Drives the choice of
+the bench lean rung's knobs by measurement.
+
+Env: TRD_ROUNDS, TRD_SWEEPS, TRD_LEADERS, TRD_GUARD, PROBE_CPU,
+CHAINS/STEPS/MOVES/POLISH (lean defaults).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("PROBE_CPU", "1") == "1":
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from ccx.goals.base import GoalConfig
+from ccx.model.fixtures import bench_spec, random_cluster
+from ccx.optimizer import OptimizeOptions, optimize
+from ccx.search.annealer import AnnealOptions
+from ccx.search.greedy import GreedyOptions
+
+WATCH = (
+    "ReplicaDistributionGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+    "PotentialNwOutGoal",
+)
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "B5"
+    m = random_cluster(bench_spec(name))
+    print(
+        f"[trd] {name}: P={m.P} B={m.B} T={m.num_topics} R={m.R} "
+        f"backend={jax.default_backend()}",
+        flush=True,
+    )
+    opts = OptimizeOptions(
+        anneal=AnnealOptions(
+            n_chains=int(os.environ.get("CHAINS", "16")),
+            n_steps=int(os.environ.get("STEPS", "1000")),
+            moves_per_step=int(os.environ.get("MOVES", "8")),
+            seed=42,
+            chunk_steps=500,
+        ),
+        polish=GreedyOptions(
+            n_candidates=256,
+            max_iters=int(os.environ.get("POLISH", "400")),
+            patience=16,
+        ),
+        run_cold_greedy=False,
+        run_polish=os.environ.get("POLISH", "400") != "0",
+        topic_rebalance_rounds=int(os.environ.get("TRD_ROUNDS", "2")),
+        topic_rebalance_max_sweeps=int(os.environ.get("TRD_SWEEPS", "128")),
+        topic_rebalance_move_leaders=os.environ.get("TRD_LEADERS", "0") == "1",
+        topic_rebalance_guarded=os.environ.get("TRD_GUARD", "1") == "1",
+        topic_rebalance_polish_iters=(
+            int(os.environ["TRD_POLISH"])
+            if os.environ.get("TRD_POLISH")
+            else None
+        ),
+        leader_pass_max_iters=(
+            int(os.environ["LEADCAP"]) if os.environ.get("LEADCAP") else None
+        ),
+    )
+    print(
+        f"[trd] rounds={opts.topic_rebalance_rounds} "
+        f"sweeps={opts.topic_rebalance_max_sweeps} "
+        f"leaders={opts.topic_rebalance_move_leaders} "
+        f"guarded={opts.topic_rebalance_guarded}",
+        flush=True,
+    )
+    t0 = time.monotonic()
+    res = optimize(
+        m, GoalConfig(), opts=opts,
+        progress_cb=lambda ph: print(
+            f"[trd] -> {ph} @ {time.monotonic() - t0:.1f}s", flush=True
+        ),
+    )
+    wall = time.monotonic() - t0
+    print(f"[trd] wall {wall:.1f}s phases={ {k: round(v, 1) for k, v in res.phase_seconds.items()} }", flush=True)
+    print(f"[trd] verified={res.verification.ok} fails={res.verification.failures}", flush=True)
+    before = res.stack_before.by_name()
+    after = res.stack_after.by_name()
+    for g in WATCH:
+        print(f"[trd] {g}: {before[g][0]:.0f} -> {after[g][0]:.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
