@@ -392,19 +392,35 @@ def main() -> None:
 
         with tempfile.TemporaryFile("w+") as out_f, \
                 tempfile.TemporaryFile("w+") as err_f:
+            # Popen + SIGTERM-grace-then-kill instead of subprocess.run:
+            # run()'s timeout path SIGKILLs outright, and a straight
+            # SIGKILL of a client holding a device claim is the wedge
+            # etiology. The child is pinned CPU-only today (CCX_BENCH_CPU
+            # above), but that invariant is one env-handling change away
+            # from breaking — the reap ladder keeps this path safe anyway.
+            sub = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                stdout=out_f,
+                stderr=err_f,
+            )
             try:
-                sub = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)],
-                    env=env,
-                    stdout=out_f,
-                    stderr=err_f,
+                rc: int | None = sub.wait(
                     timeout=int(
                         os.environ.get("CCX_BENCH_CPU_FIRST_TIMEOUT", "900")
-                    ),
+                    )
                 )
-                rc: int | None = sub.returncode
             except subprocess.TimeoutExpired:
                 rc = None
+                sub.terminate()
+                try:
+                    sub.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    sub.kill()
+                    try:
+                        sub.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        pass
             out_f.seek(0)
             banked = bank_line(out_f.read())
             if banked and rc is None:

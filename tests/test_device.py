@@ -31,6 +31,13 @@ class FakeProbe:
             raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
         return self._rc
 
+    def communicate(self, timeout=None):
+        # mirrors Popen.communicate: drains output, waits, sets returncode
+        self.calls.append(("communicate", timeout))
+        if self._hang:
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+        return "", None
+
     def poll(self):
         self.calls.append(("poll",))
         return self._rc
@@ -95,7 +102,7 @@ def test_invalid_timeout_defaults_instead_of_crashing(no_env, monkeypatch):
     probe = FakeProbe(rc=0)
     _patch_probe(monkeypatch, probe)
     assert device.ensure_responsive_backend() is True
-    assert ("wait", 60) in probe.calls  # fell back to the 60 s default
+    assert ("communicate", 60) in probe.calls  # fell back to the 60 s default
 
 
 def test_negative_timeout_warns_and_defaults(no_env, monkeypatch):
@@ -103,7 +110,7 @@ def test_negative_timeout_warns_and_defaults(no_env, monkeypatch):
     probe = FakeProbe(rc=0)
     _patch_probe(monkeypatch, probe)
     assert device.ensure_responsive_backend() is True
-    assert ("wait", 60) in probe.calls  # negative != disable; only 0 is
+    assert ("communicate", 60) in probe.calls  # negative != disable; only 0 is
 
 
 def test_healthy_probe_keeps_backend(no_env, monkeypatch):

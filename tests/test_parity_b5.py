@@ -123,6 +123,14 @@ def test_b5_pipeline_matches_or_beats_oracle_full_effort():
         before["PreferredLeaderElectionGoal"][0]
     )
 
+    # PotentialNwOut floor demonstration (VERDICT r04 weak #3): the
+    # verifier's carve-out excuses only brokers whose cap sits below the
+    # placement-invariant average potential — the same-budget oracle must
+    # concede at least as many, or the "unavoidable" claim is hollow
+    assert after["PotentialNwOutGoal"][0] <= oracle_after["PotentialNwOutGoal"][0], (
+        after["PotentialNwOutGoal"], oracle_after["PotentialNwOutGoal"]
+    )
+
     # mid-tier distribution goals must genuinely converge at full effort,
     # not just shave costs: violation counts cut >= 50% from the input
     # (VERDICT r2 "Next round" #4 done-bar)
