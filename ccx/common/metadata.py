@@ -39,6 +39,19 @@ class BrokerInfo:
     alive: bool = True
     num_disks: int = 1
     offline_disks: tuple[int, ...] = ()
+    #: hostname (ref model/Host.java: rack -> host -> broker; several
+    #: brokers may share a host). "" = unknown -> the broker is its own
+    #: host. When rack is ALSO unknown, rack-awareness falls back to host
+    #: distinctness (upstream ClusterModel.createBroker semantics).
+    host: str = ""
+
+    def rack_key(self) -> str:
+        """Effective rack grouping key: rack, else host, else broker id."""
+        return self.rack or self.host or f"broker-{self.broker_id}"
+
+    def host_key(self) -> str:
+        """Effective host grouping key: host, else broker id."""
+        return self.host or f"broker-{self.broker_id}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,10 +86,18 @@ class ClusterMetadata:
     def topic_index(self) -> dict[str, int]:
         return {t: i for i, t in enumerate(self.topics())}
 
-    def racks(self) -> list[str]:
+    def rack_keys(self) -> list[str]:
+        """Distinct effective rack keys (rack || host || broker id)."""
         seen: dict[str, None] = {}
         for b in self.brokers:
-            seen.setdefault(b.rack, None)
+            seen.setdefault(b.rack_key(), None)
+        return list(seen)
+
+    def hosts(self) -> list[str]:
+        """Distinct effective host keys."""
+        seen: dict[str, None] = {}
+        for b in self.brokers:
+            seen.setdefault(b.host_key(), None)
         return list(seen)
 
     def alive_broker_ids(self) -> set[int]:

@@ -91,6 +91,7 @@ class _SimBroker:
     num_disks: int = 1
     offline_disks: set[int] = dataclasses.field(default_factory=set)
     configs: dict[str, str] = dataclasses.field(default_factory=dict)
+    host: str = ""  # hostname; brokers sharing it share a physical host
 
 
 class SimulatedCluster:
@@ -106,9 +107,12 @@ class SimulatedCluster:
 
     # ----- topology setup ---------------------------------------------------
 
-    def add_broker(self, broker_id: int, rack: str, num_disks: int = 1) -> None:
+    def add_broker(self, broker_id: int, rack: str, num_disks: int = 1,
+                   host: str = "") -> None:
         with self._lock:
-            self._brokers[broker_id] = _SimBroker(broker_id, rack, num_disks=num_disks)
+            self._brokers[broker_id] = _SimBroker(
+                broker_id, rack, num_disks=num_disks, host=host
+            )
             self._generation += 1
 
     def create_topic(self, topic: str, partitions: int, rf: int,
@@ -210,7 +214,7 @@ class SimulatedAdminClient(AdminApi):
         with c._lock:
             brokers = tuple(
                 BrokerInfo(b.broker_id, b.rack, b.alive, b.num_disks,
-                           tuple(sorted(b.offline_disks)))
+                           tuple(sorted(b.offline_disks)), host=b.host)
                 for b in sorted(c._brokers.values(), key=lambda b: b.broker_id)
             )
             parts = tuple(

@@ -86,6 +86,10 @@ class KafkaAdminApi(AdminApi):
                     for i, ok in sorted(log_dirs.get(b["node_id"], {}).items())
                     if not ok
                 ),
+                # hostname from broker metadata: two brokers on one machine
+                # share it, which is what the rack fallback (rack || host)
+                # and the model's host axis key on
+                host=b.get("host") or "",
             )
             for b in sorted(cluster_info["brokers"], key=lambda b: b["node_id"])
         )

@@ -74,32 +74,44 @@ def structural_rows(
     ).astype(jnp.float32)
 
 
-def _rack_counts_rows(
+def _rack_rank_rows(
     m: TensorClusterModel, assign: jnp.ndarray, pvalid: jnp.ndarray
-) -> jnp.ndarray:
-    """int32[n, num_racks] — replicas per rack for each row."""
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(rank int32[n, R], valid bool[n, R]) — for each replica slot, how
+    many EARLIER valid slots of the same row share its rack. Pairwise over
+    the R axis ([n, R, R], R <= 8) instead of a [n, R, num_racks] one-hot:
+    the one-hot's width exploded to B for rack-less clusters (per-broker
+    rack fallback makes num_racks == n_brokers — gigabytes of intermediate
+    at anneal batch sizes) and was wider than R even for normal clusters."""
     valid = _row_valid(assign, pvalid)
     racks = m.broker_rack[jnp.clip(assign, 0, m.B - 1)]
-    onehot = (racks[:, :, None] == jnp.arange(m.num_racks)[None, None, :]) & valid[
-        :, :, None
-    ]
-    return jnp.sum(onehot.astype(jnp.int32), axis=1)
+    same = (
+        (racks[:, :, None] == racks[:, None, :])
+        & valid[:, :, None]
+        & valid[:, None, :]
+        & (jnp.arange(m.R)[None, :, None] > jnp.arange(m.R)[None, None, :])
+    )
+    return jnp.sum(same.astype(jnp.int32), axis=2), valid
 
 
 def rack_aware_rows(
     m: TensorClusterModel, assign: jnp.ndarray, pvalid: jnp.ndarray
 ) -> jnp.ndarray:
-    counts = _rack_counts_rows(m, assign, pvalid)
-    return jnp.sum(jnp.maximum(counts - 1, 0), axis=1).astype(jnp.float32)
+    # sum_r max(count_r - 1, 0) == number of replicas that are NOT the
+    # first occupant of their rack within the row
+    rank, valid = _rack_rank_rows(m, assign, pvalid)
+    return jnp.sum(valid & (rank >= 1), axis=1).astype(jnp.float32)
 
 
 def rack_aware_distribution_rows(
     m: TensorClusterModel, assign: jnp.ndarray, pvalid: jnp.ndarray
 ) -> jnp.ndarray:
-    counts = _rack_counts_rows(m, assign, pvalid)
-    rf = jnp.sum(_row_valid(assign, pvalid), axis=1)
+    # sum_r max(count_r - cap, 0) == number of replicas whose within-rack
+    # rank reaches cap
+    rank, valid = _rack_rank_rows(m, assign, pvalid)
+    rf = jnp.sum(valid, axis=1)
     cap = jnp.ceil(rf / jnp.maximum(m.num_racks, 1)).astype(jnp.int32)
-    return jnp.sum(jnp.maximum(counts - cap[:, None], 0), axis=1).astype(jnp.float32)
+    return jnp.sum(valid & (rank >= cap[:, None]), axis=1).astype(jnp.float32)
 
 
 def preferred_leader_rows(

@@ -82,6 +82,10 @@ class RandomClusterSpec:
     skew: float = 0.6
     n_dead_brokers: int = 0
     n_disks: int = 1
+    #: brokers per physical host (ref model/Host.java; 1 = every broker its
+    #: own host). Hosts never span racks: host ids are assigned within rack
+    #: stripes so the rack -> host -> broker tree stays well-formed.
+    brokers_per_host: int = 1
     seed: int = 0
 
 
@@ -133,6 +137,14 @@ def random_cluster(spec: RandomClusterSpec) -> TensorClusterModel:
     per_broker = total / B * spec.capacity_headroom
     broker_capacity = np.tile(per_broker[:, None], (1, B)).astype(np.float32)
     broker_rack = (np.arange(B) % spec.n_racks).astype(np.int32)
+    # hosts group same-rack brokers (stripes: rack r holds indices
+    # r, r+n_racks, ...), so a host never spans racks
+    pos_in_rack = np.arange(B) // spec.n_racks
+    host_key = (
+        broker_rack.astype(np.int64) * B
+        + pos_in_rack // max(spec.brokers_per_host, 1)
+    )
+    broker_host = np.unique(host_key, return_inverse=True)[1].astype(np.int32)
 
     broker_alive = np.ones(B, bool)
     if spec.n_dead_brokers:
@@ -158,6 +170,7 @@ def random_cluster(spec: RandomClusterSpec) -> TensorClusterModel:
         follower_load=follower_load,
         broker_capacity=broker_capacity,
         broker_rack=broker_rack,
+        broker_host=broker_host,
         partition_topic=partition_topic,
         broker_alive=broker_alive,
         disk_capacity=disk_capacity,

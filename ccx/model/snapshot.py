@@ -38,6 +38,7 @@ ARRAY_FIELDS = (
     "follower_load",
     "broker_capacity",
     "broker_rack",
+    "broker_host",
     "broker_alive",
     "broker_new",
     "broker_excl_replicas",
@@ -47,7 +48,10 @@ ARRAY_FIELDS = (
     "topic_min_leaders",
 )
 
-SCHEMA_VERSION = 1
+#: v2 adds ``broker_host`` (host axis, ref model/Host.java). Decoding is
+#: backward compatible: a v1 snapshot without the field builds a model with
+#: the one-host-per-broker default.
+SCHEMA_VERSION = 2
 
 
 def model_to_arrays(m: TensorClusterModel, strip_padding: bool = True) -> dict[str, Any]:
@@ -74,6 +78,7 @@ def model_to_arrays(m: TensorClusterModel, strip_padding: bool = True) -> dict[s
         "follower_load": arr("follower_load")[:, :P],
         "broker_capacity": arr("broker_capacity")[:, :B],
         "broker_rack": arr("broker_rack")[:B],
+        "broker_host": arr("broker_host")[:B],
         "broker_alive": arr("broker_alive")[:B],
         "broker_new": arr("broker_new")[:B],
         "broker_excl_replicas": arr("broker_excl_replicas")[:B],
@@ -133,10 +138,10 @@ _BOOL_FIELDS = {
 }
 
 
-def to_msgpack(m: TensorClusterModel) -> bytes:
+def pack_arrays(d: dict[str, Any]) -> bytes:
+    """msgpack-encode an arrays dict (full snapshot or delta fields)."""
     import msgpack
 
-    d = model_to_arrays(m)
     enc: dict[str, Any] = {}
     for k, v in d.items():
         if isinstance(v, np.ndarray):
@@ -147,6 +152,10 @@ def to_msgpack(m: TensorClusterModel) -> bytes:
         else:
             enc[k] = v
     return msgpack.packb(enc, use_bin_type=True)
+
+
+def to_msgpack(m: TensorClusterModel) -> bytes:
+    return pack_arrays(model_to_arrays(m))
 
 
 def from_msgpack(buf: bytes) -> TensorClusterModel:

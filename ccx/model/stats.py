@@ -55,11 +55,15 @@ class ClusterModelStats:
     std: dict[str, float]
     min: dict[str, float]
     max: dict[str, float]
+    #: distinct hosts among alive brokers (ref model/Host.java rollup;
+    #: equals n_brokers when every broker is its own host)
+    n_hosts: int = 0
 
     def to_json(self) -> dict:
         return {
             "metadata": {
                 "brokers": self.n_brokers,
+                "hosts": self.n_hosts,
                 "replicas": self.n_replicas,
                 "topics": self.n_topics,
                 "partitions": self.n_partitions,
@@ -141,7 +145,38 @@ def cluster_model_stats(
         std=std,
         min=mn,
         max=mx,
+        n_hosts=int(np.unique(np.asarray(m.broker_host)[alive]).size),
     )
+
+
+def host_rollup(
+    m: TensorClusterModel, agg: BrokerAggregates | None = None
+) -> dict[int, dict[str, float]]:
+    """Per-HOST aggregates over alive brokers (ref model/Host.java: a host
+    aggregates its brokers' capacity and load; multi-broker hosts appear as
+    one row). Keys are host ids; values carry summed loads, capacity, and
+    replica/leader counts — the host axis of kafka_cluster_state/load."""
+    if agg is None:
+        import jax
+
+        agg = jax.jit(broker_aggregates)(m)
+    alive = np.asarray(m.broker_valid & m.broker_alive)
+    hosts = np.asarray(m.broker_host)
+    loads = np.asarray(agg.broker_load)
+    caps = np.asarray(m.broker_capacity)
+    repl = np.asarray(agg.replica_count)
+    lead = np.asarray(agg.leader_count)
+    out: dict[int, dict[str, float]] = {}
+    for h in np.unique(hosts[alive]):
+        sel = alive & (hosts == h)
+        row = {"brokers": float(sel.sum())}
+        for key, res in _RESOURCE_KEYS.items():
+            row[key] = float(loads[res][sel].sum())
+            row[key + "Capacity"] = float(caps[res][sel].sum())
+        row["replicas"] = float(repl[sel].sum())
+        row["leaderReplicas"] = float(lead[sel].sum())
+        out[int(h)] = row
+    return out
 
 
 def balancedness_score(stats: ClusterModelStats) -> float:

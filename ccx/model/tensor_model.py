@@ -21,8 +21,9 @@ brokers, D = max disks/broker, T = topics; RES = NUM_RESOURCES):
   leadership transfer re-weights loads without re-aggregation. NW_OUT of a
   follower is 0 (only leaders serve consumers); follower NW_IN equals the
   leader's NW_IN (replication traffic); DISK is role-independent.
-* broker-axis arrays: capacity, rack id, liveness, validity, new-broker and
-  exclusion masks; disk-axis capacity/liveness for JBOD.
+* broker-axis arrays: capacity, rack id, host id (multi-broker hosts, ref
+  ``model/Host.java``), liveness, validity, new-broker and exclusion masks;
+  disk-axis capacity/liveness for JBOD.
 * ``partition_topic: int32[P]`` and topic-level masks (excluded topics,
   min-leaders topics).
 
@@ -66,6 +67,13 @@ class TensorClusterModel:
     # --- broker axis ---
     broker_capacity: jnp.ndarray   # float32[RES, B]
     broker_rack: jnp.ndarray       # int32[B]
+    #: host id per broker (ref model/Host.java: rack -> host -> broker).
+    #: Multi-broker hosts share an id; default is one host per broker.
+    #: Upstream rack-awareness falls back to host distinctness when racks
+    #: are unset — build_model implements that by deriving broker_rack from
+    #: broker_host when no racks are given, so every rack goal inherits the
+    #: fallback without kernel changes.
+    broker_host: jnp.ndarray       # int32[B]
     broker_valid: jnp.ndarray      # bool[B]
     broker_alive: jnp.ndarray      # bool[B]  (False => demoted-dead / failed)
     broker_new: jnp.ndarray        # bool[B]  (added brokers, move-target pref)
@@ -139,7 +147,8 @@ def build_model(
     leader_load: np.ndarray,
     follower_load: np.ndarray,
     broker_capacity: np.ndarray,
-    broker_rack: np.ndarray,
+    broker_rack: np.ndarray | None = None,
+    broker_host: np.ndarray | None = None,
     partition_topic: np.ndarray | None = None,
     leader_slot: np.ndarray | None = None,
     replica_disk: np.ndarray | None = None,
@@ -163,10 +172,22 @@ def build_model(
     """
     assignment = np.asarray(assignment, np.int32)
     P, R = assignment.shape
-    B = int(np.asarray(broker_rack).shape[0])
+    broker_capacity = np.asarray(broker_capacity, np.float32)
+    B = int(broker_capacity.reshape(NUM_RESOURCES, -1).shape[1])
     leader_load = np.asarray(leader_load, np.float32).reshape(NUM_RESOURCES, P)
     follower_load = np.asarray(follower_load, np.float32).reshape(NUM_RESOURCES, P)
-    broker_capacity = np.asarray(broker_capacity, np.float32).reshape(NUM_RESOURCES, B)
+    broker_capacity = broker_capacity.reshape(NUM_RESOURCES, B)
+    if broker_host is None:
+        broker_host = np.arange(B, dtype=np.int32)  # one host per broker
+    broker_host = np.asarray(broker_host, np.int32)
+    if broker_rack is None:
+        # upstream semantics (model/Rack.java via ClusterModel.createBroker):
+        # a broker with no rack information is treated as rack == its host,
+        # so rack-awareness degrades to host distinctness. Densified: host
+        # ids need not be dense, and num_racks is derived as max+1 — sparse
+        # ids would inflate it with phantom racks and tighten the
+        # RackAwareDistribution per-rack cap ceil(rf / num_racks) wrongly.
+        broker_rack = np.unique(broker_host, return_inverse=True)[1]
     broker_rack = np.asarray(broker_rack, np.int32)
 
     if partition_topic is None:
@@ -234,6 +255,20 @@ def build_model(
         follower_load=jnp.asarray(np.pad(follower_load, [(0, 0), (0, Pp - P)])),
         broker_capacity=jnp.asarray(pad_b(broker_capacity, axis=1)),
         broker_rack=jnp.asarray(pad_b(broker_rack)),
+        # padding hosts get fresh ids so a padded slot can never alias a
+        # real multi-broker host (broker_valid masks them everywhere anyway)
+        broker_host=jnp.asarray(
+            pad_b(broker_host)
+            if Bp == B
+            else np.concatenate(
+                [
+                    broker_host,
+                    broker_host.max(initial=-1)
+                    + 1
+                    + np.arange(Bp - B, dtype=np.int32),
+                ]
+            )
+        ),
         broker_valid=jnp.asarray(broker_valid),
         broker_alive=jnp.asarray(pad_b(np.asarray(broker_alive, bool))),
         broker_new=jnp.asarray(pad_b(np.asarray(broker_new, bool))),

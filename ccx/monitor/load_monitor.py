@@ -476,7 +476,11 @@ def build_tensor_model(
     R = max((len(p.replicas) for p in metadata.partitions), default=1)
     bidx = metadata.broker_index()
     tidx = metadata.topic_index()
-    racks = {r: i for i, r in enumerate(metadata.racks())}
+    # effective rack keys: rack || host || broker id — a rack-less broker
+    # falls back to HOST distinctness (upstream ClusterModel.createBroker
+    # semantics, ref model/{Rack,Host}.java), not to one shared "" rack
+    racks = {r: i for i, r in enumerate(metadata.rack_keys())}
+    hosts = {h: i for i, h in enumerate(metadata.hosts())}
 
     assignment = np.full((P, R), -1, np.int32)
     replica_disk = np.full((P, R), -1, np.int32)
@@ -508,7 +512,12 @@ def build_tensor_model(
 
     broker_ids = metadata.broker_ids()
     broker_capacity = capacity_matrix(capacity_resolver, broker_ids)
-    broker_rack = np.array([racks[b.rack] for b in metadata.brokers], np.int32)
+    broker_rack = np.array(
+        [racks[b.rack_key()] for b in metadata.brokers], np.int32
+    )
+    broker_host = np.array(
+        [hosts[b.host_key()] for b in metadata.brokers], np.int32
+    )
     broker_alive = np.array(
         [b.alive and b.broker_id not in options.brokers_to_remove
          for b in metadata.brokers], bool
@@ -542,6 +551,7 @@ def build_tensor_model(
         follower_load=follower_load,
         broker_capacity=broker_capacity,
         broker_rack=broker_rack,
+        broker_host=broker_host,
         partition_topic=partition_topic,
         leader_slot=leader_slot,
         replica_disk=replica_disk,

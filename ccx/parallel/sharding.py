@@ -76,6 +76,7 @@ def model_pspecs(m: TensorClusterModel) -> TensorClusterModel:
         follower_load=P(None, PARTS_AXIS),
         broker_capacity=P(),
         broker_rack=P(),
+        broker_host=P(),
         broker_valid=P(),
         broker_alive=P(),
         broker_new=P(),

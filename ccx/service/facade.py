@@ -538,8 +538,12 @@ class CruiseControl:
                     for b, dirs in self.admin.describe_log_dirs().items()
                 },
                 "IsController": {},
+                "HostByBrokerId": {
+                    str(b.broker_id): b.host_key() for b in md.brokers
+                },
                 "Summary": {
                     "Brokers": len(md.brokers),
+                    "Hosts": len(md.hosts()),
                     "AliveBrokers": len(md.alive_broker_ids()),
                     "Topics": len(md.topics()),
                     "Partitions": len(md.partitions),
@@ -566,6 +570,7 @@ class CruiseControl:
                 {
                     "Broker": b.broker_id,
                     "Rack": b.rack,
+                    "Host": b.host_key(),
                     "BrokerState": "ALIVE" if b.alive else "DEAD",
                     "Replicas": int(np.asarray(agg.replica_count)[i]),
                     "Leaders": int(np.asarray(agg.leader_count)[i]),

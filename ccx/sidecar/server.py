@@ -125,12 +125,27 @@ class OptimizerSidecar:
                 max_iters=int(o.get("polish_max_iters", 400)),
             ),
             check_evacuation=bool(o.get("check_evacuation", True)),
+            run_polish=bool(o.get("run_polish", True)),
+            run_cold_greedy=bool(o.get("run_cold_greedy", True)),
             topic_rebalance_rounds=int(o.get("topic_rebalance_rounds", 2)),
             topic_rebalance_max_sweeps=int(
                 o.get("topic_rebalance_max_sweeps", 1024)
             ),
             topic_rebalance_move_leaders=bool(
                 o.get("topic_rebalance_move_leaders", True)
+            ),
+            topic_rebalance_guarded=bool(
+                o.get("topic_rebalance_guarded", True)
+            ),
+            topic_rebalance_polish_iters=(
+                int(o["topic_rebalance_polish_iters"])
+                if o.get("topic_rebalance_polish_iters") is not None
+                else None
+            ),
+            leader_pass_max_iters=(
+                int(o["leader_pass_max_iters"])
+                if o.get("leader_pass_max_iters") is not None
+                else None
             ),
         )
         yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}

@@ -76,3 +76,111 @@ def test_aggregates_jit_and_vmap():
 
 def test_virtual_mesh_available():
     assert jax.device_count() == 8
+
+
+def test_host_axis_defaults_and_rack_fallback():
+    """broker_host defaults to one host per broker; with racks ABSENT the
+    rack ids fall back to HOST ids (upstream ClusterModel.createBroker:
+    rack-awareness degrades to host distinctness — SURVEY.md C2,
+    model/{Rack,Host}.java), so every rack goal inherits the fallback."""
+    import numpy as np
+    from ccx.common.resources import NUM_RESOURCES
+    from ccx.model.tensor_model import build_model
+
+    assignment = np.array([[0, 1], [2, 3]], np.int32)
+    kw = dict(
+        assignment=assignment,
+        leader_load=np.ones((NUM_RESOURCES, 2), np.float32),
+        follower_load=np.ones((NUM_RESOURCES, 2), np.float32),
+        broker_capacity=np.full((NUM_RESOURCES, 4), 100.0, np.float32),
+    )
+    # default: every broker its own host, rack == host
+    m = build_model(**kw, pad=False)
+    np.testing.assert_array_equal(np.asarray(m.broker_host), [0, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(m.broker_rack), [0, 1, 2, 3])
+    # multi-broker hosts, racks absent -> rack ids == host ids
+    m2 = build_model(**kw, broker_host=np.array([0, 0, 1, 1]), pad=False)
+    np.testing.assert_array_equal(np.asarray(m2.broker_rack), [0, 0, 1, 1])
+    # explicit racks win over the fallback
+    m3 = build_model(
+        **kw,
+        broker_host=np.array([0, 0, 1, 1]),
+        broker_rack=np.array([0, 1, 0, 1]),
+        pad=False,
+    )
+    np.testing.assert_array_equal(np.asarray(m3.broker_rack), [0, 1, 0, 1])
+    # padding hosts never alias a real host id
+    m4 = build_model(**kw, broker_host=np.array([0, 0, 1, 1]), pad=True)
+    hosts = np.asarray(m4.broker_host)
+    valid = np.asarray(m4.broker_valid)
+    assert not np.isin(hosts[~valid], hosts[valid]).any()
+
+
+def test_rack_goals_enforce_host_distinctness_when_racks_absent():
+    """With no rack info, two replicas on different BROKERS of the same
+    HOST must violate RackAwareGoal (host-distinctness fallback); replicas
+    on distinct hosts must not."""
+    import numpy as np
+    from ccx.common.resources import NUM_RESOURCES
+    from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+    from ccx.model.aggregates import broker_aggregates
+    from ccx.model.tensor_model import build_model
+
+    def rack_violations(assignment):
+        m = build_model(
+            assignment=np.asarray(assignment, np.int32),
+            leader_load=np.ones((NUM_RESOURCES, len(assignment)), np.float32),
+            follower_load=np.ones((NUM_RESOURCES, len(assignment)), np.float32),
+            broker_capacity=np.full((NUM_RESOURCES, 4), 100.0, np.float32),
+            broker_host=np.array([0, 0, 1, 1]),  # hosts: {0,1}, {2,3}
+            pad=False,
+        )
+        r = GOAL_REGISTRY["RackAwareGoal"].fn(m, broker_aggregates(m), GoalConfig())
+        return float(r.violations)
+
+    assert rack_violations([[0, 1]]) == 1.0   # same host, different brokers
+    assert rack_violations([[0, 2]]) == 0.0   # distinct hosts
+    assert rack_violations([[1, 3]]) == 0.0
+
+
+def test_stats_and_snapshot_carry_host_axis():
+    import numpy as np
+    from ccx.model.fixtures import RandomClusterSpec, random_cluster
+    from ccx.model.snapshot import from_json, to_json, arrays_to_model
+    from ccx.model.stats import cluster_model_stats, host_rollup
+    import json as _json
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=12, n_racks=3, n_topics=4, n_partitions=64,
+        brokers_per_host=2, seed=5,
+    ))
+    hosts = np.asarray(m.broker_host)[np.asarray(m.broker_valid)]
+    assert np.unique(hosts).size == 6  # 12 brokers / 2 per host
+    # hosts never span racks
+    racks = np.asarray(m.broker_rack)[np.asarray(m.broker_valid)]
+    for h in np.unique(hosts):
+        assert np.unique(racks[hosts == h]).size == 1
+
+    stats = cluster_model_stats(m)
+    assert stats.n_hosts == 6
+    assert stats.to_json()["metadata"]["hosts"] == 6
+
+    roll = host_rollup(m)
+    assert len(roll) == 6
+    assert sum(r["brokers"] for r in roll.values()) == 12.0
+    assert sum(r["replicas"] for r in roll.values()) == float(
+        np.asarray(m.n_replicas)
+    )
+
+    # snapshot round-trip preserves the axis; a v1 snapshot (no
+    # broker_host) still decodes with the one-host-per-broker default
+    m2 = from_json(to_json(m))
+    np.testing.assert_array_equal(
+        np.asarray(m2.broker_host)[np.asarray(m2.broker_valid)], hosts
+    )
+    v1 = _json.loads(to_json(m))
+    del v1["broker_host"]
+    v1["version"] = 1
+    m3 = arrays_to_model(v1)
+    bv = np.asarray(m3.broker_valid)
+    assert np.unique(np.asarray(m3.broker_host)[bv]).size == int(bv.sum())

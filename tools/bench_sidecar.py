@@ -1,0 +1,119 @@
+"""Sidecar-inclusive T1 path measurement (VERDICT r04 weak #5 / next #5).
+
+T1 as defined by the north star is snapshot-up / proposals-down through
+the gRPC hop — `bench.py` times `optimize()` in-process and leaves the
+hop unmeasured. This tool runs B5 through a real localhost gRPC
+`OptimizerSidecar` and itemizes where the wire time goes:
+
+  encode   — client-side `to_msgpack` of the full snapshot
+  put      — PutSnapshot RTT (transfer + server decode + cache store)
+  propose  — session-referencing Propose: optimize + result encode + reply
+  delta    — warm-generation path: `delta_encode` one field + Propose
+
+Cold = first propose in the process (tracing + persistent-cache load);
+warm = second propose (the resident steady state). Prints one JSON line;
+the table lives in docs/perf-notes.md.
+
+Usage: [PROBE_CPU=1] python tools/bench_sidecar.py [B5|B2|...]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("PROBE_CPU", "1") == "1":
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np
+
+from ccx.model.fixtures import bench_spec, random_cluster
+from ccx.model.snapshot import (
+    delta_encode,
+    model_to_arrays,
+    pack_arrays,
+    to_msgpack,
+)
+from ccx.sidecar.client import SidecarClient
+from ccx.sidecar.server import make_grpc_server
+
+#: the bench lean rung's effort (bench.py RUNGS["lean"] + round-5 stage)
+LEAN_OPTIONS = dict(
+    chains=16, steps=1000, moves_per_step=8, seed=42,
+    polish_max_iters=400, run_polish=False, run_cold_greedy=False,
+    topic_rebalance_rounds=1, topic_rebalance_max_sweeps=1024,
+    topic_rebalance_move_leaders=True, topic_rebalance_polish_iters=700,
+    leader_pass_max_iters=300,
+)
+
+
+def main() -> None:
+    name = sys.argv[1] if len(sys.argv) > 1 else "B5"
+    m = random_cluster(bench_spec(name))
+    server, port = make_grpc_server(address="127.0.0.1:0")
+    server.start()
+    client = SidecarClient(f"127.0.0.1:{port}")
+    out: dict = {"config": name, "backend": jax.default_backend()}
+
+    t0 = time.monotonic()
+    packed = to_msgpack(m)
+    out["encode_s"] = round(time.monotonic() - t0, 3)
+    out["snapshot_mb"] = round(len(packed) / 1e6, 2)
+
+    t0 = time.monotonic()
+    client.put_snapshot(None, session="t1", generation=1, packed=packed)
+    out["put_s"] = round(time.monotonic() - t0, 3)
+
+    for label in ("cold", "warm"):
+        t0 = time.monotonic()
+        res = client.propose(session="t1", **LEAN_OPTIONS)
+        out[f"propose_{label}_s"] = round(time.monotonic() - t0, 3)
+        out[f"optimize_{label}_s"] = round(res["wallSeconds"], 3)
+        out[f"verified_{label}"] = bool(res.get("verified", False))
+        out[f"proposals_{label}"] = len(res.get("proposals", []))
+
+    # warm-generation delta path: leadership of partition 0 moves
+    base = model_to_arrays(m)
+    new = dict(base)
+    ls = np.array(base["leader_slot"], np.int32).copy()
+    ls[0] = (ls[0] + 1) % 2
+    new["leader_slot"] = ls
+    t0 = time.monotonic()
+    dpacked = pack_arrays(delta_encode(base, new))
+    out["delta_encode_s"] = round(time.monotonic() - t0, 3)
+    out["delta_kb"] = round(len(dpacked) / 1e3, 1)
+    t0 = time.monotonic()
+    client.put_snapshot(
+        None, session="t1", generation=2, is_delta=True,
+        base_generation=1, packed=dpacked,
+    )
+    out["delta_put_s"] = round(time.monotonic() - t0, 3)
+    t0 = time.monotonic()
+    res = client.propose(session="t1", **LEAN_OPTIONS)
+    out["propose_after_delta_s"] = round(time.monotonic() - t0, 3)
+    out["verified_after_delta"] = bool(res.get("verified", False))
+
+    # the hop's contribution to warm T1 = propose RTT minus device optimize
+    out["hop_overhead_warm_s"] = round(
+        out["propose_warm_s"] - out["optimize_warm_s"], 3
+    )
+    client.close()
+    server.stop(0)
+    print(json.dumps(out), flush=True)
+
+
+
+if __name__ == "__main__":
+    main()
