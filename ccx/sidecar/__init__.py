@@ -6,6 +6,14 @@ Only wire-contract constants live here so the remote client
 
 SERVICE = "ccx.sidecar.OptimizerService"
 
+#: channel/server options shared by both ends of the hop: a 100k-partition
+#: snapshot is tens of MB packed (B5: 6.5 MB; SURVEY.md §5.8) and gRPC's
+#: 4 MB default max rejects it
+GRPC_MESSAGE_OPTIONS = (
+    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+)
+
 
 def identity(b: bytes) -> bytes:
     """Byte-identity (de)serializer — payloads are msgpack end to end."""

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import msgpack
 
-from ccx.sidecar import SERVICE, identity as _identity
+from ccx.sidecar import GRPC_MESSAGE_OPTIONS, SERVICE, identity as _identity
 
 # NOTE: ccx.model.snapshot (and with it jax) is imported lazily inside the
 # methods that take a model object — a remote-only client (ping, session
@@ -21,7 +21,9 @@ class SidecarClient:
     def __init__(self, address: str) -> None:
         import grpc
 
-        self.channel = grpc.insecure_channel(address)
+        self.channel = grpc.insecure_channel(
+            address, options=list(GRPC_MESSAGE_OPTIONS)
+        )
         self._propose = self.channel.unary_stream(
             f"/{SERVICE}/Propose",
             request_serializer=_identity, response_deserializer=_identity,

@@ -22,6 +22,7 @@ from concurrent import futures
 import msgpack
 
 from ccx import __version__
+from ccx.sidecar import GRPC_MESSAGE_OPTIONS
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER
 from ccx.model.snapshot import (
@@ -202,7 +203,13 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
         ),
     }
     handler = grpc.method_handlers_generic_handler(SERVICE, method_handlers)
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        # a 100k-partition snapshot is tens of MB (B5 full snapshot:
+        # 6.5 MB packed; SURVEY.md §5.8 sizes the hop at tens of MB) —
+        # gRPC's 4 MB default rejects the north star's own payload
+        options=GRPC_MESSAGE_OPTIONS,
+    )
     server.add_generic_rpc_handlers((handler,))
     port = server.add_insecure_port(address)
     return server, port
