@@ -293,6 +293,67 @@ def run_config(name: str, rung: str) -> dict:
     }
 
 
+def run_mesh_bench(name: str) -> None:
+    """CCX_BENCH_MESH=1: partition-axis-sharded anneal step slope at the
+    config's shape over every visible device (SURVEY.md §5.7 — the
+    long-context analogue). Prints ONE JSON line like the main ladder;
+    vs_baseline is the unsharded/sharded slope ratio at identical work
+    (>1 would mean sharding helps wall-clock on THIS host — on the 1-core
+    virtual mesh expect <=1; the number prices the collective structure
+    for real multi-chip ICI)."""
+    import time as _time
+
+    import jax
+
+    from ccx.goals.base import GoalConfig
+    from ccx.goals.stack import DEFAULT_GOAL_ORDER
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.parallel.sharding import make_mesh, sharded_anneal
+    from ccx.search.annealer import AnnealOptions, anneal
+
+    devices = jax.devices()
+    parts = len(devices)
+    m = random_cluster(bench_spec(name))
+    cfg = GoalConfig()
+    mesh = make_mesh(devices, parts=parts)
+    log(
+        f"[mesh] {name}: P={m.P} B={m.B} mesh="
+        f"{dict(zip(mesh.axis_names, mesh.devices.shape))}"
+    )
+
+    def slope(fn, *extra):
+        res = {}
+        for steps in (10, 50):
+            opts = AnnealOptions(
+                n_chains=8, n_steps=steps, moves_per_step=8, seed=3,
+                batched=True,
+            )
+            fn(m, cfg, DEFAULT_GOAL_ORDER, opts, *extra)  # compile
+            t0 = _time.monotonic()
+            r = fn(m, cfg, DEFAULT_GOAL_ORDER, opts, *extra)
+            jax.block_until_ready(r.model.assignment)
+            res[steps] = _time.monotonic() - t0
+        return (res[50] - res[10]) / 40
+
+    enter_phase(f"mesh:{name}:sharded")
+    s_sharded = slope(sharded_anneal, mesh)
+    enter_phase(f"mesh:{name}:unsharded")
+    s_unsharded = slope(anneal)
+    out = {
+        "metric": f"{name} sharded-anneal step slope ({parts}-device parts mesh)",
+        "value": round(s_sharded * 1e3, 2),
+        "unit": "ms/step",
+        "vs_baseline": round(s_unsharded / max(s_sharded, 1e-9), 3),
+        "unsharded_ms_per_step": round(s_unsharded * 1e3, 2),
+        "backend": jax.default_backend(),
+        "n_devices": parts,
+        "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+    }
+    _state["done"] = True
+    _state["final_json"] = json.dumps(out)
+    print(_state["final_json"], flush=True)
+
+
 def main() -> None:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
@@ -434,6 +495,20 @@ def main() -> None:
                 tail = "\n".join(err_f.read().splitlines()[-3:])
                 log(f"cpu-baseline yielded no JSON (rc={rc}): {tail}")
 
+    # CCX_BENCH_MESH=1: sharded-anneal step-slope at the bench config's
+    # shape over ALL visible devices (parts-axis mesh). The TPU campaign
+    # reuses this mode unchanged if the tunnel ever exposes >1 chip; on the
+    # CPU fallback it runs on the 8-virtual-device mesh. The env must be
+    # set before first backend USE (sitecustomize already imported jax,
+    # but XLA reads the flag at backend init, which is still pending).
+    mesh_mode = os.environ.get("CCX_BENCH_MESH") == "1"
+    if mesh_mode and (backend_forced or os.environ.get("CCX_BENCH_CPU") == "1"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     enter_phase("jax-init")
     import jax
 
@@ -457,6 +532,10 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    if mesh_mode:
+        run_mesh_bench(name)
+        return
 
     # Smoke: tiny B1 in seconds. If the device is wedged this is where the
     # run dies, and the breadcrumb says so. Skipped only when the PROBE

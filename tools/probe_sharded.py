@@ -48,6 +48,41 @@ def timed(fn, *a, **k):
     return time.monotonic() - t0
 
 
+def scaling(m, cfg):
+    """Mesh-LAYOUT sweep at fixed total work (8 chains x batched 8 moves):
+    how the chains/parts split prices on this topology. On the 1-core
+    virtual mesh every layout timeslices one core, so ~equal slopes mean
+    the sharding structure itself costs little and real multi-chip ICI
+    would convert device count into the corresponding axis speedup
+    (chains: embarrassingly parallel; parts: smaller per-device model +
+    one psum per step)."""
+    rows = []
+    for chains_ax, parts_ax in ((1, 8), (2, 4), (4, 2), (8, 1)):
+        mesh = make_mesh(jax.devices(), parts=parts_ax)
+        res = {}
+        for steps in (10, 50):
+            opts = AnnealOptions(
+                n_chains=8, n_steps=steps, moves_per_step=8, seed=3,
+                batched=True,
+            )
+            t = timed(sharded_anneal, m, cfg, DEFAULT_GOAL_ORDER, opts, mesh)
+            res[steps] = t
+        s = (res[50] - res[10]) / 40
+        rows.append(((chains_ax, parts_ax), s))
+        print(
+            f"[sharded-probe] mesh chains={chains_ax} parts={parts_ax}: "
+            f"{s * 1e3:7.1f} ms/step", flush=True
+        )
+    res = {}
+    for steps in (10, 50):
+        opts = AnnealOptions(
+            n_chains=8, n_steps=steps, moves_per_step=8, seed=3, batched=True
+        )
+        res[steps] = timed(anneal, m, cfg, DEFAULT_GOAL_ORDER, opts)
+    s_u = (res[50] - res[10]) / 40
+    print(f"[sharded-probe] unsharded (1 device): {s_u * 1e3:7.1f} ms/step", flush=True)
+
+
 def main():
     n_b = int(os.environ.get("PROBE_BROKERS", "256"))
     n_p = int(os.environ.get("PROBE_PARTS", "16000"))
@@ -57,6 +92,10 @@ def main():
         )
     )
     cfg = GoalConfig()
+    if os.environ.get("PROBE_SCALING") == "1":
+        print(f"[sharded-probe] SCALING P={m.P} B={m.B}", flush=True)
+        scaling(m, cfg)
+        return
     mesh = make_mesh(jax.devices(), parts=4)  # (chains=2, parts=4)
     print(
         f"[sharded-probe] P={m.P} B={m.B} mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}",
