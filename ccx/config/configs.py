@@ -215,6 +215,23 @@ def analyzer_config_def() -> ConfigDef:
              "latency bound, not a convergence knob; the default lets a "
              "round run to convergence. Latency-critical callers lower it.",
              at_least(1))
+    d.define("optimizer.topic.rebalance.guarded", Type.BOOLEAN, True,
+             Importance.LOW,
+             "Run the topic-rebalance stage's re-polish with the "
+             "TopicReplicaDistribution guard first (vetoes moves that "
+             "worsen the TRD tier, so the usage re-polish cannot trade the "
+             "shed's topic cells back), falling back to an unguarded "
+             "polish when the guarded one fails lex adoption.")
+    d.define("optimizer.topic.rebalance.polish.iters", Type.INT, -1,
+             Importance.LOW,
+             "Iteration budget for the topic-rebalance stage's re-polish; "
+             "-1 inherits optimizer.polish.max.iters. A converged shed "
+             "relocates ~55k replicas at B5 scale — the post-shed cleanup "
+             "often needs more budget than the pre-shed polish.",
+             at_least(-1))
+    d.define("optimizer.leader.pass.max.iters", Type.INT, -1, Importance.LOW,
+             "Iteration cap for the final leadership-only pass; -1 = "
+             "uncapped (inherit optimizer.polish.max.iters).", at_least(-1))
     d.define("optimizer.polish.batch.moves", Type.INT, 16, Importance.LOW,
              "Non-conflicting improving moves applied per polish iteration "
              "(disjoint partitions/topics/broker sets; 1 = classic "

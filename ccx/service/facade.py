@@ -160,6 +160,19 @@ class CruiseControl:
             topic_rebalance_move_leaders=self.config[
                 "optimizer.topic.rebalance.move.leaders"
             ],
+            topic_rebalance_guarded=self.config[
+                "optimizer.topic.rebalance.guarded"
+            ],
+            topic_rebalance_polish_iters=(
+                None
+                if self.config["optimizer.topic.rebalance.polish.iters"] < 0
+                else self.config["optimizer.topic.rebalance.polish.iters"]
+            ),
+            leader_pass_max_iters=(
+                None
+                if self.config["optimizer.leader.pass.max.iters"] < 0
+                else self.config["optimizer.leader.pass.max.iters"]
+            ),
             # the portfolio candidate roughly doubles polish-phase cost;
             # never pay it on the leadership-/disk-only fast paths
             run_cold_greedy=(

@@ -40,6 +40,9 @@ L="${1:-tpu_campaign.log}"
   echo "--- bench pass 2 (warm cache; official-style numbers) ---"
   CCX_BENCH_CPU_FIRST=0 timeout -k 60 2400 python bench.py
   echo "bench pass 2 rc=$?"
+  echo "--- sidecar-inclusive T1 at B5 (gRPC hop on the real device) ---"
+  PROBE_CPU=0 timeout -k 60 2400 python tools/bench_sidecar.py B5
+  echo "sidecar rc=$?"
   echo "--- MXU aggregates A/B at B5 ---"
   CCX_MXU_AGGREGATES=0 timeout -k 60 1200 python tools/probe_mxu.py B5
   echo "xla rc=$?"
@@ -50,6 +53,9 @@ L="${1:-tpu_campaign.log}"
   echo "moves-16 rc=$?"
   PROBE_BATCHED=1 PROBE_MOVES=32 PROBE_CHAINS=16 timeout -k 60 1800 python tools/probe_b5.py B5
   echo "moves-32 rc=$?"
+  echo "--- sharded-anneal step slope on the device set ---"
+  CCX_BENCH_MESH=1 CCX_BENCH_CPU_FIRST=0 timeout -k 60 1800 python bench.py
+  echo "mesh rc=$?"
   echo "--- remaining BASELINE configs on hardware (B1-B4, lean effort) ---"
   # pin all four effort knobs to the lean values: bench collapses to ONE
   # honestly-labeled "custom" rung per config instead of climbing
