@@ -137,7 +137,13 @@ RUNGS = {
     # on the CPU fallback it banks the first complete line within ~1 min.
     # lean/full overwrite it as the headline when they complete.
     "target": (16, 500, 8, 150),
-    "lean": (16, 1000, 8, 400),
+    # lean SA retuned 1000 -> 500 steps (round 5): with the shed-first
+    # stage doing the quality work, the extra 500 SA steps measured ZERO
+    # quality difference on every tier (probe_trd, docs/perf-notes.md
+    # round 5) for ~5.5 s of wall — and steps must stay a multiple of
+    # chunk_steps=500 or the chunk-shared compiled program is lost (a
+    # 250-step probe paid a fresh compile).
+    "lean": (16, 500, 8, 400),
     "full": (32, 3000, 16, 1600),
     "custom": (32, 3000, 16, 1600),
 }
