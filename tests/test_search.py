@@ -483,3 +483,37 @@ def test_optimize_guarded_lean_shape_reaches_low_trd():
     before = res.stack_before.by_name()["TopicReplicaDistributionGoal"][0]
     after = res.stack_after.by_name()["TopicReplicaDistributionGoal"][0]
     assert after <= 0.25 * before, (before, after)
+
+
+def test_optimize_enforces_host_distinctness_without_racks():
+    """End-to-end host-fallback property (SURVEY.md C2): a cluster with NO
+    rack information but multi-broker hosts must come out of optimize()
+    with zero rack-aware violations under the HOST-distinctness fallback —
+    and no partition may keep two replicas on brokers of the same host."""
+    from ccx.model.snapshot import arrays_to_model, model_to_arrays
+
+    m0 = random_cluster(RandomClusterSpec(
+        n_brokers=16, n_racks=4, n_topics=6, n_partitions=256,
+        brokers_per_host=2, seed=29,
+    ))
+    arrays = model_to_arrays(m0)
+    del arrays["broker_rack"]          # racks unknown -> host fallback
+    arrays.pop("num_racks", None)
+    m = arrays_to_model(arrays)
+    res = optimize(
+        m, CFG, DEFAULT_GOAL_ORDER,
+        OptimizeOptions(
+            anneal=AnnealOptions(n_chains=4, n_steps=300, seed=3),
+            polish=GreedyOptions(n_candidates=128, max_iters=150, patience=8),
+            run_cold_greedy=False,
+        ),
+    )
+    assert res.verification.ok, res.verification.failures
+    assert res.stack_after.by_name()["RackAwareGoal"][0] == 0.0
+    a = np.asarray(res.model.assignment)
+    hosts = np.asarray(res.model.broker_host)
+    pv = np.asarray(res.model.partition_valid)
+    h = np.where(a >= 0, hosts[np.clip(a, 0, res.model.B - 1)], -1)
+    for row, valid in zip(h[pv], (a >= 0)[pv]):
+        hs = row[valid]
+        assert len(set(hs.tolist())) == hs.size  # distinct hosts per partition

@@ -68,6 +68,7 @@ def main():
             n_candidates=256,
             max_iters=int(os.environ.get("POLISH", "400")),
             patience=16,
+            batch_moves=int(os.environ.get("BATCH", "16")),
         ),
         run_cold_greedy=False,
         run_polish=os.environ.get("POLISH", "400") != "0",
