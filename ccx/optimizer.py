@@ -84,11 +84,17 @@ class OptimizerResult:
     def violation_summary(self) -> dict[str, float]:
         return {n: v for n, (v, _) in self.stack_after.by_name().items() if v > 0}
 
-    def to_json(self) -> dict:
+    def to_json(self, include_proposals: bool = True) -> dict:
         before = self.stack_before.by_name()
         after = self.stack_after.by_name()
         return {
-            "proposals": [p.to_json() for p in self.proposals],
+            # columnar consumers (sidecar columnar_proposals) skip the 60k+
+            # per-proposal dict materialization entirely
+            **(
+                {"proposals": [p.to_json() for p in self.proposals]}
+                if include_proposals
+                else {}
+            ),
             "numReplicaMovements": self.num_replica_movements,
             "numLeadershipMovements": self.num_leadership_movements,
             "goalSummary": [

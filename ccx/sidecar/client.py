@@ -55,8 +55,14 @@ class SidecarClient:
 
     def propose(self, model=None, session: str | None = None,
                 goals: tuple[str, ...] = (), on_progress=None,
-                **options) -> dict:
+                columnar: bool = False, **options) -> dict:
+        """``columnar=True`` requests the proposals as one raw-buffer
+        arrays blob (``diff_columnar`` schema) instead of per-proposal
+        maps — the fast path for B5-scale results; the returned dict then
+        carries numpy arrays under ``proposalsColumnar``."""
         req: dict = {"goals": list(goals), "options": options}
+        if columnar:
+            req["columnar_proposals"] = True
         if model is not None:
             req["snapshot"] = _pack_model(model)
         if session is not None:
@@ -72,6 +78,12 @@ class SidecarClient:
                 result = update["result"]
         if result is None:
             raise RuntimeError("stream ended without a result")
+        if isinstance(result.get("proposalsColumnar"), (bytes, bytearray)):
+            from ccx.model.snapshot import decode_msgpack
+
+            result["proposalsColumnar"] = decode_msgpack(
+                result["proposalsColumnar"]
+            )
         return result
 
     def close(self) -> None:

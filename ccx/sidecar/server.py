@@ -152,7 +152,20 @@ class OptimizerSidecar:
         yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}
         res = optimize(model, self.goal_config, goals, opts)
         yield {"progress": "Diff + verification done"}
-        yield {"result": res.to_json()}
+        columnar = bool(req.get("columnar_proposals"))
+        result = res.to_json(include_proposals=not columnar)
+        if columnar:
+            # proposals-down dominated the hop's wire cost at B5 (~0.9 s of
+            # per-proposal maps for ~60k proposals — perf-notes "Sidecar-
+            # inclusive T1"); columnar mode replaces the row list with one
+            # raw-buffer arrays blob (ccx.proposals.diff_columnar schema)
+            from ccx.model.snapshot import pack_arrays
+            from ccx.proposals import diff_columnar
+
+            cols = diff_columnar(res.input_model, res.model)
+            result["numProposals"] = int(cols["partition"].shape[0])
+            result["proposalsColumnar"] = pack_arrays(cols)
+        yield {"result": result}
 
     def ping(self, request: bytes) -> bytes:
         import jax

@@ -151,3 +151,37 @@ def test_grpc_error_surfaces(model):
         c.close()
     finally:
         server.stop(0)
+
+
+def test_sidecar_columnar_proposals_agree_with_rows():
+    """columnar_proposals replaces the per-proposal maps with one
+    raw-buffer arrays blob; rows and columns must describe the SAME set of
+    movements (columns keep slot order with -1 pads; rows compact)."""
+    import msgpack
+    import numpy as np
+
+    from ccx.model.snapshot import decode_msgpack, to_msgpack as pack
+
+    sidecar = OptimizerSidecar()
+    m = small_deterministic()
+    base = {"snapshot": pack(m), "goals": [],
+            "options": {"chains": 4, "steps": 50}}
+    rows_res = [u["result"] for u in sidecar.propose(msgpack.packb(base))
+                if "result" in u][0]
+    cols_res = [u["result"] for u in sidecar.propose(
+        msgpack.packb({**base, "columnar_proposals": True}))
+        if "result" in u][0]
+    assert "proposals" not in cols_res
+    cols = decode_msgpack(cols_res["proposalsColumnar"])
+    n = cols_res["numProposals"]
+    assert cols["partition"].shape == (n,)
+    assert len(rows_res["proposals"]) == n
+    by_part = {p["topicPartition"]["partition"]: p
+               for p in rows_res["proposals"]}
+    for i in range(n):
+        p = by_part[int(cols["partition"][i])]
+        assert sorted(b for b in cols["newReplicas"][i] if b >= 0) == sorted(
+            p["newReplicas"]
+        )
+        assert int(cols["newLeader"][i]) == p["newLeader"]
+        assert int(cols["oldLeader"][i]) == p["oldLeader"]

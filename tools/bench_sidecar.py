@@ -84,6 +84,16 @@ def main() -> None:
         out[f"verified_{label}"] = bool(res.get("verified", False))
         out[f"proposals_{label}"] = len(res.get("proposals", []))
 
+    # columnar proposals-down (the warm hop's dominant wire term)
+    t0 = time.monotonic()
+    res = client.propose(session="t1", columnar=True, **LEAN_OPTIONS)
+    out["propose_columnar_s"] = round(time.monotonic() - t0, 3)
+    out["optimize_columnar_s"] = round(res["wallSeconds"], 3)
+    out["hop_overhead_columnar_s"] = round(
+        out["propose_columnar_s"] - out["optimize_columnar_s"], 3
+    )
+    out["columnar_rows"] = int(res.get("numProposals", -1))
+
     # warm-generation delta path: leadership of partition 0 moves
     base = model_to_arrays(m)
     new = dict(base)
