@@ -367,6 +367,14 @@ def webserver_config_def() -> ConfigDef:
              "REST server bind address.")
     d.define("webserver.http.port", Type.INT, 9090, Importance.HIGH,
              "REST server port.", between(0, 65535))
+    d.define("webserver.openapi.port", Type.INT, 0, Importance.LOW,
+             "Port for the second, OpenAPI-contract-routed asyncio API "
+             "surface (ref C36, the optional Vert.x module). 0 disables it "
+             "(the upstream module is optional too); both surfaces share "
+             "one dispatch/auth/review path so behavior cannot drift.",
+             between(0, 65535))
+    d.define("webserver.openapi.address", Type.STRING, "127.0.0.1",
+             Importance.LOW, "Bind address for the OpenAPI surface.")
     d.define("webserver.api.urlprefix", Type.STRING, "/kafkacruisecontrol/*",
              Importance.LOW, "Endpoint URL prefix.")
     d.define("webserver.session.maxExpiryPeriodMs", Type.LONG, 60_000,

@@ -84,6 +84,19 @@ def main(argv: list[str] | None = None) -> int:
     host, port = app.start()
     logging.info("ccx REST API listening on http://%s:%s%s", host, port,
                  "/kafkacruisecontrol/state")
+    openapi_server = None
+    if cfg["webserver.openapi.port"] > 0:
+        from ccx.servlet.openapi_server import OpenApiServer
+
+        openapi_server = OpenApiServer(
+            app, cfg["webserver.openapi.address"],
+            cfg["webserver.openapi.port"],
+        )
+        oa_host, oa_port = openapi_server.start()
+        logging.info(
+            "ccx OpenAPI surface listening on http://%s:%s%s",
+            oa_host, oa_port, "/kafkacruisecontrol/openapi",
+        )
 
     stop = {"flag": False}
 
@@ -98,6 +111,8 @@ def main(argv: list[str] | None = None) -> int:
     except (KeyboardInterrupt, AttributeError):
         pass
     finally:
+        if openapi_server is not None:
+            openapi_server.stop()
         app.stop()
         facade.shutdown()
     return 0

@@ -150,14 +150,12 @@ def diff_columnar(
     )
     ps = np.nonzero(changed)[0]
     n = ps.size
-    rows = np.arange(n)
     old_lead = np.where(
         (a0[ps] >= 0).any(axis=1), a0[ps, np.clip(l0[ps], 0, a0.shape[1] - 1)], -1
     )
     new_lead = np.where(
         (a1[ps] >= 0).any(axis=1), a1[ps, np.clip(l1[ps], 0, a1.shape[1] - 1)], -1
     )
-    del rows
     return {
         "partition": ps.astype(np.int32),
         "topic": topics[ps].astype(np.int32),

@@ -1,12 +1,14 @@
 """OpenAPI 3.0 document generated from the endpoint registry.
 
 Parity: the reference's optional Vert.x module (SURVEY.md C36) mirrors the
-servlet endpoints behind an OpenAPI contract. ccx takes the contract part
-without a second server: one spec generated from the single source of truth
-(``ccx.servlet.endpoints.EndPoint`` + ``PARAMETERS``), served at
-``GET /kafkacruisecontrol/openapi`` — clients get the same machine-readable
-surface the Vert.x module exists to provide, with zero drift risk because
-there is no second endpoint table to maintain.
+servlet endpoints behind an OpenAPI contract. This spec is generated from
+the single source of truth (``ccx.servlet.endpoints.EndPoint`` +
+``PARAMETERS``) and served at ``GET /kafkacruisecontrol/openapi`` — zero
+drift risk because there is no second endpoint table to maintain. The
+document is also the ROUTE TABLE of the second API surface
+(``ccx.servlet.openapi_server.OpenApiServer``, enabled by
+``webserver.openapi.port``), which validates every request against this
+contract before dispatch — the Vert.x module's contract-first design.
 """
 
 from __future__ import annotations

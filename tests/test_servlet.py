@@ -63,7 +63,8 @@ def server():
         cc.load_monitor.sample_once()
     app = CruiseControlApp(cfg, cc, clock=lambda: clock["now"])
     host, port = app.start()
-    yield {"host": host, "port": port, "cc": cc, "sim": sim, "clock": clock}
+    yield {"host": host, "port": port, "cc": cc, "sim": sim, "clock": clock,
+           "app": app}
     app.stop()
     cc.shutdown()
 
@@ -504,3 +505,69 @@ def test_spnego_provider_import_guard():
 
     with pytest.raises(ImportError, match="gssapi"):
         SpnegoSecurityProvider()
+
+
+# ----- OpenAPI second surface (ref C36) -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def openapi_server(server):
+    """The contract-routed asyncio surface in front of the SAME app."""
+    from ccx.servlet.openapi_server import OpenApiServer
+
+    srv = OpenApiServer(server_app(server), "127.0.0.1", 0)
+    host, port = srv.start()
+    yield {"host": host, "port": port}
+    srv.stop()
+
+
+def server_app(server):
+    # the module fixture yields the app indirectly via the bound port; keep
+    # a direct handle for the second surface
+    return server["app"]
+
+
+def test_openapi_surface_serves_contract_and_state(openapi_server):
+    status, body, _ = _one_request(
+        openapi_server, "GET", "/kafkacruisecontrol/openapi"
+    )
+    assert status == 200 and body["openapi"].startswith("3.")
+    status, body, _ = _one_request(
+        openapi_server, "GET",
+        "/kafkacruisecontrol/state?substates=monitor",
+    )
+    assert status == 200 and "MonitorState" in body
+
+
+def test_openapi_surface_rejects_contract_violations(openapi_server):
+    # unknown path
+    status, body, _ = _one_request(openapi_server, "GET", "/nope")
+    assert status == 400 and "contract" in body["errorMessage"]
+    # method not in contract
+    status, body, _ = _one_request(
+        openapi_server, "POST", "/kafkacruisecontrol/state"
+    )
+    assert status == 400 and "does not support" in body["errorMessage"]
+    # unknown parameter
+    status, body, _ = _one_request(
+        openapi_server, "GET", "/kafkacruisecontrol/state?bogus=1"
+    )
+    assert status == 400 and "bogus" in body["errorMessage"]
+    # type mismatch against the contract schema
+    status, body, _ = _one_request(
+        openapi_server, "GET",
+        "/kafkacruisecontrol/partition_load?max_load_entries=abc",
+    )
+    assert status == 400 and "integer" in body["errorMessage"]
+
+
+def test_openapi_surface_runs_async_verbs(openapi_server):
+    # a POST verb through the second surface uses the same user-task
+    # machinery (202 + User-Task-ID replay) as the servlet
+    status, body, _ = request(
+        openapi_server, "POST",
+        "/kafkacruisecontrol/rebalance?dryrun=true&json=true",
+    )
+    assert status == 200, body
+    s = body.get("summary", body)
+    assert s.get("verified") is True
