@@ -310,6 +310,17 @@ def canonicalize_preferred_leaders(
     return out, int(idx.size)
 
 
+def _group_ranks(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(rank, group_start) per element of a SORTED key array: rank counts
+    earlier elements with the same key; group_start indexes each element's
+    first group member. One implementation for the shed's three segment-
+    rank uses (topic fan-out occ, per-dest intake, per-(dest, topic) room)."""
+    idx = np.arange(keys.size)
+    seg = np.r_[True, keys[1:] != keys[:-1]] if keys.size else np.zeros(0, bool)
+    start = np.maximum.accumulate(np.where(seg, idx, 0))
+    return idx - start, start
+
+
 def topic_rebalance(
     m: TensorClusterModel,
     cfg: GoalConfig,
@@ -484,6 +495,16 @@ def topic_rebalance(
         fc = np.sort(np.unique(cell, return_index=True)[1])
         ps, rs = ps[fc], rs[fc]
         ts = topic[ps]
+        # occurrence rank of each candidate within its topic (sweep-stable):
+        # candidates of ONE topic fan out over DIFFERENT destinations in the
+        # same round (dest rank = round + topic rotation + occ), instead of
+        # all chasing the topic's single rank-k dest — the per-(topic, dest)
+        # band room (~1-2) otherwise caps a topic at ~1 accept per round and
+        # the loop at ~60 moves/round x ~900 rounds (profiled round 5).
+        t_order = np.argsort(ts, kind="stable")
+        t_inv = np.empty_like(t_order)
+        t_inv[t_order] = np.arange(ts.size)
+        occ = _group_ranks(ts[t_order])[0][t_inv]
         lead_row = is_l[ps, rs]
         # new-leader slot: the first OTHER valid replica slot whose broker
         # can actually accept leadership (alive, not leadership-excluded) —
@@ -545,7 +566,7 @@ def topic_rebalance(
                 rank_k, kl = kl, kl + 1
             else:
                 rank_k, kf = kf, kf + 1
-            dest = top_dest[ts, (rank_k + ts) % top_dest.shape[1]]
+            dest = top_dest[ts, (rank_k + ts + occ) % top_dest.shape[1]]
             ok = np.isfinite(dest_score[ts, dest])
             ok &= lead_row if lead_round else ~lead_row
             # counts is maintained per move, so the band-room check is
@@ -601,13 +622,10 @@ def topic_rebalance(
                 order = np.lexsort((ts[oi], dest[oi]))
                 ois = oi[order]
                 d_s, t_s = dest[ois], ts[ois]
-                idx = np.arange(ois.size)
-                seg_d = np.r_[True, d_s[1:] != d_s[:-1]]
-                start_d = np.maximum.accumulate(np.where(seg_d, idx, 0))
-                rank_d = idx - start_d
-                seg_td = seg_d | np.r_[True, t_s[1:] != t_s[:-1]]
-                start_td = np.maximum.accumulate(np.where(seg_td, idx, 0))
-                rank_td = idx - start_td
+                rank_d, start_d = _group_ranks(d_s)
+                # (dest, topic) pairs are sorted by the lexsort, so the
+                # combined key is sorted too
+                rank_td, _ = _group_ranks(d_s.astype(np.int64) * T + t_s)
                 load_s = foll_load[:, ps[ois]]               # [RES, n]
                 cum = np.cumsum(load_s, axis=1)
                 grp_base = (cum - load_s)[:, start_d]
@@ -700,6 +718,7 @@ def topic_rebalance(
                 keep[oi] = False
                 ps, rs, ts = ps[keep], rs[keep], ts[keep]
                 lead_row, b2, nl = lead_row[keep], b2[keep], nl[keep]
+                occ = occ[keep]
             # candidates that found no destination this round retry the
             # next-ranked destination in the following round
         total_moved += moved
