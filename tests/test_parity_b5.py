@@ -161,7 +161,7 @@ def test_b5_lean_rung_quality_is_banked():
     m = random_cluster(bench_spec("B5"))
     opts = OptimizeOptions(
         anneal=AnnealOptions(
-            n_chains=16, n_steps=1000, moves_per_step=8, seed=42,
+            n_chains=16, n_steps=500, moves_per_step=8, seed=42,
             chunk_steps=500,
         ),
         polish=GreedyOptions(n_candidates=256, max_iters=400, patience=16),
@@ -179,7 +179,7 @@ def test_b5_lean_rung_quality_is_banked():
 
     ARTIFACT_LEAN.write_text(json.dumps({
         "config": "B5 (1000 brokers / 100k partitions), bench lean rung",
-        "effort": {"chains": 16, "steps": 1000, "moves": 8,
+        "effort": {"chains": 16, "steps": 500, "moves": 8,
                    "pre_polish": False, "trd_repolish_iters": 700,
                    "trd_rounds": 1, "trd_move_leaders": True,
                    "trd_guarded": True, "leader_pass_max_iters": 300},
