@@ -1,0 +1,96 @@
+"""Compile-cache observability — counts XLA compiles and persistent-cache
+hits as they happen.
+
+The T1 budget only holds while every B5-shape program is served from the
+jit cache (in-process) or the persistent ``.jax_cache/`` (cold process):
+one silent recompile of the SA chunk or the greedy while_loop costs minutes
+on TPU and invalidates the phase math (docs/perf-notes.md "the T1 chase";
+round-4 window: a polish compile >17 min). JAX already emits monitoring
+events for exactly these transitions; this module turns them into counters
+the bench can difference around each phase, so BENCH_r*.json records
+cache hit-ness per rung and tests/test_bench_contract.py can assert the
+warm run performed ZERO fresh compiles.
+
+Counters (cumulative since listener registration):
+
+* ``backend_compiles`` / ``backend_compile_secs`` — actual XLA backend
+  compiles in this process (``/jax/core/compile/backend_compile_duration``).
+  Fires whether or not any cache is configured; a warm in-process rerun of
+  an already-traced program fires nothing.
+* ``persistent_hits`` — programs LOADED from the persistent compilation
+  cache (``/jax/compilation_cache/cache_hits``): a process-cold but
+  disk-warm path — no fresh compile paid.
+* ``persistent_misses`` — fresh compiles WRITTEN to the persistent cache
+  (``/jax/compilation_cache/cache_misses``): the cold path; each of these
+  was a real compile the next process avoids. Entries below the
+  min-compile-time/size thresholds never count.
+
+Listeners are registered once per process, lazily at first ``snapshot()``;
+``jax.monitoring`` fans events out to every listener, so coexisting
+observers are unaffected. Thread-safe: events may fire from any thread
+(the gRPC sidecar compiles in worker threads), so counters take a lock.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_COUNTS = {
+    "backend_compiles": 0,
+    "backend_compile_secs": 0.0,
+    "persistent_hits": 0,
+    "persistent_misses": 0,
+}
+_LOCK = threading.Lock()
+_REGISTERED = False
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _CACHE_HIT_EVENT:
+        with _LOCK:
+            _COUNTS["persistent_hits"] += 1
+    elif event == _CACHE_MISS_EVENT:
+        with _LOCK:
+            _COUNTS["persistent_misses"] += 1
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event == _BACKEND_COMPILE_EVENT:
+        with _LOCK:
+            _COUNTS["backend_compiles"] += 1
+            _COUNTS["backend_compile_secs"] += float(duration)
+
+
+def _ensure_registered() -> None:
+    global _REGISTERED
+    import jax.monitoring
+
+    # registration is idempotent at the module level only — the lock makes
+    # the check-then-register atomic so two threads taking their first
+    # snapshot() concurrently (bench main thread + a sidecar worker) can
+    # never double-register and double-count every compile
+    with _LOCK:
+        if _REGISTERED:
+            return
+        jax.monitoring.register_event_listener(_on_event)
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _REGISTERED = True
+
+
+def snapshot() -> dict:
+    """Cumulative counters so far (registers the listeners on first use —
+    call once early so no compile predates the listeners)."""
+    _ensure_registered()
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+def delta(before: dict, after: dict) -> dict:
+    """Counter difference between two snapshots, rounded for JSON."""
+    d = {k: after[k] - before[k] for k in _COUNTS}
+    d["backend_compile_secs"] = round(d["backend_compile_secs"], 2)
+    return d

@@ -243,6 +243,22 @@ def analyzer_config_def() -> ConfigDef:
              "portfolio pattern). Costs roughly one extra polish-budget run "
              "per optimize() call; disable for latency-sensitive endpoints. "
              "Leadership-only and disk-only fast paths skip it regardless.")
+    d.define("optimizer.repair.backend", Type.STRING, "device",
+             Importance.LOW,
+             "hard_repair loop driver: 'device' runs the whole sweep loop "
+             "as one compiled program (traced sweep budget, no per-sweep "
+             "host syncs — repair leaves the host-blocking critical path); "
+             "'host' restores the python loop (one jitted sweep + one sync "
+             "per iteration), the fallback and parity reference.",
+             one_of("device", "host"))
+    d.define("optimizer.repair.overlap", Type.BOOLEAN, False, Importance.LOW,
+             "Overlap hard repair with the first SA chunk: repair runs in "
+             "a background thread while the first chunk anneals the "
+             "still-infeasible input, then the candidates lex-merge. Only "
+             "buys wall-clock where repair executes outside the device "
+             "stream the SA chunk occupies (host-backend repair on a "
+             "multi-core host); the default pipelined device repair "
+             "already keeps repair off the critical path.")
     d.define("optimizer.profile.dir", Type.STRING, "", Importance.LOW,
              "When non-empty, capture a jax.profiler (XProf/TensorBoard) "
              "device trace of each proposal computation into this directory "

@@ -186,12 +186,35 @@ def allows_inter_broker(goal_names: tuple[str, ...]) -> bool:
     return not set(goal_names) <= INTRA_ONLY_GOALS
 
 
-def _pad_pow2(idx: np.ndarray) -> tuple[np.ndarray, int]:
+def _evac_bucket(P: int) -> int:
+    """Static offender-count bucket for a model with padded partition
+    count P — ONE sizing rule shared by the SA hot-list operand (here) and
+    the repair sweeps' per-sweep offender bound (repair._repair_nk), so a
+    retune moves both together.
+
+    Hot-list lengths vary snapshot to snapshot, and every program taking
+    the list as an operand (the chunk runner, the greedy loop) is compiled
+    per operand SHAPE — the old next-pow2 bucketing silently recompiled
+    the multi-minute B5 programs whenever the offender count crossed a
+    bucket. One fixed size pins the program; it must also stay SMALL: the
+    operand rides through every while_loop iteration, and a full-P pad
+    measured +2 s/500-step B5 SA chunk and +7 ms/greedy-polish iteration
+    on CPU vs a 4k pad (+5 s on the lean rung's 700-iter re-polish).
+    P//16 (>=1024) covers post-repair offender counts with an order of
+    magnitude to spare (B5: ~2k structural offenders vs 8192); the host
+    path escapes to a second P-sized program for pathological snapshots,
+    so there are at most TWO programs per model shape, both stable."""
+    return min(P, max(1024, P // 16))
+
+
+def _pad_fixed(idx: np.ndarray, size: int) -> tuple[np.ndarray, int]:
+    """Pad an offender-index list to a fixed size (see _evac_bucket). The
+    pad region is never read (draws index strictly below n_evac) and the
+    array is shared, not per-chain."""
     n = len(idx)
-    pad = 1
-    while pad < max(n, 1):
-        pad *= 2
-    return np.pad(idx, (0, pad - n)), n
+    out = np.zeros(max(size, 1), np.int32)
+    out[:n] = idx
+    return out, n
 
 
 def hot_partition_list(
@@ -257,7 +280,76 @@ def hot_partition_list(
             on_over = valid & over_b[np.clip(a, 0, m.B - 1)]
             hot.update(np.unique(np.nonzero(on_over)[0]).tolist())
     idx = np.asarray(sorted(hot), np.int32)
-    return _pad_pow2(idx)
+    bucket = _evac_bucket(m.P)
+    return _pad_fixed(idx, bucket if len(idx) <= bucket else m.P)
+
+
+@functools.partial(jax.jit, static_argnames=("goal_names", "cfg"))
+def hot_partition_list_device(
+    m: TensorClusterModel,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """`hot_partition_list` as one jitted program over the model's DEVICE
+    arrays: (evac int32[_evac_bucket(P)] — sorted offender ids, 0-padded;
+    n_evac scalar).
+
+    The host version materializes the placement to numpy, which blocks the
+    caller on everything queued ahead of it. The optimizer's pipelined
+    device-repair path (`OptimizeOptions.repair_backend="device"`) instead
+    derives the list from the repaired arrays on device, so the chain
+    repair -> hot list -> chain init -> SA chunks is dispatched without a
+    single host sync. Same selection rules as the host version: structural
+    offenders (dead broker/disk, rack duplicates when the stack has a rack
+    goal), else capacity offenders for capacity-scoring stacks."""
+    P, B, D, R = m.P, m.B, m.D, m.R
+    a = m.assignment
+    pvalid = m.partition_valid
+    valid = (a >= 0) & pvalid[:, None]
+    safe_b = jnp.clip(a, 0, B - 1)
+    hot = jnp.zeros(P, bool)
+    allow_inter = allows_inter_broker(goal_names)
+    if allow_inter:
+        on_dead = valid & ~(m.broker_alive & m.broker_valid)[safe_b]
+        hot = hot | jnp.any(on_dead, axis=1)
+    rd = m.replica_disk
+    dead_disk = (
+        valid & (rd >= 0) & ~m.disk_alive[safe_b, jnp.clip(rd, 0, D - 1)]
+    )
+    hot = hot | jnp.any(dead_disk, axis=1)
+    if RACK_TARGET_GOALS & set(goal_names):
+        racks = jnp.where(
+            valid, m.broker_rack[safe_b], -1 - jnp.arange(R, dtype=jnp.int32)
+        )
+        dup = (racks[:, :, None] == racks[:, None, :]) & (
+            jnp.arange(R)[:, None] < jnp.arange(R)[None, :]
+        )
+        hot = hot | (jnp.any(dup, axis=(1, 2)) & pvalid)
+    if allow_inter and CAPACITY_GOALS & set(goal_names):
+        # capacity offenders only when NO structural offender exists —
+        # same dilution rule as the host version
+        from ccx.model.aggregates import broker_aggregates
+
+        thr = jnp.asarray(cfg.capacity_threshold, jnp.float32)
+        agg = broker_aggregates(m)
+        cap = m.broker_capacity * thr[:, None]
+        util = jnp.max(
+            jnp.where(cap > 0, agg.broker_load / jnp.where(cap > 0, cap, 1.0), 0.0),
+            axis=0,
+        )
+        over_b = (m.broker_alive & m.broker_valid) & (util > 1.0)
+        hot_cap = jnp.any(valid & over_b[safe_b], axis=1)
+        hot = jnp.where(jnp.any(hot), hot, hot_cap)
+    # static bucket size (see _evac_bucket): the device program cannot
+    # data-dependently escape to a P-sized pad like the host path, so a
+    # pathological overflow truncates to the lowest `bucket` offender ids —
+    # that only biases which hot partitions SA prioritizes for a few
+    # sweeps, never feasibility (acceptance + repair still guard them)
+    bucket = _evac_bucket(P)
+    idx = jnp.nonzero(hot, size=bucket, fill_value=0)[0].astype(jnp.int32)
+    n = jnp.minimum(jnp.sum(hot), bucket).astype(jnp.int32)
+    return idx, n
 
 
 def _draw_partition(
@@ -1200,6 +1292,7 @@ def anneal(
     goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
     opts: AnnealOptions = AnnealOptions(),
     mesh=None,
+    evac=None,
 ) -> AnnealResult:
     """Run batched SA and return the best chain's placement as a new model.
 
@@ -1215,12 +1308,20 @@ def anneal(
     model and evacuation list are replicated. ``opts.n_chains`` must divide
     evenly by the mesh size. Partition-axis sharding of the model inside the
     search lives in ccx.parallel (sharded stack evaluation; sharded search).
+
+    ``evac`` optionally supplies a precomputed hot-partition list as
+    ``(indices int32[P], count)`` — device arrays are fine. The optimizer's
+    pipelined device-repair path passes `hot_partition_list_device` output
+    so this function never has to materialize the (possibly still
+    in-flight) placement to host; None computes the host list as before.
     """
     stack_before = evaluate_stack(m, cfg, goal_names)
     p_real = int(np.asarray(m.partition_valid).sum())
     bv = np.asarray(m.broker_valid)
     b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
-    evac, n_evac = hot_partition_list(m, goal_names, cfg)
+    evac, n_evac = (
+        evac if evac is not None else hot_partition_list(m, goal_names, cfg)
+    )
 
     keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
     if mesh is not None:

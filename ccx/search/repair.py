@@ -32,15 +32,12 @@ from ccx.model.tensor_model import TensorClusterModel, build_model
 from ccx.search.annealer import (
     CAPACITY_GOALS,
     RACK_TARGET_GOALS,
+    _evac_bucket,
     allows_inter_broker,
 )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("target_rack", "target_capacity", "cfg", "nk"),
-)
-def _sweep(
+def _sweep_impl(
     m: TensorClusterModel,
     assignment: jnp.ndarray,   # int32[P, R]
     leader_slot: jnp.ndarray,  # int32[P]
@@ -258,6 +255,81 @@ def _sweep(
     )
     n_struct = jnp.sum(pvalid & jnp.any(structural, axis=1))
     return new_assignment, new_replica_disk, n_moved, n_over_b, n_struct
+
+
+#: host-path entry: one jitted sweep per call (the round-2 design; the
+#: hard_repair loop around it syncs n_moved per sweep). The device path
+#: compiles the same body inside `_repair_loop`'s while_loop instead.
+_sweep = jax.jit(
+    _sweep_impl,
+    static_argnames=("target_rack", "target_capacity", "cfg", "nk"),
+)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("target_rack", "target_capacity", "cfg", "nk"),
+)
+def _repair_loop(
+    m: TensorClusterModel,
+    assignment: jnp.ndarray,
+    leader_slot: jnp.ndarray,
+    replica_disk: jnp.ndarray,
+    key: jnp.ndarray,
+    max_sweeps: jnp.ndarray,   # int32 scalar — TRACED budget (one program
+    #                            per model shape serves every sweep budget)
+    *,
+    target_rack: bool,
+    target_capacity: bool,
+    cfg: GoalConfig,
+    nk: int,
+):
+    """Device-resident hard repair: the whole sweep loop as ONE compiled
+    program (`optimizer.repair.backend=device`).
+
+    The host path dispatches one jitted `_sweep` per iteration and syncs
+    `n_moved` back after each — at B5 on the tunneled TPU that is eight
+    dispatch+transfer round trips on the critical path, and the repair
+    phase cannot overlap with anything downstream. Here the loop runs as a
+    `lax.while_loop` with the SAME body (`_sweep_impl`), the SAME per-sweep
+    key-split sequence, and the SAME stop conditions (no moves, or
+    capacity-shed oscillation with zero structural offenders), so the
+    result is bit-comparable to the host loop (pinned by
+    tests/test_repair.py parity); the single dispatch returns lazy arrays
+    the caller can feed straight into the annealer without a host sync.
+
+    Returns (assignment, replica_disk, total_moved[int32 scalar]).
+    """
+
+    def cond(carry):
+        _, _, _, i, _, _, done = carry
+        return (~done) & (i < max_sweeps)
+
+    def body(carry):
+        a, d, key, i, total, prev_over, done = carry
+        key, sub = jax.random.split(key)
+        a, d, n, n_over, n_struct = _sweep_impl(
+            m, a, leader_slot, d, sub,
+            target_rack=target_rack, target_capacity=target_capacity,
+            cfg=cfg, nk=nk,
+        )
+        total = total + n
+        # same break rules as the host loop: stop on a no-move sweep, or on
+        # capacity-shed oscillation (over-broker count not decreasing) once
+        # no structural offender remained when the sweep ran. prev_over
+        # starts at -1 (the host loop's `prev_over is None`).
+        osc = (n_struct == 0) & (prev_over > 0) & (prev_over <= n_over)
+        done = (n == 0) | osc
+        return a, d, key, i + 1, total, n_over, done
+
+    zero = jnp.asarray(0, jnp.int32)
+    a, d, _, _, total, _, _ = jax.lax.while_loop(
+        cond,
+        body,
+        (assignment, replica_disk, key, zero, zero,
+         jnp.asarray(-1, jnp.int32), jnp.asarray(False)),
+    )
+    return a, d, total
 
 
 def canonicalize_preferred_leaders(
@@ -774,6 +846,51 @@ def _leader_fix(m: TensorClusterModel, assignment, leader_slot):
     return jnp.where(cur_ok | ~any_ok, leader_slot, first_ok)
 
 
+def _repair_nk(m: TensorClusterModel, nk: int | None) -> int:
+    # static per-sweep offender bound: [nk, B] scoring matrices instead of
+    # [P, B] (0.5 GB of temporaries at B5). The P//16 bucket (shared with
+    # the SA hot-list operand — ONE sizing rule, see _evac_bucket) covers
+    # typical offender densities in one or two sweeps; the sweep loop
+    # retries while offenders remain, so a larger spill only costs extra
+    # sweeps, never correctness.
+    if nk is None:
+        return _evac_bucket(m.P)
+    return nk
+
+
+def hard_repair_async(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...],
+    max_sweeps: int = 8,
+    seed: int = 17,
+    nk: int | None = None,
+) -> tuple[TensorClusterModel, jnp.ndarray]:
+    """Device-backend repair WITHOUT a host sync: dispatches the single
+    `_repair_loop` program and returns (model of lazy arrays, total-moves
+    device scalar). The optimizer's pipelined path feeds the arrays
+    straight into the annealer — repair leaves the host-blocking critical
+    path entirely (its device time folds into the anneal phase's queue,
+    and on the tunneled TPU the eight per-sweep round trips disappear)."""
+    target_rack = bool(RACK_TARGET_GOALS & set(goal_names))
+    target_capacity = bool(CAPACITY_GOALS & set(goal_names))
+    assignment, replica_disk = m.assignment, m.replica_disk
+    total = jnp.asarray(0, jnp.int32)
+    if allows_inter_broker(goal_names):
+        assignment, replica_disk, total = _repair_loop(
+            m, assignment, m.leader_slot, replica_disk,
+            jax.random.PRNGKey(seed), jnp.asarray(max_sweeps, jnp.int32),
+            target_rack=target_rack, target_capacity=target_capacity,
+            cfg=cfg, nk=_repair_nk(m, nk),
+        )
+    leader_slot = _leader_fix(m, assignment, m.leader_slot)
+    out = m.replace(
+        assignment=assignment, leader_slot=leader_slot,
+        replica_disk=replica_disk,
+    )
+    return out, total
+
+
 def hard_repair(
     m: TensorClusterModel,
     cfg: GoalConfig,
@@ -781,25 +898,34 @@ def hard_repair(
     max_sweeps: int = 8,
     seed: int = 17,
     nk: int | None = None,
+    backend: str = "host",
 ) -> tuple[TensorClusterModel, int]:
     """Sweep until no targetable hard offenders remain (or max_sweeps).
 
     Returns (repaired model, total moves). Only runs the placement sweep for
     stacks that allow inter-broker movement; leader placement is fixed in
     all cases. ``nk`` overrides the per-sweep offender bound (tests).
+
+    ``backend`` selects the loop driver (config `optimizer.repair.backend`):
+    "device" runs the whole sweep loop as one compiled program
+    (`_repair_loop` — traced sweep budget, no per-sweep host syncs);
+    "host" is the round-2 python loop, kept as the fallback and the
+    parity reference. Both share `_sweep_impl`, the per-sweep key-split
+    sequence and the stop rules, so their repaired states agree (pinned by
+    tests/test_repair.py::test_device_repair_parity_with_host).
     """
+    if backend == "device":
+        out, total = hard_repair_async(
+            m, cfg, goal_names, max_sweeps=max_sweeps, seed=seed, nk=nk
+        )
+        return out, int(total)
     target_rack = bool(RACK_TARGET_GOALS & set(goal_names))
     target_capacity = bool(CAPACITY_GOALS & set(goal_names))
     assignment = m.assignment
     leader_slot = m.leader_slot
     replica_disk = m.replica_disk
     total = 0
-    # static per-sweep offender bound: [nk, B] scoring matrices instead of
-    # [P, B] (0.5 GB of temporaries at B5). P/16 covers typical offender
-    # densities in one or two sweeps; the loop below retries while offenders
-    # remain, so a larger spill only costs extra sweeps, never correctness.
-    if nk is None:
-        nk = min(m.P, max(1024, m.P // 16))
+    nk = _repair_nk(m, nk)
     if allows_inter_broker(goal_names):
         key = jax.random.PRNGKey(seed)
         prev_over = None

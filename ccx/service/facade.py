@@ -179,6 +179,8 @@ class CruiseControl:
                 self.config["optimizer.portfolio.cold.greedy"]
                 and not (leadership_only or disk_only)
             ),
+            repair_backend=self.config["optimizer.repair.backend"],
+            overlap_repair=self.config["optimizer.repair.overlap"],
         )
 
     def _run_optimizer(self, model, goal_names, opts: OptimizeOptions,

@@ -111,6 +111,15 @@ class OptimizerSidecar:
         if "StructuralFeasibility" not in goals:
             goals = ("StructuralFeasibility",) + tuple(goals)
         o = req.get("options") or {}
+        repair_backend = str(o.get("repair_backend", "device"))
+        if repair_backend not in ("device", "host"):
+            # mirror the config layer's one_of gate: a misspelled backend
+            # must fail the RPC loudly, not silently select the slow
+            # per-sweep-sync host loop
+            raise ValueError(
+                f"repair_backend must be 'device' or 'host', "
+                f"got {repair_backend!r}"
+            )
         opts = OptimizeOptions(
             anneal=AnnealOptions(
                 n_chains=int(o.get("chains", 32)),
@@ -118,16 +127,27 @@ class OptimizerSidecar:
                 moves_per_step=int(o.get("moves_per_step", 8)),
                 seed=int(o.get("seed", 42)),
                 # resident sidecar: one compiled chunk program serves any
-                # requested step budget (see AnnealOptions.chunk_steps)
-                chunk_steps=int(o.get("chunk_steps", 500)),
+                # requested step budget (see AnnealOptions.chunk_steps).
+                # 250 matches the bench ladder's shared chunk so a client
+                # omitting the field reuses the SAME compiled program
+                # instead of forcing a second multi-minute B5 compile
+                chunk_steps=int(o.get("chunk_steps", 250)),
             ),
             polish=GreedyOptions(
                 n_candidates=int(o.get("polish_candidates", 256)),
                 max_iters=int(o.get("polish_max_iters", 400)),
+                patience=int(o.get("polish_patience", 8)),
+                batch_moves=int(o.get("polish_batch_moves", 16)),
+                swap_fraction=float(o.get("polish_swap_fraction", 0.25)),
             ),
             check_evacuation=bool(o.get("check_evacuation", True)),
+            max_repair_rounds=int(o.get("max_repair_rounds", 3)),
+            require_hard_zero=bool(o.get("require_hard_zero", True)),
             run_polish=bool(o.get("run_polish", True)),
+            run_leader_pass=bool(o.get("run_leader_pass", True)),
             run_cold_greedy=bool(o.get("run_cold_greedy", True)),
+            repair_backend=repair_backend,
+            overlap_repair=bool(o.get("overlap_repair", False)),
             topic_rebalance_rounds=int(o.get("topic_rebalance_rounds", 2)),
             topic_rebalance_max_sweeps=int(
                 o.get("topic_rebalance_max_sweeps", 1024)
@@ -150,7 +170,39 @@ class OptimizerSidecar:
             ),
         )
         yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}
-        res = optimize(model, self.goal_config, goals, opts)
+        # per-phase progress: optimize() runs in a worker thread so its
+        # synchronous progress_cb can stream through this generator — the
+        # phase breadcrumbs are the wedge diagnosis for wire-routed runs
+        # (a >17-min TPU polish compile must name its phase in the
+        # client's partial dump, same as the in-process path)
+        import queue as _queue
+        import threading as _threading
+
+        q: _queue.Queue = _queue.Queue()
+        box: dict = {}
+
+        def _run():
+            try:
+                box["res"] = optimize(
+                    model, self.goal_config, goals, opts,
+                    progress_cb=lambda p: q.put(p),
+                )
+            except BaseException as e:  # re-raised below, at the RPC edge
+                box["err"] = e
+            finally:
+                q.put(None)
+
+        worker = _threading.Thread(target=_run, daemon=True)
+        worker.start()
+        while True:
+            phase = q.get()
+            if phase is None:
+                break
+            yield {"progress": phase}
+        worker.join()
+        if "err" in box:
+            raise box["err"]
+        res = box["res"]
         yield {"progress": "Diff + verification done"}
         columnar = bool(req.get("columnar_proposals"))
         result = res.to_json(include_proposals=not columnar)

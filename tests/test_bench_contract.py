@@ -9,7 +9,12 @@ pins the contract so it can never silently regress again:
 * the last combined-output line parses as JSON,
 * it carries a numeric "value"/"vs_baseline" and is a COMPLETED rung
   (never a partial dump),
-* the effort dict is self-describing (chains/steps/moves/polish/portfolio).
+* the effort dict is self-describing (chains/steps/moves/polish/portfolio),
+* the compile-cache report is present and the WARM run performed zero
+  fresh XLA compiles — the T1 phase budget only holds while every
+  program is served from cache, so a warm-run compile is a regression
+  BENCH_r*.json must surface, not hide (VERDICT r5 weak #5),
+* (sidecar mode) the wire rung carries the hop accounting.
 
 Runs the real bench end-to-end (B1, CPU, tiny custom effort) in a
 subprocess — ~30-60 s warm via the shared .jax_cache.
@@ -27,7 +32,7 @@ pytestmark = pytest.mark.slow
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_last_combined_line_is_result_json():
+def _run_bench(extra_env: dict) -> dict:
     env = dict(
         os.environ,
         CCX_BENCH="B1",
@@ -38,6 +43,7 @@ def test_bench_last_combined_line_is_result_json():
         CCX_BENCH_STEPS="50",
         CCX_BENCH_MOVES="2",
         CCX_BENCH_POLISH_ITERS="10",
+        **extra_env,
     )
     # tests/conftest pins JAX_PLATFORMS=cpu in THIS process; the subprocess
     # must make its own choice (CCX_BENCH_CPU=1 above)
@@ -56,6 +62,10 @@ def test_bench_last_combined_line_is_result_json():
     last = lines[-1]
     r = json.loads(last)  # the contract: last combined line IS the JSON
     assert "partial" not in r, last
+    return r
+
+
+def _assert_contract(r: dict) -> None:
     assert isinstance(r["value"], (int, float)) and r["value"] > 0
     assert isinstance(r["vs_baseline"], (int, float))
     assert r["metric"].startswith("B1 ")
@@ -64,3 +74,47 @@ def test_bench_last_combined_line_is_result_json():
         r["effort"]
     )
     assert r["effort"]["chains"] == 4 and r["effort"]["steps"] == 50
+    # compile-cache hit-ness is pinned on every rung line: the warm run
+    # must not have paid a single fresh XLA compile — the prewarm/cold
+    # passes own ALL compiles, and a warm compile means the jit cache is
+    # being silently invalidated between identical runs
+    cc = r["compile_cache"]
+    assert {"cold", "warm"} <= set(cc)
+    for k in ("backend_compiles", "persistent_hits", "persistent_misses"):
+        assert isinstance(cc["warm"][k], int)
+    assert cc["warm"]["backend_compiles"] == 0, cc
+    assert cc["warm"]["persistent_misses"] == 0, cc
+    # ... and the zero-pin must not be vacuous: the counters key off
+    # JAX-internal monitoring event names, so a renamed event would read 0
+    # everywhere and silently disarm the pin. The prewarm pass in the same
+    # subprocess MUST have either compiled or persistent-loaded the
+    # program set — a guaranteed-nonzero anchor proving the listener fired
+    pw = r["prewarm"]
+    assert pw["backend_compiles"] + pw["persistent_hits"] > 0, pw
+
+
+def test_bench_last_combined_line_is_result_json():
+    r = _run_bench({"CCX_BENCH_SIDECAR": "0"})
+    _assert_contract(r)
+    assert "sidecar" not in r
+
+
+def test_bench_sidecar_mode_reports_wire_budget():
+    """CCX_BENCH_SIDECAR=1: the rung runs snapshot-up/proposals-down
+    through a real localhost gRPC sidecar (the T1 path as defined) and the
+    line itemizes the hop — same driver contract otherwise."""
+    pytest.importorskip("grpc")
+    r = _run_bench({"CCX_BENCH_SIDECAR": "1"})
+    _assert_contract(r)
+    sc = r["sidecar"]
+    if "fallback" in sc:
+        # the bench degraded to the in-process path (its documented
+        # contract when the wire breaks) — the hop budget is unmeasurable
+        # here, not wrong
+        pytest.skip(f"sidecar degraded in subprocess: {sc['fallback']}")
+    assert {"encode_s", "snapshot_mb", "put_s", "hop_overhead_warm_s"} <= set(
+        sc
+    ), sc
+    # the wire value is RTT-inclusive: warm hop overhead must be a small
+    # positive fraction of the rung value, not a second optimize
+    assert 0 <= sc["hop_overhead_warm_s"] < r["value"]

@@ -123,6 +123,153 @@ def test_repair_scales_to_b5_style_violations():
     assert after["StructuralFeasibility"] == 0
 
 
+def _specs_for_parity():
+    """The existing repair fixtures: rack-stacked, dead brokers/disks,
+    B5-style offender density."""
+    return [
+        RandomClusterSpec(
+            n_brokers=8, n_racks=4, n_topics=4, n_partitions=64, seed=3,
+            n_dead_brokers=2,
+        ),
+        RandomClusterSpec(
+            n_brokers=6, n_racks=3, n_topics=3, n_partitions=32, seed=4
+        ),
+        RandomClusterSpec(
+            n_brokers=100, n_racks=10, n_topics=50, n_partitions=5000, seed=5
+        ),
+        RandomClusterSpec(
+            n_brokers=16, n_racks=4, n_topics=4, n_partitions=256, seed=21,
+            n_disks=3,
+        ),
+    ]
+
+
+def _assert_bitwise_or_lex_no_worse(host, dev, tag):
+    """Device result must equal the host result bit for bit, or — if XLA
+    fuses the float scoring differently inside the while_loop body on some
+    backend — land lex-equal-or-better on the full goal stack."""
+    from ccx.goals.stack import evaluate_stack as ev
+    import numpy as _np
+
+    same = (
+        _np.array_equal(_np.asarray(host.assignment), _np.asarray(dev.assignment))
+        and _np.array_equal(
+            _np.asarray(host.leader_slot), _np.asarray(dev.leader_slot)
+        )
+        and _np.array_equal(
+            _np.asarray(host.replica_disk), _np.asarray(dev.replica_disk)
+        )
+    )
+    if same:
+        return True
+    sh = ev(host, GoalConfig(), DEFAULT_GOAL_ORDER)
+    sd = ev(dev, GoalConfig(), DEFAULT_GOAL_ORDER)
+    kh = [float(sh.hard_violations)] + [float(x) for x in _np.asarray(sh.costs)]
+    kd = [float(sd.hard_violations)] + [float(x) for x in _np.asarray(sd.costs)]
+    assert tuple(kd) <= tuple(kh), (tag, kd, kh)
+    return False
+
+
+def test_device_repair_parity_with_host():
+    """`optimizer.repair.backend=device` (one fused while_loop program) must
+    reproduce the host loop's repaired state on the existing fixtures —
+    bit-identical, or (if XLA fuses the float scoring differently inside
+    the loop body) lex-equal-or-better on the full goal stack. Both drivers
+    share `_sweep_impl`, the per-sweep key-split sequence and the stop
+    rules, so bit-identity is the expected outcome."""
+    for spec in _specs_for_parity():
+        m = random_cluster(spec)
+        host, n_host = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER)
+        dev, n_dev = hard_repair(
+            m, GoalConfig(), DEFAULT_GOAL_ORDER, backend="device"
+        )
+        if _assert_bitwise_or_lex_no_worse(host, dev, spec.seed):
+            assert n_host == n_dev, (spec.seed, n_host, n_dev)
+
+
+def test_device_repair_budget_is_traced_not_compiled():
+    """Different sweep budgets must reuse ONE compiled repair program (the
+    budget is while_loop data — TPU B5 repair compiles are not free), and a
+    budget of 1 must stop after exactly one sweep like the host loop."""
+    from ccx.search.repair import _repair_loop
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=8, n_racks=4, n_topics=4, n_partitions=64, seed=3,
+        n_dead_brokers=2,
+    ))
+    h1, _ = hard_repair(m, GoalConfig(), DEFAULT_GOAL_ORDER, max_sweeps=1)
+    d1, _ = hard_repair(
+        m, GoalConfig(), DEFAULT_GOAL_ORDER, max_sweeps=1, backend="device"
+    )
+    # same bit-identical-or-lex-no-worse contract as the parity test (on
+    # TPU, fusing the sweep inside the while_loop may re-associate floats)
+    _assert_bitwise_or_lex_no_worse(h1, d1, "single-sweep")
+    if hasattr(_repair_loop, "_cache_size"):
+        before = _repair_loop._cache_size()
+        for budget in (2, 5, 8):
+            hard_repair(
+                m, GoalConfig(), DEFAULT_GOAL_ORDER, max_sweeps=budget,
+                backend="device",
+            )
+        assert _repair_loop._cache_size() == before, (
+            "sweep budget leaked into the compile key"
+        )
+
+
+def test_hot_partition_list_device_matches_host():
+    """The device hot list (the pipelined path's offender source) must
+    select exactly the host list's partitions, including the
+    capacity-only-when-no-structural dilution rule."""
+    from ccx.search.annealer import hot_partition_list, hot_partition_list_device
+
+    cfg = GoalConfig()
+    for spec in _specs_for_parity():
+        m = random_cluster(spec)
+        h_idx, h_n = hot_partition_list(m, DEFAULT_GOAL_ORDER, cfg)
+        d_idx, d_n = hot_partition_list_device(
+            m, goal_names=DEFAULT_GOAL_ORDER, cfg=cfg
+        )
+        assert int(d_n) == h_n, spec.seed
+        np.testing.assert_array_equal(
+            np.asarray(d_idx)[: int(d_n)], np.asarray(h_idx)[:h_n]
+        )
+
+
+def test_optimize_overlap_repair_merges_and_verifies():
+    """overlap_repair: first SA chunk on the infeasible input while repair
+    converges in the background, lex-merge, remaining chunks on the winner.
+    Must still reach hard feasibility and pass strict verification, and the
+    phase split must expose the overlap accounting."""
+    from ccx.optimizer import OptimizeOptions, optimize
+    from ccx.search.annealer import AnnealOptions
+    from ccx.search.greedy import GreedyOptions
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=12, n_racks=4, n_topics=6, n_partitions=96, seed=11,
+        n_dead_brokers=1,
+    ))
+    res = optimize(
+        m, GoalConfig(), DEFAULT_GOAL_ORDER,
+        OptimizeOptions(
+            anneal=AnnealOptions(
+                n_chains=4, n_steps=100, moves_per_step=2, chunk_steps=50,
+                seed=7,
+            ),
+            polish=GreedyOptions(n_candidates=64, max_iters=60),
+            overlap_repair=True,
+            run_cold_greedy=False,
+            topic_rebalance_rounds=0,
+        ),
+    )
+    assert float(res.stack_after.hard_violations) == 0
+    assert res.verification.ok, res.verification.failures
+    assert "repair-join" in res.phase_seconds
+    assert "repair-concurrent" in res.phase_seconds
+    # repair ran off the critical path: the blocking exposure is the
+    # dispatch + join, not the repair wall
+    assert res.phase_seconds["repair"] < res.phase_seconds["anneal"] + 1.0
+
+
 def test_canonicalize_preferred_leaders_zeroes_ple_exactly():
     """Reordering replica rows so the chosen leader is slot-0 must zero PLE
     and leave EVERY other goal's (violations, cost) bit-identical — the pass

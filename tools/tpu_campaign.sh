@@ -35,6 +35,17 @@ L="${1:-tpu_campaign.log}"
     exit 1
   fi
   echo "--- bench pass 1 (cold compiles -> persistent cache) ---"
+  # bench.py now opens with a PREWARM pass (one floored-budget optimize
+  # that compiles the ladder's whole shared program set at one-chunk/
+  # one-iter execution cost — the compile probe the round-4 window
+  # lacked: a pathological compile surfaces in the prewarm phase
+  # breadcrumb, before any timed rung is at stake), runs the MXU A/B
+  # automatically on a healthy TPU (CCX_BENCH_MXU=0 skips; the explicit
+  # probe steps below stay as the full-output bank), and routes the
+  # target rung through the localhost gRPC sidecar (wire-inclusive T1;
+  # CCX_BENCH_SIDECAR overrides). Every rung line carries a
+  # compile_cache hit/miss report — a warm run with fresh compiles is a
+  # cache regression, visible right in BENCH_r*.json.
   CCX_BENCH_CPU_FIRST=0 timeout -k 60 5400 python bench.py
   echo "bench pass 1 rc=$?"
   echo "--- bench pass 2 (warm cache; official-style numbers) ---"
