@@ -1,0 +1,171 @@
+package ccx.bridge.grpc;
+
+import ccx.bridge.SidecarException;
+import ccx.bridge.SidecarTransport;
+import ccx.bridge.Wire;
+
+import io.grpc.CallOptions;
+import io.grpc.ManagedChannel;
+import io.grpc.ManagedChannelBuilder;
+import io.grpc.MethodDescriptor;
+import io.grpc.Status;
+import io.grpc.StatusRuntimeException;
+import io.grpc.stub.ClientCalls;
+
+import java.io.ByteArrayInputStream;
+import java.io.IOException;
+import java.io.InputStream;
+import java.util.Iterator;
+import java.util.concurrent.TimeUnit;
+
+/**
+ * The wire transport exactly as docs/sidecar-wire.md specifies: identity
+ * (byte-passthrough) marshallers on a {@code MethodDescriptor<byte[],byte[]>}
+ * — the gRPC message IS the raw msgpack buffer, no protoc codegen. This is
+ * the only class in {@code bridge/} with a grpc-java dependency, which is
+ * why it lives in its own source root ({@code bridge/src/grpc/java});
+ * {@code tools/check_bridge.sh} compiles it only when
+ * {@code CCX_BRIDGE_GRPC_CLASSPATH} points at grpc-java jars.
+ */
+public final class GrpcSidecarTransport implements SidecarTransport {
+
+  /** Byte-passthrough marshaller (docs/sidecar-wire.md §Transport). */
+  static final MethodDescriptor.Marshaller<byte[]> BYTES =
+      new MethodDescriptor.Marshaller<byte[]>() {
+        @Override
+        public InputStream stream(byte[] value) {
+          return new ByteArrayInputStream(value);
+        }
+
+        @Override
+        public byte[] parse(InputStream stream) {
+          try {
+            return readAll(stream);
+          } catch (IOException e) {
+            throw Status.INTERNAL.withDescription("identity parse failed")
+                .withCause(e).asRuntimeException();
+          }
+        }
+      };
+
+  /** 256 MB — a B5-scale snapshot is tens of MB (GRPC_MESSAGE_OPTIONS on
+   * the Python end); gRPC's 4 MB default rejects the hop's own payload. */
+  public static final int MAX_MESSAGE_BYTES = 256 * 1024 * 1024;
+
+  private final ManagedChannel channel;
+
+  public GrpcSidecarTransport(String address) {
+    this.channel = ManagedChannelBuilder.forTarget(address)
+        .usePlaintext()
+        .maxInboundMessageSize(MAX_MESSAGE_BYTES)
+        .build();
+  }
+
+  @Override
+  public byte[] unary(String method, byte[] request, long deadlineMillis)
+      throws SidecarException {
+    try {
+      return ClientCalls.blockingUnaryCall(
+          channel, descriptor(method, MethodDescriptor.MethodType.UNARY),
+          callOptions(deadlineMillis), request);
+    } catch (StatusRuntimeException e) {
+      throw toSidecarException(e);
+    }
+  }
+
+  @Override
+  public Iterator<byte[]> serverStream(String method, byte[] request,
+      long deadlineMillis) throws SidecarException {
+    final Iterator<byte[]> frames;
+    try {
+      frames = ClientCalls.blockingServerStreamingCall(
+          channel,
+          descriptor(method, MethodDescriptor.MethodType.SERVER_STREAMING),
+          callOptions(deadlineMillis), request);
+    } catch (StatusRuntimeException e) {
+      throw toSidecarException(e);
+    }
+    // blockingServerStreamingCall only throws at call SETUP; a mid-stream
+    // failure (sidecar dies, propose deadline expires while frames drain)
+    // surfaces from hasNext/next. Wrap so it keeps the structured mapping
+    // instead of escaping as a raw StatusRuntimeException — the client
+    // unwraps SidecarException.Unchecked back to the checked form.
+    return new Iterator<byte[]>() {
+      @Override
+      public boolean hasNext() {
+        try {
+          return frames.hasNext();
+        } catch (StatusRuntimeException e) {
+          throw new SidecarException.Unchecked(toSidecarException(e));
+        }
+      }
+
+      @Override
+      public byte[] next() {
+        try {
+          return frames.next();
+        } catch (StatusRuntimeException e) {
+          throw new SidecarException.Unchecked(toSidecarException(e));
+        }
+      }
+    };
+  }
+
+  @Override
+  public void close() {
+    channel.shutdownNow();
+    try {
+      channel.awaitTermination(5, TimeUnit.SECONDS);
+    } catch (InterruptedException e) {
+      Thread.currentThread().interrupt();
+    }
+  }
+
+  private static MethodDescriptor<byte[], byte[]> descriptor(
+      String method, MethodDescriptor.MethodType type) {
+    return MethodDescriptor.<byte[], byte[]>newBuilder()
+        .setFullMethodName(Wire.SERVICE + "/" + method)
+        .setType(type)
+        .setRequestMarshaller(BYTES)
+        .setResponseMarshaller(BYTES)
+        .build();
+  }
+
+  private static CallOptions callOptions(long deadlineMillis) {
+    CallOptions opts = CallOptions.DEFAULT;
+    return deadlineMillis > 0
+        ? opts.withDeadlineAfter(deadlineMillis, TimeUnit.MILLISECONDS)
+        : opts;
+  }
+
+  /** Map a gRPC failure to the structured exception. The server encodes
+   * {@code "<code>: <message>"} ONLY in INVALID_ARGUMENT details, so the
+   * code parse is gated on that status — a transient UNAVAILABLE/DEADLINE
+   * whose description happens to contain {@code ": "} must stay code-null
+   * (retryable), not be misread as a non-retryable contract violation. */
+  private static SidecarException toSidecarException(StatusRuntimeException e) {
+    String detail = e.getStatus().getDescription();
+    String code = null;
+    String message = detail == null ? e.getStatus().toString() : detail;
+    if (detail != null
+        && e.getStatus().getCode() == Status.Code.INVALID_ARGUMENT) {
+      int sep = detail.indexOf(": ");
+      if (sep > 0) {
+        String head = detail.substring(0, sep);
+        if (head.indexOf(' ') < 0) {  // looks like a structured code token
+          code = head;
+          message = detail.substring(sep + 2);
+        }
+      }
+    }
+    return new SidecarException(code, message, e);
+  }
+
+  private static byte[] readAll(InputStream in) throws IOException {
+    java.io.ByteArrayOutputStream out = new java.io.ByteArrayOutputStream();
+    byte[] chunk = new byte[8192];
+    int n;
+    while ((n = in.read(chunk)) >= 0) { out.write(chunk, 0, n); }
+    return out.toByteArray();
+  }
+}

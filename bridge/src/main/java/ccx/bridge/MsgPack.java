@@ -1,0 +1,235 @@
+package ccx.bridge;
+
+import java.io.ByteArrayOutputStream;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.TreeMap;
+
+/**
+ * Minimal msgpack codec for the sidecar wire contract — pure JDK, no
+ * dependencies, so {@code bridge/} compiles with javac alone.
+ *
+ * <p>Canonical form (what {@code ccx/sidecar/wire.py} emits and the golden
+ * fixtures under {@code tests/fixtures/sidecar/} are banked in): map keys
+ * sorted lexicographically, minimal-width integer/str/bin/map/array heads,
+ * {@code bin} family for raw buffers, {@code float64} for floating point.
+ * {@link Writer} enforces all of that, which gives the conformance
+ * guarantee the bridge relies on: decode → re-encode of any fixture is
+ * byte-identical (checked by {@code ccx.bridge.tools.FixtureCheck} under a
+ * JVM and by {@code tests/test_bridge_conformance.py} without one).
+ *
+ * <p>Value model: {@code Map<String,Object>}, {@code List<Object>},
+ * {@code Long}, {@code Double}, {@code Boolean}, {@code String},
+ * {@code byte[]}, {@code null}. Extension types are not part of the wire
+ * contract and are rejected.
+ */
+public final class MsgPack {
+
+  private MsgPack() {}
+
+  /** Encode a value canonically (sorted map keys, minimal widths). */
+  public static byte[] pack(Object value) {
+    Writer w = new Writer();
+    w.write(value);
+    return w.toByteArray();
+  }
+
+  /** Decode a complete buffer; trailing bytes are a format error. */
+  public static Object unpack(byte[] buf) {
+    Reader r = new Reader(buf);
+    Object v = r.read();
+    if (r.pos != buf.length) {
+      throw new FormatException("trailing bytes after msgpack value: "
+          + (buf.length - r.pos));
+    }
+    return v;
+  }
+
+  /** Malformed or unsupported msgpack data. */
+  public static final class FormatException extends RuntimeException {
+    public FormatException(String message) { super(message); }
+  }
+
+  // ----- writer -------------------------------------------------------------
+
+  public static final class Writer {
+    private final ByteArrayOutputStream out = new ByteArrayOutputStream();
+
+    public byte[] toByteArray() { return out.toByteArray(); }
+
+    @SuppressWarnings("unchecked")
+    public void write(Object v) {
+      if (v == null) { out.write(0xc0); }
+      else if (v instanceof Boolean) { out.write((Boolean) v ? 0xc3 : 0xc2); }
+      else if (v instanceof Integer || v instanceof Long || v instanceof Short
+          || v instanceof Byte) { writeLong(((Number) v).longValue()); }
+      else if (v instanceof Double || v instanceof Float) {
+        writeFloat64(((Number) v).doubleValue());
+      }
+      else if (v instanceof String) { writeString((String) v); }
+      else if (v instanceof byte[]) { writeBinary((byte[]) v); }
+      else if (v instanceof Map) { writeMap((Map<String, ?>) v); }
+      else if (v instanceof List) { writeArray((List<?>) v); }
+      else {
+        throw new FormatException("unsupported wire type: " + v.getClass());
+      }
+    }
+
+    /** Minimal-width integer head, matching msgpack-python: non-negative
+     * values use the uint family, negative the int family. */
+    public void writeLong(long v) {
+      if (v >= 0) {
+        if (v < 0x80) { out.write((int) v); }
+        else if (v <= 0xffL) { out.write(0xcc); out.write((int) v); }
+        else if (v <= 0xffffL) { out.write(0xcd); writeBE(v, 2); }
+        else if (v <= 0xffffffffL) { out.write(0xce); writeBE(v, 4); }
+        else { out.write(0xcf); writeBE(v, 8); }
+      } else {
+        if (v >= -32) { out.write(0xe0 | ((int) v & 0x1f)); }
+        else if (v >= Byte.MIN_VALUE) { out.write(0xd0); out.write((int) v & 0xff); }
+        else if (v >= Short.MIN_VALUE) { out.write(0xd1); writeBE(v, 2); }
+        else if (v >= Integer.MIN_VALUE) { out.write(0xd2); writeBE(v, 4); }
+        else { out.write(0xd3); writeBE(v, 8); }
+      }
+    }
+
+    public void writeFloat64(double v) {
+      out.write(0xcb);
+      writeBE(Double.doubleToLongBits(v), 8);
+    }
+
+    public void writeString(String s) {
+      byte[] b = s.getBytes(StandardCharsets.UTF_8);
+      if (b.length < 32) { out.write(0xa0 | b.length); }
+      else if (b.length <= 0xff) { out.write(0xd9); out.write(b.length); }
+      else if (b.length <= 0xffff) { out.write(0xda); writeBE(b.length, 2); }
+      else { out.write(0xdb); writeBE(b.length, 4); }
+      out.write(b, 0, b.length);
+    }
+
+    public void writeBinary(byte[] b) {
+      if (b.length <= 0xff) { out.write(0xc4); out.write(b.length); }
+      else if (b.length <= 0xffff) { out.write(0xc5); writeBE(b.length, 2); }
+      else { out.write(0xc6); writeBE(b.length, 4); }
+      out.write(b, 0, b.length);
+    }
+
+    /** Map head + entries in sorted key order — the canonical form. */
+    public void writeMap(Map<String, ?> m) {
+      TreeMap<String, Object> sorted = new TreeMap<>(m);
+      int n = sorted.size();
+      if (n < 16) { out.write(0x80 | n); }
+      else if (n <= 0xffff) { out.write(0xde); writeBE(n, 2); }
+      else { out.write(0xdf); writeBE(n, 4); }
+      for (Map.Entry<String, Object> e : sorted.entrySet()) {
+        writeString(e.getKey());
+        write(e.getValue());
+      }
+    }
+
+    public void writeArray(List<?> a) {
+      int n = a.size();
+      if (n < 16) { out.write(0x90 | n); }
+      else if (n <= 0xffff) { out.write(0xdc); writeBE(n, 2); }
+      else { out.write(0xdd); writeBE(n, 4); }
+      for (Object v : a) { write(v); }
+    }
+
+    private void writeBE(long v, int bytes) {
+      for (int i = bytes - 1; i >= 0; i--) {
+        out.write((int) (v >>> (8 * i)) & 0xff);
+      }
+    }
+  }
+
+  // ----- reader -------------------------------------------------------------
+
+  public static final class Reader {
+    private final byte[] buf;
+    int pos;
+
+    public Reader(byte[] buf) { this.buf = buf; }
+
+    public Object read() {
+      int b = next();
+      if (b < 0x80) { return (long) b; }                       // pos fixint
+      if (b >= 0xe0) { return (long) (byte) b; }               // neg fixint
+      if (b >= 0xa0 && b <= 0xbf) { return readString(b & 0x1f); }
+      if (b >= 0x90 && b <= 0x9f) { return readArray(b & 0x0f); }
+      if (b >= 0x80 && b <= 0x8f) { return readMap(b & 0x0f); }
+      switch (b) {
+        case 0xc0: return null;
+        case 0xc2: return Boolean.FALSE;
+        case 0xc3: return Boolean.TRUE;
+        case 0xc4: return readBytes((int) readBE(1));
+        case 0xc5: return readBytes((int) readBE(2));
+        case 0xc6: return readBytes((int) readBE(4));
+        case 0xca: return (double) Float.intBitsToFloat((int) readBE(4));
+        case 0xcb: return Double.longBitsToDouble(readBE(8));
+        case 0xcc: return readBE(1);
+        case 0xcd: return readBE(2);
+        case 0xce: return readBE(4);
+        case 0xcf: return readBE(8);                           // uint64 as long
+        case 0xd0: return (long) (byte) readBE(1);
+        case 0xd1: return (long) (short) readBE(2);
+        case 0xd2: return (long) (int) readBE(4);
+        case 0xd3: return readBE(8);
+        case 0xd9: return readString((int) readBE(1));
+        case 0xda: return readString((int) readBE(2));
+        case 0xdb: return readString((int) readBE(4));
+        case 0xdc: return readArray((int) readBE(2));
+        case 0xdd: return readArray((int) readBE(4));
+        case 0xde: return readMap((int) readBE(2));
+        case 0xdf: return readMap((int) readBE(4));
+        default:
+          throw new FormatException(String.format("unsupported head 0x%02x", b));
+      }
+    }
+
+    private Map<String, Object> readMap(int n) {
+      Map<String, Object> m = new LinkedHashMap<>(Math.max(4, n * 2));
+      for (int i = 0; i < n; i++) {
+        Object k = read();
+        if (!(k instanceof String)) {
+          throw new FormatException("non-string map key: " + k);
+        }
+        m.put((String) k, read());
+      }
+      return m;
+    }
+
+    private List<Object> readArray(int n) {
+      List<Object> a = new ArrayList<>(n);
+      for (int i = 0; i < n; i++) { a.add(read()); }
+      return a;
+    }
+
+    private String readString(int len) {
+      return new String(readBytes(len), StandardCharsets.UTF_8);
+    }
+
+    private byte[] readBytes(int len) {
+      if (pos + len > buf.length) {
+        throw new FormatException("truncated: need " + len + " bytes at " + pos);
+      }
+      byte[] b = new byte[len];
+      System.arraycopy(buf, pos, b, 0, len);
+      pos += len;
+      return b;
+    }
+
+    private long readBE(int bytes) {
+      long v = 0;
+      for (int i = 0; i < bytes; i++) { v = (v << 8) | (next() & 0xffL); }
+      return v;
+    }
+
+    private int next() {
+      if (pos >= buf.length) { throw new FormatException("truncated at " + pos); }
+      return buf[pos++] & 0xff;
+    }
+  }
+}

@@ -1,0 +1,121 @@
+package ccx.bridge;
+
+import java.util.Iterator;
+import java.util.List;
+import java.util.Map;
+import java.util.function.Consumer;
+
+/**
+ * High-level sidecar client: the JVM twin of {@code ccx/sidecar/client.py}.
+ * Wraps a {@link SidecarTransport} with the envelope codec ({@link Wire}),
+ * per-call deadlines and bounded exponential-backoff retry for transient
+ * failures. Contract violations (structured non-retryable codes) surface
+ * immediately — retrying bytes the server called malformed cannot succeed.
+ */
+public final class SidecarClient implements AutoCloseable {
+
+  /** Retry/deadline policy; defaults match the Python bench harness. */
+  public static final class Options {
+    public long deadlineMillis = 120_000;     // per attempt
+    public int maxAttempts = 3;               // unary calls only
+    public long backoffMillis = 200;          // doubled per retry
+    /** Propose gets its own (long) deadline: a cold B5 compile is minutes. */
+    public long proposeDeadlineMillis = 1_800_000;
+  }
+
+  private final SidecarTransport transport;
+  private final Options options;
+
+  public SidecarClient(SidecarTransport transport) {
+    this(transport, new Options());
+  }
+
+  public SidecarClient(SidecarTransport transport, Options options) {
+    this.transport = transport;
+    this.options = options;
+  }
+
+  /** Liveness/version probe: {@code {version, backend, num_devices, wire}}. */
+  public Map<String, Object> ping() throws SidecarException {
+    return Wire.decode(retryingUnary(Wire.METHOD_PING, Wire.pingRequest()));
+  }
+
+  /** Register a full snapshot (or delta) as a session's generation. */
+  public long putSnapshot(String session, long generation, byte[] packed,
+      boolean isDelta, Long baseGeneration) throws SidecarException {
+    byte[] req = Wire.putSnapshotRequest(
+        session, generation, packed, isDelta, baseGeneration);
+    Map<String, Object> ack =
+        Wire.decode(retryingUnary(Wire.METHOD_PUT_SNAPSHOT, req));
+    Object gen = ack.get("generation");
+    if (!(gen instanceof Long)) {
+      throw new SidecarException(Wire.ERR_MALFORMED,
+          "PutSnapshot ack missing generation: " + ack);
+    }
+    return (Long) gen;
+  }
+
+  /**
+   * The analyzer hop: streams {@code progress} frames into
+   * {@code onProgress} (feed these to OperationProgress) and returns the
+   * terminal result map ({@code OptimizerResult.to_json()} schema). Propose
+   * is NOT retried here — the optimizer may be minutes into a run when a
+   * stream breaks; session re-use and re-proposal policy belong to the
+   * caller ({@link TpuGoalOptimizerBridge}).
+   */
+  public Map<String, Object> propose(List<String> goals,
+      Map<String, Object> engineOptions, byte[] snapshot, String session,
+      boolean columnar, Consumer<String> onProgress) throws SidecarException {
+    byte[] req = Wire.proposeRequest(goals, engineOptions, snapshot, session,
+        columnar);
+    Iterator<byte[]> frames = transport.serverStream(
+        Wire.METHOD_PROPOSE, req, options.proposeDeadlineMillis);
+    Map<String, Object> result = null;
+    try {
+      while (frames.hasNext()) {
+        Map<String, Object> frame = Wire.decode(frames.next());  // throws on error frame
+        Object progress = frame.get("progress");
+        if (progress != null && onProgress != null) {
+          onProgress.accept(progress.toString());
+        }
+        Object res = frame.get("result");
+        if (res instanceof Map) {
+          @SuppressWarnings("unchecked")
+          Map<String, Object> r = (Map<String, Object>) res;
+          result = r;
+        }
+      }
+    } catch (SidecarException.Unchecked e) {
+      throw e.sidecar();  // mid-stream transport failure, mapped
+    }
+    if (result == null) {
+      throw new SidecarException(null, "stream ended without a result");
+    }
+    return result;
+  }
+
+  private byte[] retryingUnary(String method, byte[] request)
+      throws SidecarException {
+    long backoff = options.backoffMillis;
+    SidecarException last = null;
+    for (int attempt = 1; attempt <= Math.max(1, options.maxAttempts); attempt++) {
+      try {
+        return transport.unary(method, request, options.deadlineMillis);
+      } catch (SidecarException e) {
+        if (!e.retryable() || attempt == options.maxAttempts) { throw e; }
+        last = e;
+        try {
+          Thread.sleep(backoff);
+        } catch (InterruptedException ie) {
+          Thread.currentThread().interrupt();
+          throw e;
+        }
+        backoff *= 2;
+      }
+    }
+    throw last;  // unreachable; keeps the compiler satisfied
+  }
+
+  @Override
+  public void close() { transport.close(); }
+}

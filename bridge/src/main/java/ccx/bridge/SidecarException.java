@@ -1,0 +1,46 @@
+package ccx.bridge;
+
+/**
+ * Structured sidecar failure: {@code code} is one of the {@link Wire}
+ * {@code ERR_*} constants when the server sent one (error frame {@code code}
+ * field, or the {@code "<code>: <message>"} prefix of an INVALID_ARGUMENT
+ * detail), else {@code null}. {@link TpuGoalOptimizerBridge} branches on the
+ * code to decide between retry, full-snapshot re-send and JVM fallback.
+ */
+public class SidecarException extends Exception {
+
+  private final String code;
+
+  public SidecarException(String code, String message) {
+    super(message);
+    this.code = code;
+  }
+
+  public SidecarException(String code, String message, Throwable cause) {
+    super(message, cause);
+    this.code = code;
+  }
+
+  /** Structured error code, or null when the peer sent none. */
+  public String code() { return code; }
+
+  /** Transient transport-level failures are retryable; contract violations
+   * ({@code malformed-request}, {@code unsupported-wire-version}, ...) are
+   * not — retrying the same bytes cannot succeed. */
+  public boolean retryable() {
+    return code == null || Wire.ERR_INTERNAL.equals(code);
+  }
+
+  /**
+   * Unchecked carrier for contexts that cannot throw the checked form —
+   * specifically {@code Iterator} methods of a streaming transport, where
+   * a mid-stream gRPC failure must still surface with its structured
+   * mapping. {@link SidecarClient#propose} unwraps it back to the checked
+   * exception, preserving the {@code throws SidecarException} contract.
+   */
+  public static final class Unchecked extends RuntimeException {
+    public Unchecked(SidecarException cause) { super(cause); }
+
+    public SidecarException sidecar() { return (SidecarException) getCause(); }
+  }
+}

@@ -1,0 +1,28 @@
+package ccx.bridge;
+
+import java.util.Iterator;
+
+/**
+ * Transport SPI between {@link SidecarClient} and the bytes-on-the-wire
+ * layer. The production implementation is the identity-marshaller gRPC
+ * transport ({@code bridge/src/grpc/java/ccx/bridge/grpc/GrpcSidecarTransport}),
+ * kept in a separate source root so the core bridge compiles with javac
+ * alone — grpc-java is only needed when the transport itself is built.
+ * Tests substitute an in-memory implementation.
+ */
+public interface SidecarTransport extends AutoCloseable {
+
+  /** One unary call ({@code Ping}, {@code PutSnapshot}); returns the raw
+   * response body. {@code deadlineMillis <= 0} means no deadline. */
+  byte[] unary(String method, byte[] request, long deadlineMillis)
+      throws SidecarException;
+
+  /** One server-streaming call ({@code Propose}); the iterator yields raw
+   * frame bodies and may throw {@link RuntimeException} on transport
+   * failure mid-stream. */
+  Iterator<byte[]> serverStream(String method, byte[] request,
+      long deadlineMillis) throws SidecarException;
+
+  @Override
+  void close();
+}

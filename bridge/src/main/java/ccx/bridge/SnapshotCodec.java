@@ -1,0 +1,101 @@
+package ccx.bridge;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * Tensor-snapshot encoding, JVM side — the msgpack array-blob schema of
+ * {@code ccx/model/snapshot.py} (docs/sidecar-wire.md "Array encoding"):
+ * every tensor is a map {@code {"b": <raw LE bytes>, "d": <dtype>,
+ * "s": [shape...]}}, boolean tensors add {@code "bool": true} and are
+ * carried as uint8. A full snapshot is one msgpack map of such tensors plus
+ * the scalars {@code version} / {@code num_racks}.
+ *
+ * <p>The JVM host adapts its ClusterModel (brokers, partitions, loads) into
+ * primitive arrays and feeds them through {@link Builder}; the resulting
+ * bytes are what {@link Wire#putSnapshotRequest} / {@link Wire#proposeRequest}
+ * carry in their {@code packed} / {@code snapshot} fields. Field names and
+ * shapes are specified in docs/sidecar-wire.md §"Snapshot schema".
+ */
+public final class SnapshotCodec {
+
+  private SnapshotCodec() {}
+
+  /** Encode an int32 tensor ({@code "<i4"}), row-major. */
+  public static Map<String, Object> int32(int[] data, int... shape) {
+    ByteBuffer bb = ByteBuffer.allocate(data.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    for (int v : data) { bb.putInt(v); }
+    return array(Wire.DTYPE_INT32, bb.array(), checkShape(data.length, shape));
+  }
+
+  /** Encode a float32 tensor ({@code "<f4"}), row-major. */
+  public static Map<String, Object> float32(float[] data, int... shape) {
+    ByteBuffer bb = ByteBuffer.allocate(data.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    for (float v : data) { bb.putFloat(v); }
+    return array(Wire.DTYPE_FLOAT32, bb.array(), checkShape(data.length, shape));
+  }
+
+  /** Encode a boolean tensor (uint8 payload + {@code "bool": true}). */
+  public static Map<String, Object> bool(boolean[] data, int... shape) {
+    byte[] b = new byte[data.length];
+    for (int i = 0; i < data.length; i++) { b[i] = (byte) (data[i] ? 1 : 0); }
+    Map<String, Object> m =
+        array(Wire.DTYPE_UINT8, b, checkShape(data.length, shape));
+    m.put(Wire.ARRAY_BOOL, Boolean.TRUE);
+    return m;
+  }
+
+  private static Map<String, Object> array(String dtype, byte[] bytes,
+      int[] shape) {
+    Map<String, Object> m = new LinkedHashMap<>();
+    m.put(Wire.ARRAY_DTYPE, dtype);
+    java.util.List<Object> s = new java.util.ArrayList<>(shape.length);
+    for (int d : shape) { s.add((long) d); }
+    m.put(Wire.ARRAY_SHAPE, s);
+    m.put(Wire.ARRAY_BYTES, bytes);
+    return m;
+  }
+
+  private static int[] checkShape(int len, int[] shape) {
+    long n = 1;
+    for (int d : shape) { n *= d; }
+    if (n != len) {
+      throw new IllegalArgumentException(
+          "shape " + java.util.Arrays.toString(shape) + " does not cover "
+              + len + " elements");
+    }
+    return shape;
+  }
+
+  /**
+   * Collects snapshot fields and packs them canonically. Usage:
+   * <pre>
+   *   byte[] packed = new SnapshotCodec.Builder(numRacks)
+   *       .put("assignment", SnapshotCodec.int32(flat, P, R))
+   *       .put("leader_slot", SnapshotCodec.int32(leaderSlot, P))
+   *       ...
+   *       .pack();
+   * </pre>
+   * For a delta, include only the changed tensors — the scalars ride along
+   * automatically (the sidecar merges field-wise, keyed by generation).
+   */
+  public static final class Builder {
+    private final Map<String, Object> fields = new LinkedHashMap<>();
+
+    public Builder(long numRacks) {
+      fields.put("version", (long) Wire.SNAPSHOT_SCHEMA_VERSION);
+      fields.put("num_racks", numRacks);
+    }
+
+    public Builder put(String field, Map<String, Object> tensor) {
+      fields.put(field, tensor);
+      return this;
+    }
+
+    public byte[] pack() { return MsgPack.pack(fields); }
+  }
+}

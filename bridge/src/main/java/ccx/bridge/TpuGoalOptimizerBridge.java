@@ -1,0 +1,211 @@
+package ccx.bridge;
+
+import ccx.bridge.spi.ClusterModel;
+import ccx.bridge.spi.Goal;
+import ccx.bridge.spi.OptimizationFailureException;
+import ccx.bridge.spi.OptimizationOptions;
+import ccx.bridge.spi.Proposal;
+
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Map;
+import java.util.logging.Level;
+import java.util.logging.Logger;
+
+/**
+ * The Goal-SPI bridge — the paper's stated integration surface
+ * (SURVEY.md §7.2 step 7): installed first in the goal list and activated
+ * by {@code goal.optimizer.backend=tpu}, it routes the WHOLE goal-stack
+ * optimization over the sidecar hop (snapshot up, proposals + per-goal
+ * stats down, progress streamed) and applies the returned movements to the
+ * JVM ClusterModel. When the sidecar is unreachable, misbehaves, or returns
+ * an unverified result, the bridge degrades to the JVM analyzer: it logs,
+ * returns {@code false}, and the regular goal chain runs as if the bridge
+ * were not installed (disable with
+ * {@code goal.optimizer.tpu.fallback=false} to fail hard instead).
+ *
+ * <p>Config keys (read via {@link #configure(Map)}):
+ * <ul>
+ *   <li>{@code goal.optimizer.backend} — {@code "tpu"} enables the bridge;
+ *       anything else makes {@link #optimize} a no-op returning false.</li>
+ *   <li>{@code goal.optimizer.tpu.address} — sidecar host:port
+ *       (default {@code 127.0.0.1:50051}).</li>
+ *   <li>{@code goal.optimizer.tpu.deadline.ms} — per-unary-call deadline.</li>
+ *   <li>{@code goal.optimizer.tpu.propose.deadline.ms} — Propose deadline
+ *       (a cold B5-scale compile is minutes).</li>
+ *   <li>{@code goal.optimizer.tpu.retries} — unary retry attempts.</li>
+ *   <li>{@code goal.optimizer.tpu.columnar} — request the columnar
+ *       proposals blob instead of per-proposal maps (B5-scale fast path;
+ *       default false: row proposals apply directly).</li>
+ *   <li>{@code goal.optimizer.tpu.fallback} — degrade to the JVM analyzer
+ *       on sidecar failure (default true).</li>
+ * </ul>
+ */
+public final class TpuGoalOptimizerBridge implements Goal {
+
+  public static final String CONFIG_BACKEND = "goal.optimizer.backend";
+  public static final String BACKEND_TPU = "tpu";
+  public static final String CONFIG_ADDRESS = "goal.optimizer.tpu.address";
+  public static final String CONFIG_DEADLINE_MS = "goal.optimizer.tpu.deadline.ms";
+  public static final String CONFIG_PROPOSE_DEADLINE_MS =
+      "goal.optimizer.tpu.propose.deadline.ms";
+  public static final String CONFIG_RETRIES = "goal.optimizer.tpu.retries";
+  public static final String CONFIG_COLUMNAR = "goal.optimizer.tpu.columnar";
+  public static final String CONFIG_FALLBACK = "goal.optimizer.tpu.fallback";
+  public static final String DEFAULT_ADDRESS = "127.0.0.1:50051";
+
+  private static final Logger LOG =
+      Logger.getLogger(TpuGoalOptimizerBridge.class.getName());
+
+  /** Indirection for tests and for environments without grpc-java. */
+  public interface TransportFactory {
+    SidecarTransport connect(String address) throws SidecarException;
+  }
+
+  private final TransportFactory transportFactory;
+  private boolean enabled;
+  private boolean fallbackToJvm = true;
+  private boolean columnar;
+  private String address = DEFAULT_ADDRESS;
+  private final SidecarClient.Options clientOptions = new SidecarClient.Options();
+
+  /** Production path: the gRPC transport, loaded reflectively so the core
+   * bridge has no compile-time grpc dependency. */
+  public TpuGoalOptimizerBridge() {
+    this(TpuGoalOptimizerBridge::loadGrpcTransport);
+  }
+
+  public TpuGoalOptimizerBridge(TransportFactory transportFactory) {
+    this.transportFactory = transportFactory;
+  }
+
+  @Override
+  public void configure(Map<String, ?> configs) {
+    enabled = BACKEND_TPU.equals(str(configs, CONFIG_BACKEND, BACKEND_TPU));
+    address = str(configs, CONFIG_ADDRESS, DEFAULT_ADDRESS);
+    fallbackToJvm = bool(configs, CONFIG_FALLBACK, true);
+    columnar = bool(configs, CONFIG_COLUMNAR, false);
+    clientOptions.deadlineMillis =
+        longVal(configs, CONFIG_DEADLINE_MS, clientOptions.deadlineMillis);
+    clientOptions.proposeDeadlineMillis = longVal(
+        configs, CONFIG_PROPOSE_DEADLINE_MS, clientOptions.proposeDeadlineMillis);
+    clientOptions.maxAttempts =
+        (int) longVal(configs, CONFIG_RETRIES, clientOptions.maxAttempts);
+  }
+
+  @Override
+  public String name() { return "TpuGoalOptimizerBridge"; }
+
+  @Override
+  public boolean optimize(ClusterModel model, OptimizationOptions options)
+      throws OptimizationFailureException {
+    if (!enabled) { return false; }
+    // The ENTIRE remote exchange — including parsing the result into
+    // Proposal values — happens before the model is touched, so the
+    // fallback path always leaves the ClusterModel exactly as it was:
+    // a malformed result (unexpected field shape from a future sidecar)
+    // degrades to the JVM analyzer like any transport failure.
+    List<Proposal> proposals;
+    try (SidecarClient client =
+        new SidecarClient(transportFactory.connect(address), clientOptions)) {
+      client.ping();  // fail fast (and cheap) before shipping megabytes
+      Map<String, Object> result = client.propose(
+          options.goals(), options.engineOptions(), model.toSnapshot(),
+          null, columnar,
+          p -> LOG.log(Level.FINE, "sidecar progress: {0}", p));
+      if (Boolean.FALSE.equals(result.get("verified"))) {
+        throw new SidecarException(Wire.ERR_INTERNAL,
+            "sidecar result failed verification: "
+                + result.get("verificationFailures"));
+      }
+      proposals = parseProposals(result);
+      if (proposals.isEmpty() && result.get("proposalsColumnar") != null) {
+        // a columnar result carries no row proposals to apply — returning
+        // true here would be a SILENT no-op rebalance that also skips the
+        // JVM chain. The Goal bridge applies rows; the columnar fast path
+        // is for hosts consuming SidecarClient directly.
+        throw new SidecarException(Wire.ERR_INVALID,
+            "columnar result cannot be applied by the Goal bridge — unset "
+                + CONFIG_COLUMNAR + " or decode proposalsColumnar in a "
+                + "custom host");
+      }
+    } catch (SidecarException | RuntimeException e) {
+      if (fallbackToJvm) {
+        LOG.log(Level.WARNING,
+            "TPU sidecar unavailable ({0}); falling back to JVM analyzer",
+            e.getMessage());
+        return false;  // the regular goal chain takes over
+      }
+      throw new OptimizationFailureException(
+          "TPU sidecar optimization failed and fallback is disabled: "
+              + e.getMessage(), e);
+    }
+    // Host-side application is NOT swallowed into the fallback: a failure
+    // here is a host adapter bug (and may have partially mutated the
+    // model), which must surface, not silently rerun the JVM analyzer on
+    // a half-applied state.
+    for (Proposal p : proposals) { model.apply(p); }
+    return true;  // whole stack solved remotely — skip the JVM chain
+  }
+
+  /** Row-proposal parsing ({@code proposals} list of maps; the columnar
+   * blob is a raw arrays payload the host decodes with its own tensor
+   * tooling, so it is passed through untouched). */
+  @SuppressWarnings("unchecked")
+  static List<Proposal> parseProposals(Map<String, Object> result) {
+    Object raw = result.get("proposals");
+    List<Proposal> out = new ArrayList<>();
+    if (!(raw instanceof List)) { return out; }
+    for (Object o : (List<Object>) raw) {
+      Map<String, Object> p = (Map<String, Object>) o;
+      Map<String, Object> tp = (Map<String, Object>) p.get("topicPartition");
+      out.add(new Proposal(
+          (Long) tp.get("topic"), (Long) tp.get("partition"),
+          (Long) p.get("oldLeader"), (Long) p.get("newLeader"),
+          longs(p.get("oldReplicas")), longs(p.get("newReplicas")),
+          longs(p.get("oldDisks")), longs(p.get("newDisks"))));
+    }
+    return out;
+  }
+
+  @SuppressWarnings("unchecked")
+  private static long[] longs(Object v) {
+    if (!(v instanceof List)) { return new long[0]; }
+    List<Object> l = (List<Object>) v;
+    long[] out = new long[l.size()];
+    for (int i = 0; i < out.length; i++) { out[i] = (Long) l.get(i); }
+    return out;
+  }
+
+  private static SidecarTransport loadGrpcTransport(String address)
+      throws SidecarException {
+    try {
+      Class<?> cls = Class.forName("ccx.bridge.grpc.GrpcSidecarTransport");
+      return (SidecarTransport)
+          cls.getConstructor(String.class).newInstance(address);
+    } catch (ReflectiveOperationException e) {
+      throw new SidecarException(null,
+          "gRPC transport not on classpath (build bridge/src/grpc with "
+              + "grpc-java): " + e, e);
+    }
+  }
+
+  private static String str(Map<String, ?> c, String key, String dflt) {
+    Object v = c.get(key);
+    return v == null ? dflt : v.toString();
+  }
+
+  private static boolean bool(Map<String, ?> c, String key, boolean dflt) {
+    Object v = c.get(key);
+    if (v == null) { return dflt; }
+    if (v instanceof Boolean) { return (Boolean) v; }
+    return Boolean.parseBoolean(v.toString());
+  }
+
+  private static long longVal(Map<String, ?> c, String key, long dflt) {
+    Object v = c.get(key);
+    if (v == null) { return dflt; }
+    if (v instanceof Number) { return ((Number) v).longValue(); }
+    return Long.parseLong(v.toString());
+  }
+}

@@ -1,0 +1,134 @@
+package ccx.bridge;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * The sidecar wire contract, JVM side — constants and envelope builders
+ * mirroring the single-source schema module {@code ccx/sidecar/wire.py}
+ * (see {@code docs/sidecar-wire.md}). The Python conformance harness
+ * ({@code tests/test_bridge_conformance.py}) parses the constants below and
+ * fails if they drift from the Python values, so the two ends cannot
+ * silently diverge even though no JVM runs in CI.
+ *
+ * <p>All builders emit canonical msgpack (sorted keys, minimal widths) via
+ * {@link MsgPack.Writer}; a request built here is byte-identical to the
+ * golden fixture bytes under {@code tests/fixtures/sidecar/} given the same
+ * field values.
+ */
+public final class Wire {
+
+  private Wire() {}
+
+  /** gRPC service name ({@code ccx.sidecar.OptimizerService/...}). */
+  public static final String SERVICE = "ccx.sidecar.OptimizerService";
+  public static final String METHOD_PROPOSE = "Propose";
+  public static final String METHOD_PUT_SNAPSHOT = "PutSnapshot";
+  public static final String METHOD_PING = "Ping";
+
+  /** Envelope wire version; every request/response/frame carries it. */
+  public static final int WIRE_VERSION = 1;
+  /** Field name carrying the version. */
+  public static final String FIELD_WIRE = "wire";
+
+  // Structured error codes (error-frame "code" / INVALID_ARGUMENT prefix).
+  public static final String ERR_UNSUPPORTED_VERSION = "unsupported-wire-version";
+  public static final String ERR_MALFORMED = "malformed-request";
+  public static final String ERR_BAD_SNAPSHOT = "bad-snapshot";
+  public static final String ERR_INVALID = "invalid-argument";
+  public static final String ERR_INTERNAL = "internal";
+
+  // Array-blob encoding field names (snapshot tensor schema, see
+  // docs/sidecar-wire.md "Array encoding" and SnapshotCodec).
+  public static final String ARRAY_DTYPE = "d";
+  public static final String ARRAY_SHAPE = "s";
+  public static final String ARRAY_BYTES = "b";
+  public static final String ARRAY_BOOL = "bool";
+  public static final String DTYPE_INT32 = "<i4";
+  public static final String DTYPE_FLOAT32 = "<f4";
+  public static final String DTYPE_UINT8 = "|u1";
+
+  /** Snapshot schema version ({@code ccx.model.snapshot.SCHEMA_VERSION}). */
+  public static final int SNAPSHOT_SCHEMA_VERSION = 2;
+
+  // ----- request builders ---------------------------------------------------
+
+  /** Canonical Ping body: {@code {"wire": 1}}. */
+  public static byte[] pingRequest() {
+    return MsgPack.pack(stamped(new LinkedHashMap<>()));
+  }
+
+  /**
+   * PutSnapshot body. {@code packed} is a full msgpack snapshot (or delta
+   * fields only, with {@code isDelta}); {@code baseGeneration} may be null.
+   */
+  public static byte[] putSnapshotRequest(String session, long generation,
+      byte[] packed, boolean isDelta, Long baseGeneration) {
+    Map<String, Object> req = new LinkedHashMap<>();
+    req.put("session", session);
+    req.put("generation", generation);
+    req.put("packed", packed);
+    req.put("is_delta", isDelta);
+    if (baseGeneration != null) { req.put("base_generation", baseGeneration); }
+    return MsgPack.pack(stamped(req));
+  }
+
+  /**
+   * Propose body. Exactly one of {@code snapshot} (one-shot full snapshot)
+   * or {@code session} (server-cached) should be set; {@code options} keys
+   * are the engine knobs documented in docs/sidecar-wire.md.
+   */
+  public static byte[] proposeRequest(List<String> goals,
+      Map<String, Object> options, byte[] snapshot, String session,
+      boolean columnarProposals) {
+    Map<String, Object> req = new LinkedHashMap<>();
+    req.put("goals", goals == null ? new ArrayList<>() : goals);
+    req.put("options", options == null ? new LinkedHashMap<>() : options);
+    if (snapshot != null) { req.put("snapshot", snapshot); }
+    if (session != null) { req.put("session", session); }
+    if (columnarProposals) { req.put("columnar_proposals", Boolean.TRUE); }
+    return MsgPack.pack(stamped(req));
+  }
+
+  // ----- frame/response decode ----------------------------------------------
+
+  /**
+   * Decode a unary response or stream frame and gate the version: absent is
+   * accepted (pre-versioning server), unsupported raises the structured
+   * error a caller can branch on.
+   */
+  @SuppressWarnings("unchecked")
+  public static Map<String, Object> decode(byte[] buf) throws SidecarException {
+    Object v;
+    try {
+      v = MsgPack.unpack(buf);
+    } catch (MsgPack.FormatException e) {
+      throw new SidecarException(ERR_MALFORMED,
+          "undecodable msgpack frame: " + e.getMessage(), e);
+    }
+    if (!(v instanceof Map)) {
+      throw new SidecarException(ERR_MALFORMED,
+          "frame must be a msgpack map, got " + (v == null ? "nil" : v.getClass()));
+    }
+    Map<String, Object> frame = (Map<String, Object>) v;
+    Object wire = frame.get(FIELD_WIRE);
+    if (wire != null && (!(wire instanceof Long) || (Long) wire != WIRE_VERSION)) {
+      throw new SidecarException(ERR_UNSUPPORTED_VERSION,
+          "unsupported frame wire version " + wire + "; this end speaks ["
+              + WIRE_VERSION + "]");
+    }
+    if (frame.containsKey("error")) {
+      Object code = frame.get("code");
+      throw new SidecarException(code == null ? null : code.toString(),
+          String.valueOf(frame.get("error")));
+    }
+    return frame;
+  }
+
+  private static Map<String, Object> stamped(Map<String, Object> payload) {
+    payload.put(FIELD_WIRE, (long) WIRE_VERSION);
+    return payload;
+  }
+}

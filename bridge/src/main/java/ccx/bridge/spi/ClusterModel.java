@@ -1,0 +1,21 @@
+package ccx.bridge.spi;
+
+/**
+ * The slice of the JVM ClusterModel the bridge needs. The host adapts its
+ * model once: encode the tensor snapshot (via
+ * {@link ccx.bridge.SnapshotCodec.Builder} — field names and shapes in
+ * docs/sidecar-wire.md §"Snapshot schema") and apply returned proposals as
+ * replica/leadership movements.
+ */
+public interface ClusterModel {
+
+  /** Packed msgpack snapshot of the current model state. */
+  byte[] toSnapshot();
+
+  /** Model generation (the reference's ModelGeneration), used as the
+   * delta-session generation key. */
+  long generation();
+
+  /** Apply one accepted proposal (replica moves + leadership transfer). */
+  void apply(Proposal proposal);
+}

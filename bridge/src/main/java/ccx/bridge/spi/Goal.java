@@ -1,0 +1,31 @@
+package ccx.bridge.spi;
+
+import java.util.Map;
+
+/**
+ * Minimal mirror of the reference Goal SPI
+ * ({@code com.linkedin.kafka.cruisecontrol.analyzer.goals.Goal}): the
+ * pluggable unit the JVM analyzer drives in priority order. The bridge ships
+ * its own copy so {@code bridge/} compiles with javac alone — no
+ * cruise-control jar in this environment. Adapting to the real SPI is a
+ * thin wrapper: implement the upstream interface, delegate to
+ * {@link ccx.bridge.TpuGoalOptimizerBridge} and translate
+ * {@link ClusterModel}/{@link Proposal} to the upstream model types (see
+ * bridge/README.md "Adapting to upstream").
+ */
+public interface Goal {
+
+  /** Reflective configuration hook (the reference's {@code Configurable}). */
+  void configure(Map<String, ?> configs);
+
+  /** Goal name as surfaced in state/summary endpoints. */
+  String name();
+
+  /**
+   * Optimize the model in place. Returns true when this goal fully handled
+   * optimization (the TPU path: the whole goal stack was solved remotely),
+   * false to let the regular JVM goal chain proceed (the fallback path).
+   */
+  boolean optimize(ClusterModel model, OptimizationOptions options)
+      throws OptimizationFailureException;
+}

@@ -1,0 +1,14 @@
+package ccx.bridge.spi;
+
+/**
+ * Mirror of the reference's OptimizationFailureException: the goal could
+ * not produce a valid optimization and no fallback is configured.
+ */
+public class OptimizationFailureException extends Exception {
+
+  public OptimizationFailureException(String message) { super(message); }
+
+  public OptimizationFailureException(String message, Throwable cause) {
+    super(message, cause);
+  }
+}

@@ -1,0 +1,33 @@
+package ccx.bridge.spi;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Mirror of the reference's OptimizationOptions, reduced to what rides the
+ * wire: the goal stack (reference class names, priority order; empty means
+ * the sidecar's default stack) and the engine knobs forwarded verbatim as
+ * the {@code options} map (chains, steps, seed, ... — docs/sidecar-wire.md
+ * §Propose).
+ */
+public final class OptimizationOptions {
+
+  private final List<String> goals = new ArrayList<>();
+  private final Map<String, Object> engineOptions = new LinkedHashMap<>();
+
+  public List<String> goals() { return goals; }
+
+  public Map<String, Object> engineOptions() { return engineOptions; }
+
+  public OptimizationOptions goal(String referenceGoalName) {
+    goals.add(referenceGoalName);
+    return this;
+  }
+
+  public OptimizationOptions option(String key, Object value) {
+    engineOptions.put(key, value);
+    return this;
+  }
+}

@@ -1,0 +1,40 @@
+package ccx.bridge.spi;
+
+import java.util.Arrays;
+
+/**
+ * One accepted movement — the wire's per-proposal map
+ * ({@code OptimizerResult.to_json()} schema) as a value object: replica
+ * set change plus leadership transfer for a single topic-partition.
+ */
+public final class Proposal {
+
+  public final long topic;
+  public final long partition;
+  public final long oldLeader;
+  public final long newLeader;
+  public final long[] oldReplicas;
+  public final long[] newReplicas;
+  public final long[] oldDisks;
+  public final long[] newDisks;
+
+  public Proposal(long topic, long partition, long oldLeader, long newLeader,
+      long[] oldReplicas, long[] newReplicas, long[] oldDisks,
+      long[] newDisks) {
+    this.topic = topic;
+    this.partition = partition;
+    this.oldLeader = oldLeader;
+    this.newLeader = newLeader;
+    this.oldReplicas = oldReplicas;
+    this.newReplicas = newReplicas;
+    this.oldDisks = oldDisks;
+    this.newDisks = newDisks;
+  }
+
+  @Override
+  public String toString() {
+    return "Proposal{t" + topic + "-p" + partition + " leader " + oldLeader
+        + "->" + newLeader + " replicas " + Arrays.toString(oldReplicas)
+        + "->" + Arrays.toString(newReplicas) + "}";
+  }
+}

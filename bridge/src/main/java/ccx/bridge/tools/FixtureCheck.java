@@ -1,0 +1,79 @@
+package ccx.bridge.tools;
+
+import ccx.bridge.MsgPack;
+import ccx.bridge.Wire;
+
+import java.io.IOException;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.Paths;
+import java.util.Arrays;
+import java.util.Map;
+
+/**
+ * JVM-side conformance check over the golden wire fixtures
+ * ({@code tests/fixtures/sidecar/}): every {@code *.bin} fixture must
+ * decode with {@link MsgPack.Reader} and re-encode with
+ * {@link MsgPack.Writer} to the IDENTICAL bytes — the fixtures are banked
+ * in canonical form (sorted keys, minimal widths), so any deviation in the
+ * Java codec shows up as a byte diff. Inner {@code packed}/{@code snapshot}
+ * payloads (the tensor blobs) are round-tripped too, and version-stamped
+ * envelopes must carry the {@link Wire#WIRE_VERSION} this bridge speaks.
+ *
+ * <p>Run by {@code tools/check_bridge.sh} when a JRE is present:
+ * {@code java ccx.bridge.tools.FixtureCheck tests/fixtures/sidecar}.
+ * Exit 0 = conformant.
+ */
+public final class FixtureCheck {
+
+  private FixtureCheck() {}
+
+  public static void main(String[] args) throws IOException {
+    Path dir = Paths.get(args.length > 0 ? args[0] : "tests/fixtures/sidecar");
+    int checked = 0;
+    try (var names = Files.list(dir)) {
+      for (Path p : (Iterable<Path>) names.sorted()::iterator) {
+        if (!p.getFileName().toString().endsWith(".bin")) { continue; }
+        check(p);
+        checked++;
+      }
+    }
+    if (checked == 0) {
+      System.err.println("FixtureCheck: no .bin fixtures under " + dir);
+      System.exit(1);
+    }
+    System.out.println("FixtureCheck: " + checked
+        + " fixtures canonical-roundtrip clean (" + dir + ")");
+  }
+
+  private static void check(Path path) throws IOException {
+    byte[] golden = Files.readAllBytes(path);
+    Object decoded = MsgPack.unpack(golden);
+    byte[] reencoded = MsgPack.pack(decoded);
+    if (!Arrays.equals(golden, reencoded)) {
+      fail(path, "canonical re-encode differs (" + reencoded.length + " vs "
+          + golden.length + " bytes)");
+    }
+    if (decoded instanceof Map) {
+      Map<?, ?> envelope = (Map<?, ?>) decoded;
+      Object wire = envelope.get(Wire.FIELD_WIRE);
+      if (wire != null && !Long.valueOf(Wire.WIRE_VERSION).equals(wire)) {
+        fail(path, "wire version " + wire + " != " + Wire.WIRE_VERSION);
+      }
+      for (String key : new String[] {"packed", "snapshot"}) {
+        Object inner = envelope.get(key);
+        if (inner instanceof byte[]) {
+          byte[] blob = (byte[]) inner;
+          if (!Arrays.equals(blob, MsgPack.pack(MsgPack.unpack(blob)))) {
+            fail(path, "inner '" + key + "' blob re-encode differs");
+          }
+        }
+      }
+    }
+  }
+
+  private static void fail(Path path, String why) {
+    System.err.println("FixtureCheck FAILED: " + path + ": " + why);
+    System.exit(1);
+  }
+}

@@ -139,8 +139,12 @@ _BOOL_FIELDS = {
 
 
 def pack_arrays(d: dict[str, Any]) -> bytes:
-    """msgpack-encode an arrays dict (full snapshot or delta fields)."""
-    import msgpack
+    """msgpack-encode an arrays dict (full snapshot or delta fields).
+
+    Canonical bytes (map keys sorted, recursively — ``ccx.sidecar.wire``
+    owns the rule) so fixture generation is deterministic and a JVM
+    encoder emitting sorted keys reproduces snapshots byte-exact."""
+    from ccx.sidecar.wire import packb
 
     enc: dict[str, Any] = {}
     for k, v in d.items():
@@ -151,7 +155,7 @@ def pack_arrays(d: dict[str, Any]) -> bytes:
             enc[k] = p
         else:
             enc[k] = v
-    return msgpack.packb(enc, use_bin_type=True)
+    return packb(enc)
 
 
 def to_msgpack(m: TensorClusterModel) -> bytes:

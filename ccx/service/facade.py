@@ -531,11 +531,17 @@ class CruiseControl:
         if "executor" in want:
             out["ExecutorState"] = self.executor.state_json()
         if "analyzer" in want:
+            from ccx.sidecar.wire import WIRE_VERSION
+
             with self._proposal_lock:
                 out["AnalyzerState"] = {
                     "isProposalReady": self._proposal_cache is not None,
                     "readyGoals": list(self._resolve_goals()),
                     "backend": self.config["goal.optimizer.backend"],
+                    # the sidecar envelope version this build speaks — lets
+                    # an operator (or the JVM bridge) confirm wire compat
+                    # from the REST state endpoint before routing proposals
+                    "sidecarWireVersion": WIRE_VERSION,
                 }
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self.anomaly_detector.state()

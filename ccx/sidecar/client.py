@@ -1,16 +1,17 @@
 """Sidecar client — the JVM bridge's reference implementation.
 
 Mirrors what the JVM-side ``goal.optimizer.backend=tpu`` strategy does
-(SURVEY.md §0 north star): serialize the cluster snapshot, stream progress,
-collect the ``OptimizerResult``. Used by tests, the ``ccx-propose`` CLI, and
-as executable documentation of the wire contract in ``optimizer.proto``.
+(SURVEY.md §0 north star; ``bridge/`` for the Java twin): serialize the
+cluster snapshot, stream progress, collect the ``OptimizerResult``. Every
+envelope comes from the single-source schema module ``ccx/sidecar/wire.py``,
+so this client, the server and the golden conformance fixtures share one
+encoding. Used by tests, the ``ccx-propose`` CLI, and as executable
+documentation of the wire contract in ``optimizer.proto``.
 """
 
 from __future__ import annotations
 
-import msgpack
-
-from ccx.sidecar import GRPC_MESSAGE_OPTIONS, SERVICE, identity as _identity
+from ccx.sidecar import GRPC_MESSAGE_OPTIONS, SERVICE, identity as _identity, wire
 
 # NOTE: ccx.model.snapshot (and with it jax) is imported lazily inside the
 # methods that take a model object — a remote-only client (ping, session
@@ -38,20 +39,17 @@ class SidecarClient:
         )
 
     def ping(self) -> dict:
-        return msgpack.unpackb(self._ping(msgpack.packb({})), raw=False)
+        return wire.decode_response(self._ping(wire.ping_request()))
 
     def put_snapshot(self, model, session: str, generation: int,
                      is_delta: bool = False, base_generation: int | None = None,
                      packed: bytes | None = None) -> dict:
-        payload = {
-            "session": session,
-            "generation": generation,
-            "packed": packed if packed is not None else _pack_model(model),
-            "is_delta": is_delta,
-        }
-        if base_generation is not None:
-            payload["base_generation"] = base_generation
-        return msgpack.unpackb(self._put(msgpack.packb(payload)), raw=False)
+        req = wire.put_snapshot_request(
+            session=session, generation=generation,
+            packed=packed if packed is not None else _pack_model(model),
+            is_delta=is_delta, base_generation=base_generation,
+        )
+        return wire.decode_response(self._put(req))
 
     def propose(self, model=None, session: str | None = None,
                 goals: tuple[str, ...] = (), on_progress=None,
@@ -60,24 +58,20 @@ class SidecarClient:
         arrays blob (``diff_columnar`` schema) instead of per-proposal
         maps — the fast path for B5-scale results; the returned dict then
         carries numpy arrays under ``proposalsColumnar``."""
-        req: dict = {"goals": list(goals), "options": options}
-        if columnar:
-            req["columnar_proposals"] = True
-        if model is not None:
-            req["snapshot"] = _pack_model(model)
-        if session is not None:
-            req["session"] = session
+        req = wire.propose_request(
+            goals=goals, options=options,
+            snapshot=_pack_model(model) if model is not None else None,
+            session=session, columnar=columnar,
+        )
         result: dict | None = None
-        for raw in self._propose(msgpack.packb(req)):
-            update = msgpack.unpackb(raw, raw=False)
+        for raw in self._propose(req):
+            update = wire.decode_frame(raw)  # raises SidecarError on error
             if "progress" in update and on_progress:
                 on_progress(update["progress"])
-            if "error" in update:
-                raise RuntimeError(update["error"])
             if "result" in update:
                 result = update["result"]
         if result is None:
-            raise RuntimeError("stream ended without a result")
+            raise wire.SidecarError("stream ended without a result")
         if isinstance(result.get("proposalsColumnar"), (bytes, bytearray)):
             from ccx.model.snapshot import decode_msgpack
 

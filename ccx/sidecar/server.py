@@ -7,10 +7,12 @@ streamed so the JVM can feed its ``OperationProgress``.
 
 Implementation notes: the wire methods are registered with
 ``grpc.GenericRpcHandler`` and byte-identity serializers, so no protoc
-codegen is required on the Python side; payloads are msgpack (see
-``optimizer.proto`` for the JVM-side contract and ``ccx/model/snapshot.py``
-for the tensor schema). Delta snapshots are cached per session keyed by
-generation (SURVEY.md §7.4 snapshot-transfer mitigation).
+codegen is required on the Python side; every envelope is built/parsed by
+the single-source schema module ``ccx/sidecar/wire.py`` (versioned,
+structured error codes — see ``optimizer.proto`` for the JVM-side contract
+and ``ccx/model/snapshot.py`` for the tensor schema). Delta snapshots are
+cached per session keyed by generation (SURVEY.md §7.4 snapshot-transfer
+mitigation).
 """
 
 from __future__ import annotations
@@ -18,8 +20,6 @@ from __future__ import annotations
 import logging
 import threading
 from concurrent import futures
-
-import msgpack
 
 from ccx import __version__
 from ccx.sidecar import GRPC_MESSAGE_OPTIONS
@@ -33,7 +33,7 @@ from ccx.model.snapshot import (
 from ccx.optimizer import OptimizeOptions, optimize
 from ccx.search.annealer import AnnealOptions
 from ccx.search.greedy import GreedyOptions
-from ccx.sidecar import SERVICE, identity as _identity
+from ccx.sidecar import SERVICE, identity as _identity, wire
 
 log = logging.getLogger(__name__)
 
@@ -49,11 +49,15 @@ class OptimizerSidecar:
     # ----- PutSnapshot ------------------------------------------------------
 
     def put_snapshot(self, request: bytes) -> bytes:
-        req = msgpack.unpackb(request, raw=False)
+        req = wire.unpackb(request)
+        wire.check_version(req)
         session = req.get("session", "")
         generation = int(req.get("generation", 0))
-        packed = req["packed"]
-        arrays = decode_msgpack(packed)
+        if "packed" not in req:
+            raise wire.WireError(
+                wire.ERR_MALFORMED, "PutSnapshot request missing 'packed'"
+            )
+        arrays = _decode_snapshot(req["packed"], what="packed snapshot")
         with self._lock:
             if req.get("is_delta"):
                 base = self._snapshots.get(session)
@@ -70,16 +74,17 @@ class OptimizerSidecar:
                     )
                 arrays = delta_apply(base[1], arrays)
             self._snapshots[session] = (generation, arrays)
-        return msgpack.packb({"generation": generation})
+        return wire.ack_response(generation)
 
     # ----- Propose ----------------------------------------------------------
 
     def propose(self, request: bytes):
         """Generator: progress dicts, then the final result dict."""
-        req = msgpack.unpackb(request, raw=False)
-        yield {"progress": "Decoding snapshot"}
+        req = wire.unpackb(request)
+        wire.check_version(req)
+        yield wire.progress_frame("Decoding snapshot")
         if req.get("snapshot") is not None:
-            arrays = decode_msgpack(req["snapshot"])
+            arrays = _decode_snapshot(req["snapshot"], what="snapshot")
         else:
             session = req.get("session", "")
             # Read, validate, apply, and store under ONE lock acquisition so
@@ -96,7 +101,9 @@ class OptimizerSidecar:
                             f"match cached generation {entry[0]} for "
                             f"session {session!r}"
                         )
-                    arrays = delta_apply(entry[1], decode_msgpack(req["delta"]))
+                    arrays = delta_apply(
+                        entry[1], _decode_snapshot(req["delta"], what="delta")
+                    )
                     self._snapshots[session] = (
                         int(req.get("generation", entry[0] + 1)), arrays
                     )
@@ -169,7 +176,9 @@ class OptimizerSidecar:
                 else None
             ),
         )
-        yield {"progress": f"Optimizing {model.P}x{model.B} over {len(goals)} goals"}
+        yield wire.progress_frame(
+            f"Optimizing {model.P}x{model.B} over {len(goals)} goals"
+        )
         # per-phase progress: optimize() runs in a worker thread so its
         # synchronous progress_cb can stream through this generator — the
         # phase breadcrumbs are the wedge diagnosis for wire-routed runs
@@ -198,12 +207,12 @@ class OptimizerSidecar:
             phase = q.get()
             if phase is None:
                 break
-            yield {"progress": phase}
+            yield wire.progress_frame(phase)
         worker.join()
         if "err" in box:
             raise box["err"]
         res = box["res"]
-        yield {"progress": "Diff + verification done"}
+        yield wire.progress_frame("Diff + verification done")
         columnar = bool(req.get("columnar_proposals"))
         result = res.to_json(include_proposals=not columnar)
         if columnar:
@@ -217,16 +226,28 @@ class OptimizerSidecar:
             cols = diff_columnar(res.input_model, res.model)
             result["numProposals"] = int(cols["partition"].shape[0])
             result["proposalsColumnar"] = pack_arrays(cols)
-        yield {"result": result}
+        yield wire.result_frame(result)
 
     def ping(self, request: bytes) -> bytes:
         import jax
 
-        return msgpack.packb({
-            "version": __version__,
-            "backend": jax.default_backend(),
-            "num_devices": jax.device_count(),
-        })
+        if request:  # empty bytes = pre-versioning client, accepted
+            wire.check_version(wire.unpackb(request))
+        return wire.pong_response(
+            __version__, jax.default_backend(), jax.device_count()
+        )
+
+
+def _decode_snapshot(packed: bytes, what: str) -> dict:
+    """Array-blob decode with the structured ``bad-snapshot`` error: a
+    truncated tensor buffer (or any undecodable payload) must fail THIS
+    request, not crash the server."""
+    try:
+        return decode_msgpack(packed)
+    except Exception as e:  # noqa: BLE001 — anything here is a bad payload
+        raise wire.WireError(
+            wire.ERR_BAD_SNAPSHOT, f"undecodable {what}: {e}"
+        ) from e
 
 
 def make_grpc_server(sidecar: OptimizerSidecar | None = None,
@@ -242,16 +263,22 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
                 return fn(request)
             except Exception as e:  # noqa: BLE001 — RPC boundary
                 log.exception("rpc failed")
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                # structured detail: "<code>: <message>" so a client can
+                # branch on the code without parsing prose; the server
+                # itself stays up (abort only fails this RPC)
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"{wire.code_of(e)}: {e}",
+                )
         return handler
 
     def propose_stream(request: bytes, context):
         try:
             for update in sidecar.propose(request):
-                yield msgpack.packb(update)
+                yield wire.pack_frame(update)
         except Exception as e:  # noqa: BLE001
             log.exception("propose failed")
-            yield msgpack.packb({"error": str(e)})
+            yield wire.pack_frame(wire.error_frame(str(e), wire.code_of(e)))
 
     method_handlers = {
         "Propose": grpc.unary_stream_rpc_method_handler(

@@ -1,0 +1,232 @@
+"""Single-source wire schema for the sidecar hop (docs/sidecar-wire.md).
+
+Every msgpack envelope that crosses the JVM ↔ TPU-sidecar boundary is built
+and parsed HERE — ``ccx/sidecar/server.py``, ``ccx/sidecar/client.py`` and
+the golden-fixture generator (``tools/gen_wire_fixtures.py``) all consume
+this module, so the Python ends and the checked-in conformance bytes cannot
+drift apart. The JVM side (``bridge/src/main/java/ccx/bridge/Wire.java``)
+mirrors the constants below; ``tests/test_bridge_conformance.py`` cross-
+checks both against the fixtures without a JVM.
+
+Canonical encoding: map keys sorted lexicographically, msgpack minimal-width
+integers, ``use_bin_type`` bins for raw buffers. The sidecar ACCEPTS any key
+order; producers that want byte-exact conformance with the golden fixtures
+must emit canonically (``packb`` here, ``MsgPack.Writer`` on the JVM).
+
+Versioning: every request, response and stream frame carries an integer
+``wire`` field. A missing field is accepted (pre-versioning peers); a value
+outside ``SUPPORTED_WIRE_VERSIONS`` is a structured error
+(``unsupported-wire-version``), never a crash — unary methods surface it as
+gRPC INVALID_ARGUMENT, ``Propose`` as a terminal ``{"error", "code"}`` frame.
+
+Dependency-light on purpose: msgpack only — no jax/numpy/grpc — so a remote
+client (and the fixture cross-check) can import it anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import msgpack
+
+#: bump when an envelope field changes meaning; additions are compatible.
+WIRE_VERSION = 1
+SUPPORTED_WIRE_VERSIONS = (1,)
+#: envelope field carrying the version (requests, responses, frames alike)
+FIELD_WIRE = "wire"
+
+# ----- structured error codes ----------------------------------------------
+
+#: request carried a wire version this end does not speak
+ERR_UNSUPPORTED_VERSION = "unsupported-wire-version"
+#: request body is not decodable msgpack / not a map / missing required keys
+ERR_MALFORMED = "malformed-request"
+#: the packed snapshot/delta payload is undecodable (e.g. truncated buffer)
+ERR_BAD_SNAPSHOT = "bad-snapshot"
+#: semantically invalid request (unknown goal, missing session, bad base gen)
+ERR_INVALID = "invalid-argument"
+#: the optimizer itself failed — not the caller's fault
+ERR_INTERNAL = "internal"
+
+
+class WireError(ValueError):
+    """A structured wire-contract violation: ``code`` is one of the ERR_*
+    constants and rides the wire next to the message (error frame ``code``
+    field / INVALID_ARGUMENT detail prefix), so a JVM client can branch on
+    it without parsing prose."""
+
+    def __init__(self, code: str, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+class SidecarError(RuntimeError):
+    """Client-side image of a server error frame (or abort): ``code`` is the
+    structured error code when the server sent one, else None. Subclasses
+    RuntimeError so pre-versioning callers' ``except RuntimeError`` and
+    message-matching keep working."""
+
+    def __init__(self, message: str, code: str | None = None) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+# ----- canonical msgpack ----------------------------------------------------
+
+def canonicalize(obj: Any) -> Any:
+    """Recursively sort map keys (tuples become lists) — the deterministic
+    form the golden fixtures are generated in."""
+    if isinstance(obj, dict):
+        return {k: canonicalize(obj[k]) for k in sorted(obj)}
+    if isinstance(obj, (list, tuple)):
+        return [canonicalize(v) for v in obj]
+    return obj
+
+
+def packb(obj: Any) -> bytes:
+    """Canonical msgpack bytes (sorted keys, bin type for bytes)."""
+    return msgpack.packb(canonicalize(obj), use_bin_type=True)
+
+
+def unpackb(buf: bytes) -> dict:
+    """Decode an envelope; malformed bytes raise ``WireError(ERR_MALFORMED)``
+    instead of leaking msgpack internals to the RPC edge."""
+    try:
+        obj = msgpack.unpackb(buf, raw=False)
+    except Exception as e:  # noqa: BLE001 — any unpack failure is malformed
+        raise WireError(ERR_MALFORMED, f"undecodable msgpack request: {e}") from e
+    if not isinstance(obj, dict):
+        raise WireError(
+            ERR_MALFORMED, f"request must be a msgpack map, got {type(obj).__name__}"
+        )
+    return obj
+
+
+def check_version(msg: dict, what: str = "request") -> None:
+    """Graceful unknown-version gate: absent ⇒ pre-versioning peer, accepted;
+    present-but-unsupported ⇒ structured ``unsupported-wire-version``."""
+    v = msg.get(FIELD_WIRE)
+    if v is None:
+        return
+    if not isinstance(v, int) or v not in SUPPORTED_WIRE_VERSIONS:
+        raise WireError(
+            ERR_UNSUPPORTED_VERSION,
+            f"unsupported {what} wire version {v!r}; this end speaks "
+            f"{list(SUPPORTED_WIRE_VERSIONS)}",
+        )
+
+
+def _stamped(payload: dict) -> dict:
+    out = dict(payload)
+    out[FIELD_WIRE] = WIRE_VERSION
+    return out
+
+
+# ----- requests (client side / fixture generator) ---------------------------
+
+def ping_request() -> bytes:
+    """Canonical Ping body. The server also accepts empty bytes (legacy)."""
+    return packb(_stamped({}))
+
+
+def put_snapshot_request(session: str, generation: int, packed: bytes,
+                         is_delta: bool = False,
+                         base_generation: int | None = None) -> bytes:
+    req: dict = {
+        "session": session,
+        "generation": int(generation),
+        "packed": packed,
+        "is_delta": bool(is_delta),
+    }
+    if base_generation is not None:
+        req["base_generation"] = int(base_generation)
+    return packb(_stamped(req))
+
+
+def propose_request(goals: Iterable[str] = (), options: dict | None = None,
+                    snapshot: bytes | None = None, session: str | None = None,
+                    delta: bytes | None = None,
+                    base_generation: int | None = None,
+                    generation: int | None = None,
+                    columnar: bool = False) -> bytes:
+    req: dict = {"goals": list(goals), "options": dict(options or {})}
+    if snapshot is not None:
+        req["snapshot"] = snapshot
+    if session is not None:
+        req["session"] = session
+    if delta is not None:
+        req["delta"] = delta
+    if base_generation is not None:
+        req["base_generation"] = int(base_generation)
+    if generation is not None:
+        req["generation"] = int(generation)
+    if columnar:
+        req["columnar_proposals"] = True
+    return packb(_stamped(req))
+
+
+# ----- responses / stream frames (server side) ------------------------------
+
+def ack_response(generation: int) -> bytes:
+    return packb(_stamped({"generation": int(generation)}))
+
+
+def pong_response(version: str, backend: str, num_devices: int) -> bytes:
+    return packb(_stamped({
+        "version": version, "backend": backend, "num_devices": int(num_devices),
+    }))
+
+
+def progress_frame(text: str) -> dict:
+    return _stamped({"progress": text})
+
+
+def result_frame(result: dict) -> dict:
+    return _stamped({"result": result})
+
+
+def error_frame(message: str, code: str = ERR_INVALID) -> dict:
+    return _stamped({"error": message, "code": code})
+
+
+def pack_frame(frame: dict) -> bytes:
+    """Stream frames are NOT canonicalized: a B5 row-mode result frame
+    holds ~62k proposal maps, and the recursive key-sort would deep-copy
+    all of it on the hot path the <5 s T1 budget measures. Only bytes with
+    golden fixtures (requests, unary responses) need canonical form —
+    frame CONTENT is compared as JSON, key-order-insensitive."""
+    return msgpack.packb(frame, use_bin_type=True)
+
+
+def code_of(exc: BaseException) -> str:
+    """Structured code for an exception escaping a method implementation."""
+    if isinstance(exc, WireError):
+        return exc.code
+    if isinstance(exc, (ValueError, KeyError, TypeError)):
+        return ERR_INVALID
+    return ERR_INTERNAL
+
+
+# ----- frame decode (client side) -------------------------------------------
+
+def decode_frame(buf: bytes) -> dict:
+    """Decode one Propose stream frame; raises ``SidecarError`` (with the
+    server's structured code) on an error frame or a version we don't speak."""
+    try:
+        frame = unpackb(buf)
+        check_version(frame, what="frame")
+    except WireError as e:
+        raise SidecarError(str(e), code=e.code) from e
+    if "error" in frame:
+        raise SidecarError(str(frame["error"]), code=frame.get("code"))
+    return frame
+
+
+def decode_response(buf: bytes) -> dict:
+    """Decode a unary response, tolerating (but checking) the version."""
+    try:
+        resp = unpackb(buf)
+        check_version(resp, what="response")
+    except WireError as e:
+        raise SidecarError(str(e), code=e.code) from e
+    return resp

@@ -1,0 +1,276 @@
+"""JVM-free conformance harness for the bridge (ISSUE 2 tentpole; VERDICT
+r5 next #7).
+
+Pins the three-way agreement between (1) the single-source Python schema
+module ``ccx/sidecar/wire.py``, (2) the golden fixture bytes under
+``tests/fixtures/sidecar/`` and (3) the Java bridge sources under
+``bridge/`` — all WITHOUT a JVM:
+
+* every golden fixture re-derives byte-exact from the schema module and
+  survives a canonical decode → re-encode round trip (the same property
+  ``ccx.bridge.tools.FixtureCheck`` pins under a JVM — fixtures are banked
+  in canonical sorted-key/minimal-width form, so a conforming codec on
+  either side must reproduce them bit-for-bit);
+* the fixture bytes replay through the LIVE sidecar behind a real gRPC
+  server exactly as a JVM client would drive it (identity marshalling),
+  and the responses match the goldens;
+* the constants in ``bridge/.../Wire.java`` match ``wire.py`` (service
+  name, wire version, error codes, dtype strings), so the two ends cannot
+  drift even though no JVM runs in CI;
+* the sidecar error paths are structured and non-fatal: malformed msgpack,
+  truncated tensor buffers, unknown methods and unknown wire versions all
+  fail the offending RPC with a code and leave the server serving.
+
+``tools/check_bridge.sh`` adds the javac-optional compile smoke on top.
+"""
+
+import pathlib
+import re
+import subprocess
+import sys
+
+import msgpack
+import pytest
+
+from ccx.model.snapshot import SCHEMA_VERSION
+from ccx.sidecar import SERVICE, identity, wire
+from ccx.sidecar.server import OptimizerSidecar, make_grpc_server
+
+pytestmark = pytest.mark.bridge
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXDIR = REPO / "tests" / "fixtures" / "sidecar"
+BRIDGE_MAIN = REPO / "bridge" / "src" / "main" / "java" / "ccx" / "bridge"
+
+sys.path.insert(0, str(REPO / "tools"))
+import gen_wire_fixtures as gen  # noqa: E402
+
+
+# ----- fixtures ↔ schema module ---------------------------------------------
+
+def test_every_request_fixture_rederives_from_schema_module():
+    requests = gen.build_requests()
+    assert set(requests) == set(gen.REQUEST_NAMES)
+    for name, buf in requests.items():
+        assert (FIXDIR / name).read_bytes() == buf, (
+            f"{name} drifted from wire.py builders — regenerate via "
+            f"tools/gen_wire_fixtures.py if the change is intentional"
+        )
+
+
+def test_every_bin_fixture_is_canonical_msgpack():
+    """Decode → canonical re-encode is byte-identity, outer envelope AND
+    inner packed tensor blobs — the exact invariant the Java FixtureCheck
+    pins, so a JVM-side codec that matches it produces these bytes."""
+    bins = sorted(FIXDIR.glob("*.bin"))
+    assert bins, "no .bin fixtures"
+    for path in bins:
+        golden = path.read_bytes()
+        decoded = msgpack.unpackb(golden, raw=False)
+        assert wire.packb(decoded) == golden, f"{path.name}: not canonical"
+        if isinstance(decoded, dict):
+            for key in ("packed", "snapshot"):
+                blob = decoded.get(key)
+                if isinstance(blob, bytes):
+                    inner = msgpack.unpackb(blob, raw=False)
+                    assert wire.packb(inner) == blob, (
+                        f"{path.name}: inner {key!r} blob not canonical"
+                    )
+
+
+def test_regeneration_is_byte_stable():
+    """Two independent builds emit identical bytes (sorted msgpack keys,
+    fixed seeds), and the generator's own --check agrees with the tree."""
+    a, b = gen.build_requests(), gen.build_requests()
+    assert a == b
+    assert gen.check(FIXDIR, full=False) == []
+
+
+def test_versioned_envelopes_carry_current_version():
+    for name in gen.REQUEST_NAMES:
+        decoded = msgpack.unpackb((FIXDIR / name).read_bytes(), raw=False)
+        assert decoded.get(wire.FIELD_WIRE) == wire.WIRE_VERSION, name
+
+
+# ----- fixtures ↔ Java sources ----------------------------------------------
+
+def _java_constants(path: pathlib.Path) -> dict:
+    """String/int constants from a Java source, anchored to actual
+    declarations (``static final String/int NAME = ...``) so prose or
+    examples in comments can never shadow the real value and silently
+    disarm the drift guard; first declaration wins."""
+    src = path.read_text()
+    out: dict = {}
+    for name, val in re.findall(
+            r"String\s+(\w+)\s*=\s*\"((?:[^\"\\]|\\.)*)\"\s*;", src):
+        out.setdefault(name, val)
+    for name, val in re.findall(r"int\s+(\w+)\s*=\s*(\d+)\s*;", src):
+        out.setdefault(name, int(val))
+    return out
+
+
+def test_java_wire_constants_match_python():
+    consts = _java_constants(BRIDGE_MAIN / "Wire.java")
+    expected = {
+        "SERVICE": SERVICE,
+        "METHOD_PROPOSE": "Propose",
+        "METHOD_PUT_SNAPSHOT": "PutSnapshot",
+        "METHOD_PING": "Ping",
+        "WIRE_VERSION": wire.WIRE_VERSION,
+        "FIELD_WIRE": wire.FIELD_WIRE,
+        "ERR_UNSUPPORTED_VERSION": wire.ERR_UNSUPPORTED_VERSION,
+        "ERR_MALFORMED": wire.ERR_MALFORMED,
+        "ERR_BAD_SNAPSHOT": wire.ERR_BAD_SNAPSHOT,
+        "ERR_INVALID": wire.ERR_INVALID,
+        "ERR_INTERNAL": wire.ERR_INTERNAL,
+        "ARRAY_DTYPE": "d",
+        "ARRAY_SHAPE": "s",
+        "ARRAY_BYTES": "b",
+        "ARRAY_BOOL": "bool",
+        "DTYPE_INT32": "<i4",
+        "DTYPE_FLOAT32": "<f4",
+        "DTYPE_UINT8": "|u1",
+        "SNAPSHOT_SCHEMA_VERSION": SCHEMA_VERSION,
+    }
+    for name, want in expected.items():
+        assert consts.get(name) == want, (
+            f"Wire.java {name} = {consts.get(name)!r}, Python says {want!r}"
+        )
+
+
+def test_java_bridge_covers_the_config_surface():
+    src = (BRIDGE_MAIN / "TpuGoalOptimizerBridge.java").read_text()
+    assert '"goal.optimizer.backend"' in src
+    assert '"tpu"' in src
+    grpc_src = (REPO / "bridge" / "src" / "grpc" / "java" / "ccx" / "bridge"
+                / "grpc" / "GrpcSidecarTransport.java").read_text()
+    # the documented transport shape: identity marshaller on byte[] methods
+    assert "MethodDescriptor" in grpc_src and "Marshaller" in grpc_src
+
+
+def test_check_bridge_script_runs_and_skips_cleanly():
+    """The javac-optional smoke must exit 0 with or without a JDK; the
+    fixture cross-check portion is exercised in-process above, so the
+    subprocess run skips it (CCX_BRIDGE_SKIP_FIXTURES) and stays fast."""
+    proc = subprocess.run(
+        ["bash", str(REPO / "tools" / "check_bridge.sh")],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin", "CCX_BRIDGE_SKIP_FIXTURES": "1"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert "skipped" in out or "compiles clean" in out, out
+
+
+# ----- live replay over real gRPC -------------------------------------------
+
+@pytest.fixture(scope="module")
+def wire_channel():
+    grpc = pytest.importorskip("grpc")
+    server, port = make_grpc_server()
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield grpc, channel
+    channel.close()
+    server.stop(0)
+
+
+def _unary(grpc_channel, method):
+    _, channel = grpc_channel
+    return channel.unary_unary(
+        f"/{SERVICE}/{method}",
+        request_serializer=identity, response_deserializer=identity,
+    )
+
+
+def _stream(grpc_channel, method="Propose"):
+    _, channel = grpc_channel
+    return channel.unary_stream(
+        f"/{SERVICE}/{method}",
+        request_serializer=identity, response_deserializer=identity,
+    )
+
+
+def test_fixture_replay_over_grpc_matches_goldens(wire_channel):
+    """Byte-in/byte-out over a REAL gRPC hop — exactly what a JVM client
+    emitting the fixture bytes experiences. The Propose replay (runs the
+    optimizer, ~25 s) lives in tests/test_sidecar_conformance.py at the
+    byte-identical in-process layer, and propose-over-gRPC is covered by
+    tests/test_sidecar.py — re-running it here would only re-pay the
+    compile, so this test pins the cheap unary pair plus stream framing
+    via the error-path tests below (tier-1 budget, ROADMAP)."""
+    put = _unary(wire_channel, "PutSnapshot")
+    assert put((FIXDIR / "put_full_request.bin").read_bytes()) == (
+        FIXDIR / "put_full_response.bin").read_bytes()
+    assert put((FIXDIR / "put_delta_request.bin").read_bytes()) == (
+        FIXDIR / "put_delta_response.bin").read_bytes()
+    pong = wire.decode_response(
+        _unary(wire_channel, "Ping")((FIXDIR / "ping_request.bin").read_bytes()))
+    assert pong[wire.FIELD_WIRE] == wire.WIRE_VERSION
+
+
+# ----- structured error paths (server must stay up) --------------------------
+
+def _assert_alive(wire_channel):
+    pong = wire.decode_response(_unary(wire_channel, "Ping")(b""))
+    assert pong["version"]
+
+
+def test_malformed_msgpack_is_structured_error(wire_channel):
+    grpc, _ = wire_channel
+    with pytest.raises(grpc.RpcError) as exc:
+        _unary(wire_channel, "PutSnapshot")(b"\xc1\xff not msgpack")
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert wire.ERR_MALFORMED in exc.value.details()
+    # same body through the streaming method: terminal error frame, coded
+    frames = list(_stream(wire_channel)(b"\xc1\xff not msgpack"))
+    with pytest.raises(wire.SidecarError) as serr:
+        wire.decode_frame(frames[-1])
+    assert serr.value.code == wire.ERR_MALFORMED
+    _assert_alive(wire_channel)
+
+
+def test_truncated_tensor_buffer_is_structured_error(wire_channel):
+    grpc, _ = wire_channel
+    req = msgpack.unpackb((FIXDIR / "put_full_request.bin").read_bytes(),
+                          raw=False)
+    req["packed"] = req["packed"][:-7]  # truncate mid raw tensor buffer
+    with pytest.raises(grpc.RpcError) as exc:
+        _unary(wire_channel, "PutSnapshot")(wire.packb(req))
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert wire.ERR_BAD_SNAPSHOT in exc.value.details()
+    _assert_alive(wire_channel)
+
+
+def test_unknown_method_is_unimplemented_not_fatal(wire_channel):
+    grpc, _ = wire_channel
+    with pytest.raises(grpc.RpcError) as exc:
+        _unary(wire_channel, "NoSuchMethod")(b"")
+    assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    _assert_alive(wire_channel)
+
+
+def test_unknown_wire_version_is_graceful(wire_channel):
+    grpc, _ = wire_channel
+    # unary: INVALID_ARGUMENT with the structured code in the detail
+    req = msgpack.unpackb((FIXDIR / "put_full_request.bin").read_bytes(),
+                          raw=False)
+    req[wire.FIELD_WIRE] = 99
+    with pytest.raises(grpc.RpcError) as exc:
+        _unary(wire_channel, "PutSnapshot")(wire.packb(req))
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert wire.ERR_UNSUPPORTED_VERSION in exc.value.details()
+    # stream: terminal error frame carrying the code
+    frames = list(_stream(wire_channel)(
+        wire.packb({wire.FIELD_WIRE: 99, "goals": [], "options": {}})))
+    with pytest.raises(wire.SidecarError) as serr:
+        wire.decode_frame(frames[-1])
+    assert serr.value.code == wire.ERR_UNSUPPORTED_VERSION
+    _assert_alive(wire_channel)
+
+
+def test_missing_packed_field_is_structured_error():
+    sc = OptimizerSidecar()
+    with pytest.raises(wire.WireError) as exc:
+        sc.put_snapshot(wire.packb({"session": "x", "generation": 1}))
+    assert exc.value.code == wire.ERR_MALFORMED

@@ -166,6 +166,9 @@ def test_state_and_reads(tmp_path):
     assert st["MonitorState"]["state"] == "RUNNING"
     assert st["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
     assert st["AnalyzerState"]["backend"] == "tpu"
+    from ccx.sidecar.wire import WIRE_VERSION
+
+    assert st["AnalyzerState"]["sidecarWireVersion"] == WIRE_VERSION
     assert "AnomalyDetectorState" in st
     sub = cc.state(("monitor",))
     assert "ExecutorState" not in sub

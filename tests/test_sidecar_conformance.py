@@ -6,144 +6,163 @@ the checked-in request bytes verbatim interoperates with the sidecar. The
 test replays each request through OptimizerSidecar exactly as the gRPC layer
 would (byte-identity marshalling) and asserts the responses.
 
+Single source: the request builders and replay live in
+``tools/gen_wire_fixtures.py`` (which itself consumes ``ccx/sidecar/wire.py``
+and ``bench.build_opts`` — the golden Propose IS the official target rung)
+— this file only asserts; ``tests/test_bridge_conformance.py`` adds the
+bridge-side cross-checks over the same fixtures.
+
+Because the replay cold-compiles the target rung's program set, the
+compile-cache warmth tripwire (VERDICT r5 next #6) lives here too: a warm
+re-replay in the same module must be served ENTIRELY from the jit cache —
+one silent recompile of the SA chunk or the greedy while_loop costs
+minutes on TPU (round-4 window: >17 min) and invalidates the <5 s T1
+budget. A change that leaks fresh statics into a jit key (an unhashable
+option, a Python-object pytree leaf, a shape dodging the padding buckets)
+fails HERE the day it is made, not at the next TPU window. The tiny
+fixture cluster exercises the same key-construction path as B5: program
+identity is (options-derived statics, padded bucket shapes).
+
 Regenerate after an intentional wire change:
     CCX_REGEN_FIXTURES=1 python -m pytest tests/test_sidecar_conformance.py
+(equivalently: python tools/gen_wire_fixtures.py)
 """
 
 import json
 import os
 import pathlib
+import sys
 
 import msgpack
-import numpy as np
 import pytest
 
-from ccx.model.fixtures import small_deterministic
-from ccx.model.snapshot import delta_encode, model_to_arrays, to_msgpack
+from ccx.common import compilestats
+from ccx.sidecar import wire
 from ccx.sidecar.server import OptimizerSidecar
 
-FIXDIR = pathlib.Path(__file__).parent / "fixtures" / "sidecar"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+import gen_wire_fixtures as gen  # noqa: E402
 
-#: volatile result keys excluded from golden comparison
-VOLATILE = {"wallSeconds"}
-
-SESSION = "conformance"
-GOALS = ["RackAwareGoal", "ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"]
-OPTIONS = {"chains": 4, "steps": 200, "seed": 7, "polish_candidates": 32,
-           "polish_max_iters": 20}
+FIXDIR = gen.FIXDIR
 
 
-def _delta_arrays():
-    """The fixture delta: partition 0's leadership moves to slot 1."""
-    base = model_to_arrays(small_deterministic())
-    new = dict(base)
-    ls = np.array(base["leader_slot"], np.int32).copy()
-    ls[0] = 1
-    new["leader_slot"] = ls
-    return base, new
-
-
-def _pack_arrays(d: dict) -> bytes:
-    from ccx.model.snapshot import _BOOL_FIELDS, _pack_array
-
-    enc = {}
-    for k, v in d.items():
-        if isinstance(v, np.ndarray):
-            p = _pack_array(v)
-            if k in _BOOL_FIELDS:
-                p["bool"] = True
-            enc[k] = p
-        else:
-            enc[k] = v
-    return msgpack.packb(enc, use_bin_type=True)
-
-
-def build_requests() -> dict[str, bytes]:
-    m = small_deterministic()
-    base, new = _delta_arrays()
-    return {
-        "ping_request.bin": b"",
-        "put_full_request.bin": msgpack.packb(
-            {"session": SESSION, "generation": 1, "packed": to_msgpack(m),
-             "is_delta": False},
-            use_bin_type=True,
-        ),
-        "put_delta_request.bin": msgpack.packb(
-            {"session": SESSION, "generation": 2,
-             "packed": _pack_arrays(delta_encode(base, new)),
-             "is_delta": True, "base_generation": 1},
-            use_bin_type=True,
-        ),
-        "propose_request.bin": msgpack.packb(
-            {"session": SESSION, "goals": GOALS, "options": OPTIONS},
-            use_bin_type=True,
-        ),
-    }
-
-
-def run_wire(requests: dict[str, bytes]):
-    """Replay the golden requests through a fresh sidecar, in protocol order."""
-    sc = OptimizerSidecar()
-    put_full = sc.put_snapshot(requests["put_full_request.bin"])
-    put_delta = sc.put_snapshot(requests["put_delta_request.bin"])
-    frames = list(sc.propose(requests["propose_request.bin"]))
-    return put_full, put_delta, frames
-
-
-def _canonical_result(frames) -> dict:
-    assert frames, "propose produced no frames"
-    *progress, last = frames
-    assert all("progress" in f for f in progress)
-    assert "result" in last, last
-    res = {k: v for k, v in last["result"].items() if k not in VOLATILE}
-    return json.loads(json.dumps(res))  # normalize tuples etc.
-
-
-def test_fixtures_exist_or_regenerate():
+@pytest.fixture(scope="module", autouse=True)
+def _maybe_regenerate():
+    """Regen must happen before ANY test in the module touches the goldens
+    (test_request_bytes_are_reproducible runs before wire_replay is built),
+    so the documented one-shot regen flow passes on its first run."""
     if os.environ.get("CCX_REGEN_FIXTURES") == "1":
-        FIXDIR.mkdir(parents=True, exist_ok=True)
-        requests = build_requests()
-        put_full, put_delta, frames = run_wire(requests)
-        for name, buf in requests.items():
-            (FIXDIR / name).write_bytes(buf)
-        (FIXDIR / "put_full_response.bin").write_bytes(put_full)
-        (FIXDIR / "put_delta_response.bin").write_bytes(put_delta)
-        (FIXDIR / "propose_result.json").write_text(
-            json.dumps(_canonical_result(frames), indent=1, sort_keys=True)
-        )
+        gen.write(FIXDIR)
+
+
+@pytest.fixture(scope="module")
+def wire_replay():
+    """ONE golden replay shared by the response assertions and the warmth
+    tripwire: (requests, put_full, put_delta, frames, compile-stats delta
+    of the cold run)."""
+    requests = {name: (FIXDIR / name).read_bytes()
+                for name in gen.REQUEST_NAMES}
+    before = compilestats.snapshot()  # registers listeners pre-compile
+    put_full, put_delta, frames = gen.run_wire(requests)
+    cold = compilestats.delta(before, compilestats.snapshot())
+    return requests, put_full, put_delta, frames, cold
+
+
+def test_fixtures_exist():
     assert (FIXDIR / "propose_request.bin").exists(), (
-        "fixtures missing — run with CCX_REGEN_FIXTURES=1"
+        "fixtures missing — run tools/gen_wire_fixtures.py"
     )
 
 
 def test_request_bytes_are_reproducible():
     """The documented client-side encoding reproduces the golden bytes —
-    i.e. the walkthrough in docs/sidecar-wire.md fully determines them."""
-    for name, buf in build_requests().items():
+    i.e. docs/sidecar-wire.md + wire.py fully determine them."""
+    for name, buf in gen.build_requests().items():
         golden = (FIXDIR / name).read_bytes()
         assert buf == golden, f"{name}: encoding drifted from golden bytes"
 
 
-def test_wire_replay_matches_golden_responses():
-    requests = {name: (FIXDIR / name).read_bytes() for name in build_requests()}
-    put_full, put_delta, frames = run_wire(requests)
+def test_wire_replay_matches_golden_responses(wire_replay):
+    _, put_full, put_delta, frames, _ = wire_replay
     assert put_full == (FIXDIR / "put_full_response.bin").read_bytes()
     assert put_delta == (FIXDIR / "put_delta_response.bin").read_bytes()
-    golden = json.loads((FIXDIR / "propose_result.json").read_text())
-    assert _canonical_result(frames) == golden
+    golden = json.loads((FIXDIR / gen.RESULT_NAME).read_text())
+    assert gen.canonical_result(frames) == golden
+
+
+def test_golden_propose_is_the_official_target_rung(monkeypatch):
+    """Drift guard: the fixture's goals/options must stay byte-coupled to
+    bench.build_opts("B5", "target") — a rung retune without a deliberate
+    fixture regeneration fails here, not at the next TPU window."""
+    for knob in gen._BENCH_KNOBS:
+        monkeypatch.delenv(knob, raising=False)
+    goals, options = gen.target_rung_goals_and_options()
+    req = msgpack.unpackb((FIXDIR / "propose_request.bin").read_bytes(),
+                          raw=False)
+    assert req["goals"] == goals
+    assert req["options"] == wire.canonicalize(options)
+    assert options["steps"] == options["chunk_steps"], (
+        "target rung drifted: its anneal is no longer one minimal chunk"
+    )
+
+
+def test_warm_recall_of_target_rung_shapes_compiles_nothing(wire_replay):
+    """Compile-cache warmth tripwire (module docstring): re-replaying the
+    golden target-rung Propose in the same process must pay ZERO fresh XLA
+    compiles — the cold replay above owns them all."""
+    if os.environ.get("CCX_REGEN_FIXTURES") == "1":
+        # the regen pass already compiled everything before wire_replay's
+        # "cold" run, so the vacuity anchor below would be meaningless
+        pytest.skip("regenerating fixtures — warmth anchor not measurable")
+    requests, _, _, _, cold = wire_replay
+    # vacuity anchor (same rationale as the bench contract): the counters
+    # key off JAX-internal monitoring event names, so a renamed event would
+    # read zero everywhere and silently disarm this tripwire. The cold
+    # replay must have either compiled or persistent-cache-loaded programs.
+    assert cold["backend_compiles"] + cold["persistent_hits"] > 0, cold
+
+    before = compilestats.snapshot()
+    gen.run_wire(requests)  # fresh sidecar, same bytes, same program keys
+    warm = compilestats.delta(before, compilestats.snapshot())
+    assert warm["backend_compiles"] == 0, (
+        f"warm re-call of the target-rung program shapes paid "
+        f"{warm['backend_compiles']} fresh XLA compiles "
+        f"({warm['backend_compile_secs']} s) — a jit cache key is being "
+        f"invalidated between identical runs; on TPU this is minutes per "
+        f"program: {warm}"
+    )
+    assert warm["persistent_misses"] == 0, warm
+
+
+def test_empty_goals_resolve_to_default_stack(wire_replay):
+    """goals=[] ⇒ the sidecar runs DEFAULT_GOAL_ORDER (docs/sidecar-wire.md
+    §Propose). Runs warm: the target-rung replay above already compiled
+    exactly these programs (build_opts B5 IS the default stack)."""
+    from ccx.goals.stack import DEFAULT_GOAL_ORDER
+
+    requests, *_ = wire_replay
+    sc, _, _ = gen.run_puts(requests)
+    _, options = gen.target_rung_goals_and_options()
+    frames = list(sc.propose(wire.propose_request(
+        goals=(), options=options, session=gen.SESSION)))
+    summary = gen.canonical_result(frames)["goalSummary"]
+    assert [g["goal"] for g in summary] == list(DEFAULT_GOAL_ORDER)
 
 
 def test_delta_base_mismatch_is_rejected():
-    requests = build_requests()
+    requests = gen.build_requests()
     sc = OptimizerSidecar()
     sc.put_snapshot(requests["put_full_request.bin"])
     bad = msgpack.unpackb(requests["put_delta_request.bin"], raw=False)
     bad["base_generation"] = 99
     with pytest.raises(ValueError, match="base generation"):
-        sc.put_snapshot(msgpack.packb(bad, use_bin_type=True))
+        sc.put_snapshot(wire.packb(bad))
 
 
 def test_ping_shape():
     sc = OptimizerSidecar()
-    pong = msgpack.unpackb(sc.ping(b""), raw=False)
-    assert set(pong) == {"version", "backend", "num_devices"}
+    # both the canonical versioned body and legacy empty bytes are accepted
+    for req in (wire.ping_request(), b""):
+        pong = msgpack.unpackb(sc.ping(req), raw=False)
+        assert set(pong) == {"version", "backend", "num_devices", wire.FIELD_WIRE}
+        assert pong[wire.FIELD_WIRE] == wire.WIRE_VERSION

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# check_bridge.sh — javac-optional bridge smoke + wire-fixture cross-check.
+#
+# Part of the repo verify flow (tier-1 runs it via
+# tests/test_bridge_conformance.py; operators run it directly):
+#   1. JVM-free fixture cross-check: regenerated wire bytes must match the
+#      golden fixtures byte-for-byte (tools/gen_wire_fixtures.py --check).
+#   2. If javac is on PATH: compile bridge/src/main (pure JDK, no jars).
+#   3. If a JRE is also present: run ccx.bridge.tools.FixtureCheck — every
+#      golden fixture must decode -> re-encode byte-identically through the
+#      Java msgpack codec.
+#   4. If CCX_BRIDGE_GRPC_CLASSPATH is set: compile bridge/src/grpc too.
+# Steps 2-4 skip cleanly (exit 0, with a note) when the toolchain is absent.
+#
+# Env:
+#   CCX_BRIDGE_SKIP_FIXTURES=1     skip step 1 (e.g. when pytest already ran it)
+#   CCX_BRIDGE_GRPC_CLASSPATH=...  grpc-java jars for the transport compile
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [ "${CCX_BRIDGE_SKIP_FIXTURES:-0}" != "1" ]; then
+  echo "check_bridge: cross-checking wire fixtures (JVM-free)"
+  python tools/gen_wire_fixtures.py --check
+else
+  echo "check_bridge: fixture cross-check skipped (CCX_BRIDGE_SKIP_FIXTURES=1)"
+fi
+
+if ! command -v javac >/dev/null 2>&1; then
+  echo "check_bridge: javac not found — Java compile smoke skipped (OK)"
+  exit 0
+fi
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+echo "check_bridge: compiling bridge/src/main with $(javac -version 2>&1)"
+# shellcheck disable=SC2046 — file list is ours, no spaces
+javac -d "$out" $(find bridge/src/main/java -name '*.java' | sort)
+echo "check_bridge: bridge core compiles clean"
+
+if command -v java >/dev/null 2>&1; then
+  java -cp "$out" ccx.bridge.tools.FixtureCheck tests/fixtures/sidecar
+else
+  echo "check_bridge: java (JRE) not found — FixtureCheck skipped (OK)"
+fi
+
+if [ -n "${CCX_BRIDGE_GRPC_CLASSPATH:-}" ]; then
+  echo "check_bridge: compiling bridge/src/grpc against grpc-java"
+  # shellcheck disable=SC2046
+  javac -cp "$out:$CCX_BRIDGE_GRPC_CLASSPATH" -d "$out" \
+    $(find bridge/src/grpc/java -name '*.java' | sort)
+  echo "check_bridge: grpc transport compiles clean"
+else
+  echo "check_bridge: CCX_BRIDGE_GRPC_CLASSPATH unset — grpc transport compile skipped (OK)"
+fi
+
+echo "check_bridge: all checks passed"

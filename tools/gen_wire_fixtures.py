@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Deterministic golden-fixture generator for the sidecar wire contract.
+
+Single source with the live endpoints: every request byte is built by
+``ccx/sidecar/wire.py`` (canonical sorted-key msgpack) from the seeded
+``small_deterministic`` fixture cluster, so regeneration is byte-stable —
+same tree, same bytes, any machine (CPU backend is forced when run
+standalone). ``tests/test_sidecar_conformance.py`` and
+``tests/test_bridge_conformance.py`` import THIS module for the builders;
+``tools/check_bridge.sh`` runs ``--check`` as its JVM-free cross-check.
+
+Usage:
+    python tools/gen_wire_fixtures.py            # (re)write tests/fixtures/sidecar/
+    python tools/gen_wire_fixtures.py --check    # verify bytes match the tree
+    python tools/gen_wire_fixtures.py --check --full   # also replay Propose
+
+``--check`` rebuilds the request bytes and replays PutSnapshot through a
+live in-process sidecar, comparing byte-for-byte against the checked-in
+fixtures; ``--full`` adds the Propose replay (runs the optimizer, ~1 min).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXDIR = REPO / "tests" / "fixtures" / "sidecar"
+
+if str(REPO) not in sys.path:  # standalone runs start with tools/ as path[0]
+    sys.path.insert(0, str(REPO))
+
+#: the fixture protocol: one session, full snapshot then a delta, propose
+SESSION = "conformance"
+#: bench-effort env knobs that must not leak into fixture generation
+_BENCH_KNOBS = ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
+                "CCX_BENCH_POLISH_ITERS")
+#: volatile result keys excluded from the golden propose_result.json
+#: (phaseSeconds is per-phase wall clock — round 6: its unnoticed arrival
+#: in to_json had silently broken the replay test until regeneration here)
+VOLATILE = ("wallSeconds", "phaseSeconds")
+
+REQUEST_NAMES = ("ping_request.bin", "put_full_request.bin",
+                 "put_delta_request.bin", "propose_request.bin")
+RESPONSE_NAMES = ("put_full_response.bin", "put_delta_response.bin")
+RESULT_NAME = "propose_result.json"
+
+
+def _delta_arrays():
+    """The fixture delta: partition 0's leadership moves to slot 1."""
+    import numpy as np
+
+    from ccx.model.fixtures import small_deterministic
+    from ccx.model.snapshot import model_to_arrays
+
+    base = model_to_arrays(small_deterministic())
+    new = dict(base)
+    ls = np.array(base["leader_slot"], np.int32).copy()
+    ls[0] = 1
+    new["leader_slot"] = ls
+    return base, new
+
+
+def target_rung_goals_and_options() -> tuple[list, dict]:
+    """The OFFICIAL bench target rung (full goal stack + engine options),
+    serialized exactly as the bench's own sidecar path does
+    (``bench.build_opts`` → ``bench._wire_options`` — the single rung-config
+    construction site). Pinning the golden propose fixture to the T1 wire
+    configuration makes rung retunes fail the conformance suite loudly
+    (regenerate deliberately, with a changelog entry) and lets the
+    compile-warmth tripwire reuse the replay's compiled program set.
+    Deterministic: the bench effort env knobs are masked for the call."""
+    import os
+
+    import bench
+
+    saved = {k: os.environ.pop(k) for k in _BENCH_KNOBS if k in os.environ}
+    try:
+        goal_names, opts, _effort = bench.build_opts("B5", "target")
+    finally:
+        os.environ.update(saved)
+    return list(goal_names), bench._wire_options(opts)
+
+
+def build_requests() -> dict[str, bytes]:
+    """The four golden request bodies, byte-exact (wire.py canonical)."""
+    from ccx.model.fixtures import small_deterministic
+    from ccx.model.snapshot import delta_encode, pack_arrays, to_msgpack
+    from ccx.sidecar import wire
+
+    base, new = _delta_arrays()
+    goals, options = target_rung_goals_and_options()
+    return {
+        "ping_request.bin": wire.ping_request(),
+        "put_full_request.bin": wire.put_snapshot_request(
+            session=SESSION, generation=1,
+            packed=to_msgpack(small_deterministic()), is_delta=False,
+        ),
+        "put_delta_request.bin": wire.put_snapshot_request(
+            session=SESSION, generation=2,
+            packed=pack_arrays(delta_encode(base, new)),
+            is_delta=True, base_generation=1,
+        ),
+        "propose_request.bin": wire.propose_request(
+            goals=goals, options=options, session=SESSION,
+        ),
+    }
+
+
+def run_puts(requests: dict[str, bytes], sidecar=None):
+    """Replay the PutSnapshot pair in protocol order; returns the sidecar
+    (holding the session) plus both response byte strings."""
+    from ccx.sidecar.server import OptimizerSidecar
+
+    sc = sidecar or OptimizerSidecar()
+    put_full = sc.put_snapshot(requests["put_full_request.bin"])
+    put_delta = sc.put_snapshot(requests["put_delta_request.bin"])
+    return sc, put_full, put_delta
+
+
+def run_wire(requests: dict[str, bytes]):
+    """Full protocol replay: puts then the Propose stream frames."""
+    sc, put_full, put_delta = run_puts(requests)
+    frames = list(sc.propose(requests["propose_request.bin"]))
+    return put_full, put_delta, frames
+
+
+def canonical_result(frames) -> dict:
+    """The terminal result frame, volatile fields stripped, JSON-normalized."""
+    assert frames, "propose produced no frames"
+    *progress, last = frames
+    assert all("progress" in f for f in progress), progress
+    assert "result" in last, last
+    res = {k: v for k, v in last["result"].items() if k not in VOLATILE}
+    return json.loads(json.dumps(res))  # normalize tuples etc.
+
+
+def result_json(frames) -> str:
+    return json.dumps(canonical_result(frames), indent=1, sort_keys=True)
+
+
+def write(fixdir: pathlib.Path = FIXDIR) -> None:
+    fixdir.mkdir(parents=True, exist_ok=True)
+    requests = build_requests()
+    put_full, put_delta, frames = run_wire(requests)
+    for name, buf in requests.items():
+        (fixdir / name).write_bytes(buf)
+    (fixdir / "put_full_response.bin").write_bytes(put_full)
+    (fixdir / "put_delta_response.bin").write_bytes(put_delta)
+    (fixdir / RESULT_NAME).write_text(result_json(frames))
+
+
+def check(fixdir: pathlib.Path = FIXDIR, full: bool = False) -> list[str]:
+    """Byte-compare a regeneration against the checked-in fixtures;
+    returns a list of problems (empty = conformant)."""
+    problems: list[str] = []
+    requests = build_requests()
+    for name, buf in requests.items():
+        path = fixdir / name
+        if not path.exists():
+            problems.append(f"{name}: missing")
+        elif path.read_bytes() != buf:
+            problems.append(f"{name}: regenerated bytes differ")
+    if full:
+        put_full, put_delta, frames = run_wire(requests)
+        result = result_json(frames)
+    else:
+        _, put_full, put_delta = run_puts(requests)
+        result = None
+    for name, buf in (("put_full_response.bin", put_full),
+                      ("put_delta_response.bin", put_delta)):
+        if (fixdir / name).read_bytes() != buf:
+            problems.append(f"{name}: replayed response differs")
+    if result is not None and (fixdir / RESULT_NAME).read_text() != result:
+        problems.append(f"{RESULT_NAME}: replayed result differs")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="verify instead of write")
+    ap.add_argument("--full", action="store_true",
+                    help="with --check: also replay Propose (slow)")
+    ap.add_argument("--out", type=pathlib.Path, default=FIXDIR)
+    args = ap.parse_args(argv)
+
+    # standalone runs must not touch a (possibly wedged) accelerator, and
+    # engine-output fixtures are banked on the CPU backend — force it
+    # before the first backend use (env vars are too late under the
+    # sitecustomize-preloaded TPU platform)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if args.check:
+        problems = check(args.out, full=args.full)
+        for p in problems:
+            print(f"FIXTURE DRIFT: {p}", file=sys.stderr)
+        if problems:
+            print(f"{len(problems)} fixture problem(s) — regenerate with "
+                  f"`python tools/gen_wire_fixtures.py` if the wire change "
+                  f"is intentional", file=sys.stderr)
+            return 1
+        print(f"wire fixtures conformant ({args.out})")
+        return 0
+    write(args.out)
+    print(f"wrote {len(REQUEST_NAMES) + len(RESPONSE_NAMES) + 1} fixtures "
+          f"to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
