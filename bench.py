@@ -253,7 +253,19 @@ def build_opts(name: str, rung: str):
                 "topic_rebalance_max_sweeps": 1024,
                 "topic_rebalance_move_leaders": True,
                 "topic_rebalance_polish_iters": 700,
-                "leader_pass_max_iters": 300,
+                # r6 usage-coupled swap engine (docs/perf-notes.md
+                # "Usage-coupled swaps"): 150 pre-leader coupled swap
+                # iters (clears the NwOut/CPU usage cells) + 300
+                # post-leader iters (the LeaderReplica/LeaderBytesIn
+                # cells the uniform leader pass stalls on), leader cap
+                # 300 -> 150 (the coupled post stage does the leader-tier
+                # work the extra cap iterations were buying, cheaper).
+                # Measured at B5 vs the r5 lean line: NwOut 661 -> 17,
+                # LeaderReplica 723 -> 371, LeaderBytesIn 757 -> 447,
+                # every other tier equal or better, TRD stays 0.
+                "swap_polish_iters": 150,
+                "swap_polish_post_iters": 300,
+                "leader_pass_max_iters": 150,
                 "run_polish": "TopicReplicaDistributionGoal" not in goal_names,
             }
             if rung in ("lean", "custom")
@@ -267,6 +279,8 @@ def build_opts(name: str, rung: str):
         # never silently compared across different stage sets
         "portfolio": opts.run_cold_greedy,
         "trd_rounds": opts.topic_rebalance_rounds,
+        "swap_polish": [opts.swap_polish_iters, opts.swap_polish_post_iters],
+        "swap_coupling": opts.anneal.swap_coupling,
     }
     return goal_names, opts, effort
 
@@ -303,6 +317,13 @@ def _wire_options(opts) -> dict:
         "leader_pass_max_iters": opts.leader_pass_max_iters,
         "repair_backend": opts.repair_backend,
         "overlap_repair": opts.overlap_repair,
+        "p_swap": opts.anneal.p_swap,
+        "p_swap_end": opts.anneal.p_swap_end,
+        "swap_coupling": opts.anneal.swap_coupling,
+        "swap_polish_iters": opts.swap_polish_iters,
+        "swap_polish_post_iters": opts.swap_polish_post_iters,
+        "swap_polish_candidates": opts.swap_polish_candidates,
+        "swap_polish_guarded": opts.swap_polish_guarded,
     }
 
 
@@ -886,6 +907,12 @@ def main() -> None:
                 opts.anneal.n_chains,
                 opts.anneal.moves_per_step,
                 opts.polish.n_candidates,
+                # the swap-polish program is lean-rung-only while target
+                # shares the SA/polish shapes — without this key the
+                # dedup would skip the rung that compiles it (either
+                # invocation runs the same program, so pre OR post counts)
+                opts.swap_polish_iters > 0 or opts.swap_polish_post_iters > 0,
+                opts.swap_polish_candidates,
             )
             if shape in shapes:
                 continue

@@ -243,6 +243,52 @@ def analyzer_config_def() -> ConfigDef:
              "portfolio pattern). Costs roughly one extra polish-budget run "
              "per optimize() call; disable for latency-sensitive endpoints. "
              "Leadership-only and disk-only fast paths skip it regardless.")
+    d.define("optimizer.swap.coupling", Type.DOUBLE, 0.5, Importance.LOW,
+             "Share of SA swap proposals drawn usage-coupled (both "
+             "endpoints Gumbel-selected from a candidate pool ranked by "
+             "live broker band pressure x per-replica usage) instead of "
+             "uniformly. 0 restores the uniform draw; coupling is what "
+             "lets a lean budget hit the specific different-topic pairs "
+             "that fix residual NetworkOutUsage/LeaderReplica cells.",
+             between(0, 1))
+    d.define("optimizer.swap.p.swap", Type.DOUBLE, 0.15, Importance.LOW,
+             "REPLICA_SWAP share of SA proposals (AnnealOptions.p_swap; "
+             "intra-broker stacks force 0).", between(0, 1))
+    d.define("optimizer.swap.p.swap.end", Type.DOUBLE, -1.0, Importance.LOW,
+             "End value of the linear p_swap schedule: the swap share "
+             "anneals from optimizer.swap.p.swap to this value over the "
+             "run (swaps matter most once count tiers settle). -1 = "
+             "constant share. The schedule enters compiled programs as "
+             "data — retunes never recompile the SA chunk.",
+             between(-1, 1))
+    d.define("optimizer.swap.polish.iters", Type.INT, 150, Importance.LOW,
+             "Iteration budget for the usage-coupled swap-polish phase "
+             "(count-preserving replica swaps + pressure-coupled "
+             "leadership transfers, pure lexicographic descent, run after "
+             "the topic-rebalance stage). 0 disables. The budget is "
+             "while_loop data — every setting shares one compiled "
+             "program. Leadership-/disk-only fast paths skip the phase.",
+             at_least(0))
+    d.define("optimizer.swap.polish.post.iters", Type.INT, 150,
+             Importance.LOW,
+             "Iteration budget for the SECOND swap-polish invocation, run "
+             "after the leadership pass (the uniform leader pass stalls "
+             "on LeaderReplica/LeaderBytesIn cells only the coupled draw "
+             "finds). 0 disables; shares the pre-leader stage's compiled "
+             "program.", at_least(0))
+    d.define("optimizer.swap.polish.candidates", Type.INT, 128,
+             Importance.LOW,
+             "Coupled candidates scored per swap-polish iteration, split "
+             "evenly between replica-swap pairs and leadership transfers "
+             "(static program shape, shared by the pre- and post-leader "
+             "invocations).", at_least(1))
+    d.define("optimizer.swap.polish.guarded", Type.BOOLEAN, True,
+             Importance.LOW,
+             "Veto swap-polish candidates that significantly worsen the "
+             "TopicReplicaDistribution tier (different-topic swaps move "
+             "topic cells; the guard keeps a converged shed's TRD=0 from "
+             "being traded back for usage cells — same rationale as "
+             "optimizer.topic.rebalance.guarded).")
     d.define("optimizer.repair.backend", Type.STRING, "device",
              Importance.LOW,
              "hard_repair loop driver: 'device' runs the whole sweep loop "

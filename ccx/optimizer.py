@@ -35,7 +35,12 @@ from ccx.search.annealer import (
     anneal,
     hot_partition_list_device,
 )
-from ccx.search.greedy import GreedyOptions, greedy_optimize
+from ccx.search.greedy import (
+    GreedyOptions,
+    SwapPolishOptions,
+    greedy_optimize,
+    swap_polish,
+)
 from ccx.search.repair import (
     finalize_preferred_leaders,
     hard_repair,
@@ -58,6 +63,13 @@ class OptimizerResult:
     n_sa_accepted: int
     n_polish_moves: int
     phase_seconds: dict = dataclasses.field(default_factory=dict)
+    #: per-move-type proposal/acceptance counts summed over every search
+    #: phase executed (SA + polishes + swap-polish + leader pass; engine
+    #: activity, not output-plan attribution) — keyed by
+    #: ccx.search.state.MOVE_KIND_NAMES. Rides BENCH_*.json so frontier
+    #: regressions (e.g. a swap acceptance collapse) are diagnosable from
+    #: artifacts alone.
+    move_counters: dict = dataclasses.field(default_factory=dict)
     #: input placement, kept so the ClusterModelStats blocks (ref
     #: model/ClusterModelStats.java, SURVEY.md C4) can be derived lazily —
     #: computing them costs an aggregate pass + host transfer, which must not
@@ -124,6 +136,7 @@ class OptimizerResult:
             "phaseSeconds": {
                 k: round(v, 3) for k, v in self.phase_seconds.items()
             },
+            "moveCounters": self.move_counters,
             **(
                 {
                     "clusterModelStats": {
@@ -210,6 +223,33 @@ class OptimizeOptions:
     #: wall) — so the default is uncapped; the knob exists for
     #: latency-critical callers.
     leader_pass_max_iters: int | None = None
+    #: iteration budget for the usage-coupled swap-polish phase (config
+    #: `optimizer.swap.polish.iters`; 0 disables). Runs AFTER the
+    #: topic-rebalance stage (so it polishes whatever the guarded re-polish
+    #: left) and BEFORE the leadership pass (which cleans up the
+    #: preferred-leader debris leadership-bearing swaps create). Pure lex
+    #: descent over count-preserving replica swaps + pressure-coupled
+    #: leadership transfers (ccx.search.greedy.swap_polish) — the move
+    #: class the residual NwOut/LeaderReplica cells need (VERDICT r5 #4).
+    swap_polish_iters: int = 0
+    #: iteration budget for the SECOND swap-polish invocation, run AFTER
+    #: the leadership pass (config `optimizer.swap.polish.post.iters`;
+    #: 0 disables). Measured at B5: the leader pass leaves LeaderReplica/
+    #: LeaderBytesIn cells whose fix needs the coupled draw (pressure-
+    #: ranked low-usage-delta transfers + complementary swaps) — 300 post
+    #: iters took LR 599 -> 239 and LBI 631 -> 271 in ~10 s where the
+    #: uniform leader pass had stalled. Shares the pre-leader stage's
+    #: compiled program (same candidate shape).
+    swap_polish_post_iters: int = 0
+    #: coupled candidates per swap-polish iteration (static program
+    #: shape), split evenly between replica-swap pairs and leadership
+    #: transfers so both invocations share ONE compiled program
+    swap_polish_candidates: int = 128
+    #: veto swap-polish candidates that significantly worsen the
+    #: TopicReplicaDistribution tier (different-topic swaps move topic
+    #: cells; the guard keeps a converged shed's TRD=0 from being traded
+    #: back for usage cells — same rationale as topic_rebalance_guarded)
+    swap_polish_guarded: bool = True
     #: hard_repair loop driver (config `optimizer.repair.backend`):
     #: "device" (default) runs the whole sweep loop as ONE compiled program
     #: with a traced sweep budget and feeds its lazy outputs straight into
@@ -278,6 +318,13 @@ def prewarm_options(opts: OptimizeOptions) -> OptimizeOptions:
         anneal=anneal,
         polish=polish,
         max_repair_rounds=1,
+        # the swap-polish budget is while_loop data too — one floored
+        # iteration compiles the program every real budget reuses (both
+        # invocations share it, so the post stage needs no extra pass)
+        swap_polish_iters=min(
+            max(opts.swap_polish_iters, opts.swap_polish_post_iters), 1
+        ),
+        swap_polish_post_iters=0,
         # one sweep round compiles nothing extra (host numpy) but exercises
         # the guarded re-polish adoption path end-to-end
         topic_rebalance_rounds=min(opts.topic_rebalance_rounds, 1),
@@ -337,6 +384,14 @@ def optimize(
     """
     t0 = time.monotonic()
     phases: dict[str, float] = {}
+    kind_prop = [0, 0, 0]
+    kind_acc = [0, 0, 0]
+
+    def _tally(r) -> None:
+        """Accumulate a search result's per-move-kind counters."""
+        for i in range(3):
+            kind_prop[i] += int(r.n_prop_kind[i])
+            kind_acc[i] += int(r.n_acc_kind[i])
 
     def _enter(name: str) -> float:
         if progress_cb is not None:
@@ -393,6 +448,7 @@ def optimize(
                 m, cfg, goal_names,
                 dataclasses.replace(opts.anneal, n_steps=chunk),
             )
+            _tally(sa1)
             t_join = time.monotonic()
             repair_thread.join()
             phases["repair-join"] = time.monotonic() - t_join
@@ -433,6 +489,7 @@ def optimize(
         else:
             sa = anneal(repaired, cfg, goal_names, opts.anneal)
     phases["anneal"] = time.monotonic() - t
+    _tally(sa)
     if n_repair_lazy is not None:
         # the anneal consumed the repaired arrays, so this sync is free
         n_repair = int(n_repair_lazy)
@@ -443,6 +500,7 @@ def optimize(
     if opts.run_polish:
         with annotate("ccx:polish"):
             polish = greedy_optimize(model, cfg, goal_names, opts.polish)
+            _tally(polish)
             model = polish.model
             stack_after = polish.stack_after
             n_polish += polish.n_moves
@@ -454,6 +512,7 @@ def optimize(
                 )
                 n_polish += n_r
                 polish = greedy_optimize(model, cfg, goal_names, opts.polish)
+                _tally(polish)
                 if polish.n_moves == 0 and n_r == 0:
                     break
                 model = polish.model
@@ -479,6 +538,7 @@ def optimize(
         t = _enter("portfolio")
         with annotate("ccx:portfolio"):
             cold = greedy_optimize(m, cfg, goal_names, opts.polish)
+            _tally(cold)
             if _lex_better(cold.stack_after, stack_after):
                 model = cold.model
                 stack_after = cold.stack_after
@@ -526,16 +586,53 @@ def optimize(
                     swept, cfg, goal_names, repolish,
                     trd_guard=opts.topic_rebalance_guarded,
                 )
+                _tally(cand)
                 if opts.topic_rebalance_guarded and not _lex_better(
                     cand.stack_after, stack_after
                 ):
                     cand = greedy_optimize(swept, cfg, goal_names, repolish)
+                    _tally(cand)
                 if not _lex_better(cand.stack_after, stack_after):
                     break
                 model = cand.model
                 stack_after = cand.stack_after
                 n_polish += n_swept + cand.n_moves
         phases["topic-rebalance"] = time.monotonic() - t
+    def _run_swap_polish(model_in, iters, phase_name):
+        # usage-coupled swap polish: the count-preserving descent for the
+        # residual NwOut/LeaderReplica cells single moves cannot reach
+        # (VERDICT r5 #4). Pure lex descent (hard-safe, optionally
+        # TRD-guarded), so the result is adopted unconditionally. The
+        # candidate budget splits evenly between replica-swap pairs and
+        # leadership transfers, so the pre-leader and post-leader
+        # invocations share ONE compiled program.
+        t_sp = _enter(phase_name)
+        with annotate(f"ccx:{phase_name}"):
+            ksw = max(opts.swap_polish_candidates // 2, 1)
+            sp = swap_polish(
+                model_in, cfg, goal_names,
+                SwapPolishOptions(
+                    n_swap_candidates=ksw,
+                    n_lead_candidates=max(
+                        opts.swap_polish_candidates - ksw, 0
+                    ),
+                    max_iters=iters,
+                    trd_guard=opts.swap_polish_guarded,
+                ),
+            )
+            _tally(sp)
+        phases[phase_name] = time.monotonic() - t_sp
+        return sp
+
+    if opts.swap_polish_iters > 0 and allows_inter_broker(goal_names):
+        # pre-leader invocation: clears the usage-tier (NwOut/CPU) cells
+        # so the leader pass optimizes against a settled usage field; the
+        # leader pass then cleans up the preferred-leader debris
+        # leadership-bearing swaps leave behind
+        sp = _run_swap_polish(model, opts.swap_polish_iters, "swap-polish")
+        model = sp.model
+        stack_after = sp.stack_after
+        n_polish += sp.n_moves
     leadership_scored = LEADERSHIP_GOALS & set(goal_names)
     if (
         opts.run_leader_pass
@@ -563,10 +660,22 @@ def optimize(
                     ),
                 ),
             )
+            _tally(lead)
             model = lead.model
             stack_after = lead.stack_after
             n_polish += lead.n_moves
         phases["leader-pass"] = time.monotonic() - t
+    if opts.swap_polish_post_iters > 0 and allows_inter_broker(goal_names):
+        # post-leader invocation: the uniform leader pass stalls on the
+        # LeaderReplica/LeaderBytesIn cells whose fix needs the coupled
+        # draw — measured at B5 (docs/perf-notes.md "Usage-coupled
+        # swaps"): 300 post iters, LR 599 -> 239, LBI 631 -> 271, ~10 s
+        sp = _run_swap_polish(
+            model, opts.swap_polish_post_iters, "swap-polish-post"
+        )
+        model = sp.model
+        stack_after = sp.stack_after
+        n_polish += sp.n_moves
     # exact final guarantee: fold leadership decisions into canonical
     # replica order (leader first), zeroing fixable PLE violations without
     # perturbing any other tier — see repair.finalize_preferred_leaders
@@ -591,6 +700,16 @@ def optimize(
         stack_after=stack_after,
     )
     phases["verify"] = time.monotonic() - t
+    from ccx.common.metrics import REGISTRY
+    from ccx.search.state import MOVE_KIND_NAMES
+
+    move_counters = {}
+    for i, name in enumerate(MOVE_KIND_NAMES):
+        move_counters[name] = {
+            "proposed": kind_prop[i], "accepted": kind_acc[i]
+        }
+        REGISTRY.counter(f"proposal-moves-{name}-proposed").inc(kind_prop[i])
+        REGISTRY.counter(f"proposal-moves-{name}-accepted").inc(kind_acc[i])
     return OptimizerResult(
         proposals=proposals,
         stack_before=stack_before,
@@ -601,6 +720,7 @@ def optimize(
         n_sa_accepted=sa.n_accepted,
         n_polish_moves=n_polish,
         phase_seconds=phases,
+        move_counters=move_counters,
         input_model=m,
     )
 

@@ -301,6 +301,7 @@ def sharded_anneal(
         ProposalParams,
         _anneal_step,
         _anneal_step_batched,
+        _swap_ramp_of,
         allows_inter_broker,
         best_chain_index,
         hot_partition_list,
@@ -355,7 +356,12 @@ def sharded_anneal(
         target_capacity=bool(CAPACITY_GOALS_ & set(goal_names)),
         cap_thresholds=tuple(cfg.capacity_threshold),
         p_lead_swap=lead_swap_share(opts.p_leadership),
+        # swap-knob parity with annealer._build_step: the coupled
+        # endpoint draw and the p_swap schedule run under sharding too
+        p_couple=opts.swap_coupling if allow_inter else 0.0,
+        couple_pool=opts.couple_pool,
     )
+    schedule_on = allow_inter and opts.p_swap_end >= 0
 
     m_sharded = shard_model(m, mesh)
     keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
@@ -386,6 +392,7 @@ def sharded_anneal(
     cache_key = (
         mesh, goal_names, cfg, pp, b_real,
         opts.n_steps, opts.t0, opts.t1, opts.moves_per_step, opts.batched,
+        opts.p_swap_end,
         needs_topic, _struct_key(m),
     )
     cached_run = _cache_get(_RUN_CACHE, cache_key)
@@ -424,6 +431,8 @@ def sharded_anneal(
         grouped_leader=(
             P(CHAINS_AXIS, None, None) if needs_topic else None
         ),
+        n_prop_kind=P(CHAINS_AXIS, None),
+        n_acc_kind=P(CHAINS_AXIS, None),
     )
 
     import functools as _ft
@@ -506,6 +515,8 @@ def sharded_anneal(
                 hard_mask=hard_mask,
                 grouped_assign=ga,
                 grouped_leader=gl,
+                n_prop_kind=jnp.zeros(3, jnp.int32),
+                n_acc_kind=jnp.zeros(3, jnp.int32),
             )
             states = jax.vmap(lambda k: state0.replace(key=k))(keys_local)
 
@@ -541,7 +552,7 @@ def sharded_anneal(
             batched = (
                 opts.batched
                 and opts.moves_per_step > 1
-                and pp.p_swap > 0.0
+                and (pp.p_swap > 0.0 or schedule_on)
                 and b_real >= 4 * m_local.R * opts.moves_per_step
             )
             step = _ft.partial(
@@ -556,6 +567,9 @@ def sharded_anneal(
                 gather=gather,
                 locate=locate,
                 group=group_l,
+                swap_ramp=_swap_ramp_of(opts, n),
+                swap_schedule_on=schedule_on,
+                cfg=cfg,
                 **(
                     {
                         "vector_fn": make_cost_vector_fn(
@@ -616,4 +630,6 @@ def _finish_sharded_anneal(m_sharded, states, cfg, goal_names, opts, stack_befor
         n_chains=opts.n_chains,
         n_steps=opts.n_steps,
         best_chain=best,
+        n_prop_kind=tuple(int(x) for x in np.asarray(pick.n_prop_kind)),
+        n_acc_kind=tuple(int(x) for x in np.asarray(pick.n_acc_kind)),
     )

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ccx.common.resources import Resource
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack, soft_weights
 from ccx.model.tensor_model import TensorClusterModel
@@ -38,6 +39,8 @@ from ccx.search.state import (
     SearchState,
     apply_move,
     apply_swap,
+    broker_pressure,
+    bump_kind_counters,
     gather_view,
     init_search_state,
     make_move_scorer,
@@ -45,6 +48,7 @@ from ccx.search.state import (
     make_topic_group,
     max_partitions_per_topic,
     stack_needs_topic,
+    usage_weights,
     with_placement,
 )
 
@@ -88,6 +92,23 @@ class AnnealOptions:
     #: count-preserving barriers single moves cannot (ref ActionType,
     #: SURVEY.md C20); 0 disables (intra-broker stacks set 0).
     p_swap: float = 0.15
+    #: >= 0: the swap share anneals linearly from ``p_swap`` to this value
+    #: over the run — swaps matter most once the count tiers have settled
+    #: (late in the schedule), so a lean budget can start single-heavy and
+    #: finish swap-heavy. < 0 (default): constant ``p_swap``. The ramp
+    #: enters the step as traced data (the chunk runner keeps ONE compiled
+    #: program across schedules). Config: ``optimizer.swap.p.swap.end``.
+    p_swap_end: float = -1.0
+    #: share of swap proposals drawn USAGE-COUPLED instead of uniform
+    #: (batched step only): both endpoints are Gumbel-selected from a
+    #: ``couple_pool``-candidate pool scored by live broker band pressure
+    #: (ccx.search.state.broker_pressure) x per-replica usage, so the
+    #: (overloaded-broker hot replica, underloaded-broker cool replica)
+    #: pairs that fix residual NwOut/leader cells stop being needles in a
+    #: uniform haystack. Config: ``optimizer.swap.coupling``.
+    swap_coupling: float = 0.5
+    #: candidates per coupled endpoint draw (static — program shape)
+    couple_pool: int = 4
     #: >0: run the scan in fixed chunks of this many steps with the global
     #: step index passed as data, so ONE compiled program (per chains/moves
     #: shape) serves every n_steps — TPU B5 compiles are minutes apiece and
@@ -110,6 +131,10 @@ class AnnealResult:
     n_chains: int
     n_steps: int
     best_chain: int
+    #: best chain's per-move-kind (single, replica-swap, leadership-swap)
+    #: proposal/acceptance counts — observability (state.MOVE_KIND_NAMES)
+    n_prop_kind: tuple[int, ...] = (0, 0, 0)
+    n_acc_kind: tuple[int, ...] = (0, 0, 0)
 
     @property
     def improved(self) -> bool:
@@ -153,6 +178,12 @@ class ProposalParams:
     #: ``lead_swap_share`` so a stack with a tiny p_leadership doesn't spend
     #: half its swap budget on leadership rotations.
     p_lead_swap: float = 0.5
+    #: share of swap proposals drawn usage-coupled (AnnealOptions
+    #: .swap_coupling; batched step only — the sequential step keeps the
+    #: uniform draw as the ablation reference). 0 disables the pool pass.
+    p_couple: float = 0.0
+    #: static pool size per coupled endpoint draw
+    couple_pool: int = 4
 
 
 def lead_swap_share(p_leadership: float) -> float:
@@ -597,15 +628,18 @@ def propose_swap(
     count, so they reach load-balance states that single relocations cannot
     without transiently violating the count-distribution band.
 
-    Returns (p1, view1, old1, new1, p2, view2, old2, new2, feasible)."""
+    Returns (p1, view1, old1, new1, p2, view2, old2, new2, feasible,
+    is_lead)."""
     k_p1, k_p2, k_plan = jax.random.split(key, 3)
     p1 = jax.random.randint(k_p1, (), 0, pp.p_real)
     p2 = jax.random.randint(k_p2, (), 0, pp.p_real)
     g = gather or gather_view
     view1 = g(state, m, p1)
     view2 = g(state, m, p2)
-    old1, new1, old2, new2, ok = _swap_plan(k_plan, m, pp, p1, view1, p2, view2)
-    return p1, view1, old1, new1, p2, view2, old2, new2, ok
+    old1, new1, old2, new2, ok, is_lead = _swap_plan(
+        k_plan, m, pp, p1, view1, p2, view2
+    )
+    return p1, view1, old1, new1, p2, view2, old2, new2, ok, is_lead
 
 
 def _swap_plan(
@@ -616,9 +650,11 @@ def _swap_plan(
     view1,
     p2: jnp.ndarray,
     view2,
+    use_lead: jnp.ndarray | None = None,
+    couple=None,
 ):
     """Build a swap candidate from two gathered views: returns
-    (old1, new1, old2, new2, feasible).
+    (old1, new1, old2, new2, feasible, is_lead).
 
     Two variants share the draw: a REPLICA swap (exchange brokers between
     two replicas — preserves every broker's replica count) and a LEADERSHIP
@@ -627,11 +663,21 @@ def _swap_plan(
     preferred-leader / leader-bytes improvements cross the
     LeaderReplicaDistribution tier, which vetoes any single transfer that
     unbalances leader counts (the reference reaches these states through
-    PreferredLeaderElectionGoal's count-neutral passes)."""
+    PreferredLeaderElectionGoal's count-neutral passes).
+
+    ``use_lead`` (traced bool) pre-decides the variant when the caller drew
+    it earlier (the coupled batched step scores its candidate pools
+    per-variant); None keeps the internal ``p_lead_swap`` draw.
+    ``couple = (use_couple, r1_c, r2_c)`` overrides the uniform slot draw
+    with the coupling pass's hot/cool slots for coupled replica swaps."""
     R, B, D = m.R, m.B, m.D
     k_r1, k_r2, k_d1, k_d2, k_kind = jax.random.split(key, 5)
     r1 = jax.random.randint(k_r1, (), 0, R)
     r2 = jax.random.randint(k_r2, (), 0, R)
+    if couple is not None:
+        use_couple, r1_c, r2_c = couple
+        r1 = jnp.where(use_couple, r1_c, r1).astype(jnp.int32)
+        r2 = jnp.where(use_couple, r2_c, r2).astype(jnp.int32)
     x = view1.assign[r1]
     y = view2.assign[r2]
     sx = jnp.clip(x, 0, B - 1)
@@ -698,12 +744,17 @@ def _swap_plan(
         & lead_allowed[lb1]
         & lead_allowed[lb2]
     )
-    use_lead = (
-        (jax.random.uniform(k_kind) < pp.p_lead_swap)
-        if pp.p_lead_swap > 0
-        else False
-    )
-    if pp.p_lead_swap > 0:
+    if use_lead is None:
+        lead_possible = pp.p_lead_swap > 0
+        use_lead = (
+            (jax.random.uniform(k_kind) < pp.p_lead_swap)
+            if lead_possible
+            else jnp.asarray(False)
+        )
+    else:
+        lead_possible = True
+        use_lead = jnp.asarray(use_lead)
+    if lead_possible:
         def sel_rows(a, b):
             return jnp.where(use_lead, a, b)
 
@@ -718,7 +769,7 @@ def _swap_plan(
             sel_rows(view2.disk, new2[2]),
         )
         ok = jnp.where(use_lead, ok_lead, ok)
-    return old1, new1, old2, new2, ok
+    return old1, new1, old2, new2, ok, jnp.asarray(use_lead)
 
 
 def goal_tols(cost_vec: jnp.ndarray) -> jnp.ndarray:
@@ -768,10 +819,19 @@ def _anneal_step(
     gather=None,
     locate=None,
     group=None,
+    swap_ramp=0.0,
+    swap_schedule_on: bool = False,
+    cfg=None,
 ) -> SearchState:
     """``moves_per_step`` sequential proposals on one chain (vmapped over
     chains by the caller). Sequential composition inside the step is exact:
     each proposal scores against the state left by the previous one.
+
+    ``swap_ramp`` (traced scalar, per-step delta of the swap share) makes
+    the swap probability ``pp.p_swap + swap_ramp * step`` — the p_swap
+    schedule enters as DATA so the chunk runner's one-program contract
+    survives schedule retunes. ``cfg`` is accepted for signature parity
+    with the batched step (the sequential path keeps uniform draws).
 
     Every proposal — single move or REPLICA_SWAP — flows through ONE
     two-partition code path (a single move is a degenerate swap whose second
@@ -803,17 +863,20 @@ def _anneal_step(
             ss.cost_vec, delta.cost_vec, hard_arr, weights, temperature, k_acc
         )
         p_idx, owned = locate(p) if locate is not None else (p, True)
-        return apply_move(
+        ss = apply_move(
             ss, m, p_idx, view, old, new, delta, accept, owned,
             group=group, global_p=p,
         )
+        return bump_kind_counters(ss, 0, 1, accept.astype(jnp.int32))
 
     def inner(i, ss: SearchState) -> SearchState:
         key = jax.random.fold_in(ss.key, step_idx * moves_per_step + i)
         k_sel, k_p, k_ev, k_evi, k_p1, k_p2, k_single, k_swap, k_acc = (
             jax.random.split(key, 9)
         )
-        use_swap = jax.random.uniform(k_sel) < pp.p_swap
+        use_swap = jax.random.uniform(k_sel) < (
+            pp.p_swap + swap_ramp * step_idx
+        )
 
         p_single, use_evac = _draw_partition(k_p, k_ev, k_evi, pp, evac, n_evac)
         p1_sw = jax.random.randint(k_p1, (), 0, pp.p_real)
@@ -827,7 +890,9 @@ def _anneal_step(
         old_s, new_s, feas_s = _single_plan(
             k_single, ss, m, pp, va, use_evac & ~use_swap
         )
-        o1w, n1w, o2w, n2w, ok_w = _swap_plan(k_swap, m, pp, pa, va, pb, vb)
+        o1w, n1w, o2w, n2w, ok_w, is_lead = _swap_plan(
+            k_swap, m, pp, pa, va, pb, vb
+        )
 
         def pick(a, b):
             return jnp.where(use_swap, a, b)
@@ -855,13 +920,19 @@ def _anneal_step(
             ib, ownb = locate(pb)
         else:
             ia, owna, ib, ownb = pa, True, pb, True
-        return apply_swap(
+        ss = apply_swap(
             ss, m, ia, va, olda, newa, ib, vb, oldb, newb, delta, accept,
             owna, ownb, group=group, global_p1=pa, global_p2=pb,
             active2=use_swap,
         )
+        kind = jnp.where(
+            use_swap, jnp.where(is_lead, 2, 1), 0
+        ).astype(jnp.int32)
+        return bump_kind_counters(ss, kind, 1, accept.astype(jnp.int32))
 
-    body = inner if pp.p_swap > 0.0 else inner_single_only
+    # the branch is program SHAPE: a traced ramp cannot flip it, so the
+    # builder passes the static schedule flag alongside the traced ramp
+    body = inner if (pp.p_swap > 0.0 or swap_schedule_on) else inner_single_only
     return jax.lax.fori_loop(0, moves_per_step, body, state)
 
 
@@ -883,11 +954,25 @@ def _anneal_step_batched(
     gather=None,
     locate=None,
     group=None,
+    swap_ramp=0.0,
+    swap_schedule_on: bool = False,
+    cfg=None,
 ) -> SearchState:
     """``moves_per_step`` proposals drawn, scored and accepted against the
     step's BASE state, then applied as a pairwise-disjoint batch — the
     polish-pass batching (ccx.search.greedy apply_batch) lifted into the SA
-    step. Wall-clock rationale: the sequential step pays one stacked
+    step.
+
+    Swap endpoints are drawn USAGE-COUPLED with probability ``pp.p_couple``
+    (AnnealOptions.swap_coupling): each endpoint Gumbel-picked from a
+    ``pp.couple_pool``-candidate pool ranked by live broker band pressure
+    (ccx.search.state.broker_pressure, O(B) from the carried aggregates —
+    never a [P] pass) x per-replica usage, hot x complementary. Pool slot 0
+    is the plain uniform draw, so uncoupled candidates force selection 0
+    and the program stays shape-stable across coupling settings; at
+    ``p_couple == 0`` the pool collapses to C=1 and the step is the
+    round-6 uniform engine. ``swap_ramp``/``swap_schedule_on``: see
+    ``_anneal_step`` — the p_swap schedule enters as traced data. Wall-clock rationale: the sequential step pays one stacked
     gather + one stacked scatter per carried buffer *per proposal*; this
     step pays the same *per step*, so K proposals cost ~one proposal's
     kernel sequencing. Under partition-axis sharding the per-proposal psum
@@ -914,37 +999,136 @@ def _anneal_step_batched(
     )
 
     K = moves_per_step
-    B, T = m.B, m.num_topics
+    B, T, R = m.B, m.num_topics, m.R
     ss = state
     keys = jax.random.split(jax.random.fold_in(ss.key, step_idx), K)
+    couple_on = pp.p_couple > 0.0 and cfg is not None
+    C = max(int(pp.couple_pool), 1) if couple_on else 1
 
-    # --- draw K candidate partition pairs (index-only, no state reads) ----
+    # --- draw K candidate endpoint POOLS (index-only, no state reads) -----
     def draw(k):
-        k_sel, k_p, k_ev, k_evi, k_p1, k_p2, k_s, k_w, k_acc = jax.random.split(
-            k, 9
-        )
+        (k_sel, k_p, k_ev, k_evi, k_pa, k_pb, k_s, k_w, k_acc, k_lead,
+         k_cpl, k_ga, k_gb) = jax.random.split(k, 13)
         use_swap = (
-            (jax.random.uniform(k_sel) < pp.p_swap)
-            if pp.p_swap > 0.0
+            (jax.random.uniform(k_sel) < (pp.p_swap + swap_ramp * step_idx))
+            if (pp.p_swap > 0.0 or swap_schedule_on)
             else jnp.asarray(False)
         )
         p_single, use_evac = _draw_partition(k_p, k_ev, k_evi, pp, evac, n_evac)
-        p1 = jax.random.randint(k_p1, (), 0, pp.p_real)
-        p2 = jax.random.randint(k_p2, (), 0, pp.p_real)
-        pa = jnp.where(use_swap, p1, p_single)
-        return pa, p2, use_swap, use_evac & ~use_swap, k_s, k_w, k_acc
+        pool_a = jax.random.randint(k_pa, (C,), 0, pp.p_real)
+        pool_b = jax.random.randint(k_pb, (C,), 0, pp.p_real)
+        # pool slot 0 doubles as the single-move partition on non-swap draws
+        pool_a = pool_a.at[0].set(jnp.where(use_swap, pool_a[0], p_single))
+        use_lead = (
+            (jax.random.uniform(k_lead) < pp.p_lead_swap)
+            if pp.p_lead_swap > 0
+            else jnp.asarray(False)
+        )
+        use_couple = (
+            ((jax.random.uniform(k_cpl) < pp.p_couple) & use_swap)
+            if couple_on
+            else jnp.asarray(False)
+        )
+        return (pool_a, pool_b, use_swap, use_evac & ~use_swap, use_lead,
+                use_couple, k_s, k_w, k_acc, k_ga, k_gb)
 
-    pa, pb, use_swap, use_evac, ks_single, ks_swap, ks_acc = jax.vmap(draw)(keys)
+    (pools_a, pools_b, use_swap, use_evac, use_lead, use_couple,
+     ks_single, ks_swap, ks_acc, ks_ga, ks_gb) = jax.vmap(draw)(keys)
 
-    # ONE stacked gather for all 2K views per carried placement buffer
-    # (the sharding hook turns this into one owner-gather + one psum)
-    views = (gather or gather_views)(ss, m, jnp.concatenate([pa, pb]))
-    va = jax.tree.map(lambda x: x[:K], views)
-    vb = jax.tree.map(lambda x: x[K:], views)
+    # ONE stacked gather for all 2*K*C pool views per carried placement
+    # buffer (the sharding hook turns this into one owner-gather + one psum)
+    views = (gather or gather_views)(
+        ss, m, jnp.concatenate([pools_a.reshape(-1), pools_b.reshape(-1)])
+    )
+    va_pool = jax.tree.map(
+        lambda x: x[: K * C].reshape((K, C) + x.shape[1:]), views
+    )
+    vb_pool = jax.tree.map(
+        lambda x: x[K * C:].reshape((K, C) + x.shape[1:]), views
+    )
 
-    def plan(k_s, k_w, va_k, vb_k, pa_k, pb_k, use_swap_k, use_evac_k):
+    if couple_on:
+        # ---- usage-coupled endpoint selection: Gumbel-pick each endpoint
+        # from its pool, ranked by live broker band pressure (over for
+        # endpoint a, under for b) x per-replica usage — elementwise math
+        # on already-gathered views, no extra carried-buffer reads --------
+        press = broker_pressure(m, ss.agg, cfg)
+        uw = usage_weights()
+
+        def pool_scores(vp, over: bool):
+            b = jnp.clip(vp.assign, 0, B - 1)                    # [C, R]
+            ok = (
+                (vp.assign >= 0)
+                & vp.pvalid[:, None]
+                & ~vp.immovable[:, None]
+            )
+            is_l = jnp.arange(R)[None, :] == vp.leader[:, None]
+            u_lead = vp.lead_load @ uw                           # [C]
+            u_foll = vp.foll_load @ uw
+            u = jnp.where(is_l, u_lead[:, None], u_foll[:, None])  # [C, R]
+            if over:
+                sc = press.usage_over[b] * u * ok
+            else:
+                sc = press.usage_under[b] * (1.0 / (1.0 + u)) * ok
+            slot = jnp.argmax(sc, axis=1).astype(jnp.int32)
+            rs_logit = jnp.log(jnp.max(sc, axis=1) + 1e-12)
+            # leadership-swap variant: endpoint quality is the LEADER
+            # broker's leader-bytes band pressure x the leader's bytes-in
+            lsafe = jnp.clip(vp.leader, 0, R - 1)[:, None]
+            lb = jnp.take_along_axis(b, lsafe, axis=1)[:, 0]
+            has_lead = vp.pvalid & (
+                jnp.take_along_axis(vp.assign, lsafe, axis=1)[:, 0] >= 0
+            )
+            lbytes = vp.lead_load[:, Resource.NW_IN]
+            if over:
+                lsc = press.lbi_over[lb] * lbytes
+            else:
+                lsc = press.lbi_under[lb] * (1.0 / (1.0 + lbytes))
+            ls_logit = jnp.log(jnp.where(has_lead, lsc, 0.0) + 1e-12)
+            return rs_logit, ls_logit, slot
+
+        rs_a, ls_a, slot_a = jax.vmap(lambda vp: pool_scores(vp, True))(
+            va_pool
+        )
+        rs_b, ls_b, slot_b = jax.vmap(lambda vp: pool_scores(vp, False))(
+            vb_pool
+        )
+
+        def gumbel_pick(logit_rs, logit_ls, ul, uc, kg):
+            logit = jnp.where(ul, logit_ls, logit_rs)
+            g = -jnp.log(
+                -jnp.log(
+                    jax.random.uniform(kg, (C,), minval=1e-12, maxval=1.0)
+                )
+            )
+            s = jnp.argmax(logit + g).astype(jnp.int32)
+            return jnp.where(uc, s, 0)
+
+        sel_a = jax.vmap(gumbel_pick)(rs_a, ls_a, use_lead, use_couple, ks_ga)
+        sel_b = jax.vmap(gumbel_pick)(rs_b, ls_b, use_lead, use_couple, ks_gb)
+        ar = jnp.arange(K)
+        va = jax.tree.map(lambda x: x[ar, sel_a], va_pool)
+        vb = jax.tree.map(lambda x: x[ar, sel_b], vb_pool)
+        pa = pools_a[ar, sel_a]
+        pb = pools_b[ar, sel_b]
+        r1_c = slot_a[ar, sel_a]
+        r2_c = slot_b[ar, sel_b]
+    else:
+        va = jax.tree.map(lambda x: x[:, 0], va_pool)
+        vb = jax.tree.map(lambda x: x[:, 0], vb_pool)
+        pa = pools_a[:, 0]
+        pb = pools_b[:, 0]
+        r1_c = jnp.zeros((K,), jnp.int32)
+        r2_c = jnp.zeros((K,), jnp.int32)
+
+    def plan(k_s, k_w, va_k, vb_k, pa_k, pb_k, use_swap_k, use_evac_k,
+             use_lead_k, use_couple_k, r1_k, r2_k):
         old_s, new_s, feas_s = _single_plan(k_s, ss, m, pp, va_k, use_evac_k)
-        o1w, n1w, o2w, n2w, ok_w = _swap_plan(k_w, m, pp, pa_k, va_k, pb_k, vb_k)
+        o1w, n1w, o2w, n2w, ok_w, _ = _swap_plan(
+            k_w, m, pp, pa_k, va_k, pb_k, vb_k,
+            use_lead=use_lead_k if pp.p_lead_swap > 0 else None,
+            couple=(use_couple_k & ~use_lead_k, r1_k, r2_k),
+        )
 
         def pick(a, b):
             return jnp.where(use_swap_k, a, b)
@@ -965,7 +1149,8 @@ def _anneal_step_batched(
         return olda, newa, oldb, newb, jnp.where(use_swap_k, ok_w, feas_s)
 
     olda, newa, oldb, newb, feas = jax.vmap(plan)(
-        ks_single, ks_swap, va, vb, pa, pb, use_swap, use_evac
+        ks_single, ks_swap, va, vb, pa, pb, use_swap, use_evac,
+        use_lead, use_couple, r1_c, r2_c
     )
 
     deltas = jax.vmap(
@@ -1087,6 +1272,10 @@ def _anneal_step_batched(
     write_b = take & batch_ok & use_swap & ownb
     mirror_a = take & batch_ok & va.pvalid
     mirror_b = take & batch_ok & use_swap & vb.pvalid
+    kind = jnp.where(use_swap, jnp.where(use_lead, 2, 1), 0).astype(jnp.int32)
+    ss = bump_kind_counters(
+        ss, kind, 1, (take & batch_ok).astype(jnp.int32)
+    )
     return ss.replace(
         agg=sel_tree(agg, ss.agg),
         part_sums=sel_tree(part, ss.part_sums),
@@ -1110,6 +1299,14 @@ def _anneal_step_batched(
     )
 
 
+def _swap_ramp_of(opts: AnnealOptions, n: int) -> float:
+    """Per-step swap-share delta of the linear p_swap schedule (0.0 when
+    the schedule is off, ``p_swap_end < 0``)."""
+    if opts.p_swap_end < 0:
+        return 0.0
+    return (opts.p_swap_end - opts.p_swap) / max(n - 1, 1)
+
+
 def _build_step(
     m: TensorClusterModel,
     goal_names: tuple[str, ...],
@@ -1118,6 +1315,7 @@ def _build_step(
     p_real: int,
     b_real: int,
     max_pt: int,
+    swap_ramp=0.0,
 ):
     """Construct the per-step transition (called inside a trace).
 
@@ -1125,7 +1323,10 @@ def _build_step(
     (`_run_chunk`) so both compile the identical step body. Returns
     ``(step, group)``; ``opts.n_steps`` is never read here — the cooling
     schedule is the caller's business — so a chunk-runner static key with
-    ``n_steps`` zeroed still builds the exact same transition.
+    ``n_steps`` zeroed still builds the exact same transition. The p_swap
+    schedule follows the same rule: ``swap_ramp`` (per-step swap-share
+    delta) may be a traced scalar; only the SIGN of ``opts.p_swap_end``
+    (schedule on/off) is program shape.
     """
     group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
     hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
@@ -1133,6 +1334,7 @@ def _build_step(
     weights = soft_weights(hard_mask)
 
     allow_inter = allows_inter_broker(goal_names)
+    schedule_on = allow_inter and opts.p_swap_end >= 0
     pp = ProposalParams(
         p_real=p_real,
         b_real=b_real,
@@ -1146,6 +1348,8 @@ def _build_step(
         target_capacity=bool(CAPACITY_GOALS & set(goal_names)),
         cap_thresholds=tuple(cfg.capacity_threshold),
         p_lead_swap=lead_swap_share(opts.p_leadership),
+        p_couple=opts.swap_coupling if allow_inter else 0.0,
+        couple_pool=opts.couple_pool,
     )
     from ccx.search.state import make_cost_vector_fn
 
@@ -1161,7 +1365,7 @@ def _build_step(
     batched = (
         opts.batched
         and opts.moves_per_step > 1
-        and pp.p_swap > 0.0
+        and (pp.p_swap > 0.0 or schedule_on)
         and b_real >= 4 * m.R * opts.moves_per_step
     )
     step = functools.partial(
@@ -1174,6 +1378,9 @@ def _build_step(
         scorer=make_move_scorer(m, goal_names, cfg),
         swap_scorer=make_swap_scorer(m, goal_names, cfg),
         group=group,
+        swap_ramp=swap_ramp,
+        swap_schedule_on=schedule_on,
+        cfg=cfg,
         **(
             {"vector_fn": make_cost_vector_fn(m, goal_names, cfg)}
             if batched
@@ -1211,6 +1418,7 @@ def _run_chunk(
     n_evac: jnp.ndarray,
     t_offset: jnp.ndarray,
     decay: jnp.ndarray,
+    swap_ramp: jnp.ndarray,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
@@ -1230,9 +1438,12 @@ def _run_chunk(
     of once per rung/retune. Bit-exact vs `_run_chains`: the step body is
     identical (`_build_step`) and ``temp = t0 * decay**t`` sees the same
     f32 values — XLA folds the unchunked path's python-float decay to f32
-    exactly as `jnp.float32(decay)` does here.
+    exactly as `jnp.float32(decay)` does here. ``swap_ramp`` rides along
+    the same way (the p_swap schedule is data, not shape).
     """
-    step, _ = _build_step(m, goal_names, cfg, opts, p_real, b_real, max_pt)
+    step, _ = _build_step(
+        m, goal_names, cfg, opts, p_real, b_real, max_pt, swap_ramp=swap_ramp
+    )
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
         temp = opts.t0 * decay**t
@@ -1262,11 +1473,14 @@ def _run_chains(
     b_real: int,
     max_pt: int,
 ) -> SearchState:
-    step, group = _build_step(m, goal_names, cfg, opts, p_real, b_real, max_pt)
+    n = max(opts.n_steps, 1)
+    step, group = _build_step(
+        m, goal_names, cfg, opts, p_real, b_real, max_pt,
+        swap_ramp=_swap_ramp_of(opts, n),
+    )
     state0 = init_search_state(m, cfg, goal_names, keys[0], group=group)
     states = jax.vmap(lambda k: state0.replace(key=k))(keys)
 
-    n = max(opts.n_steps, 1)
     decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
@@ -1346,16 +1560,25 @@ def anneal(
         # chunk_steps documents the restriction.
         n = max(opts.n_steps, 1)
         decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
-        opts_key = dataclasses.replace(opts, n_steps=0, seed=0)
+        # the schedule's MAGNITUDE is traced data (swap_ramp below); only
+        # its on/off sign may shape the program, so the static key pins
+        # p_swap_end to a sign sentinel and schedule retunes reuse the
+        # compiled chunk
+        opts_key = dataclasses.replace(
+            opts, n_steps=0, seed=0,
+            p_swap_end=1.0 if opts.p_swap_end >= 0 else -1.0,
+        )
         states = _init_chains(
             m, keys, goal_names=goal_names, cfg=cfg, max_pt=max_pt
         )
         evac_j = jnp.asarray(evac)
         n_evac_j = jnp.asarray(n_evac, jnp.int32)
+        ramp = jnp.asarray(_swap_ramp_of(opts, n), jnp.float32)
         for off in range(0, n, opts.chunk_steps):
             states = _run_chunk(
                 states, m, evac_j, n_evac_j,
                 jnp.asarray(off, jnp.int32), jnp.asarray(decay, jnp.float32),
+                ramp,
                 goal_names=goal_names, cfg=cfg, opts=opts_key,
                 p_real=p_real, b_real=b_real, max_pt=max_pt,
                 chunk=int(min(opts.chunk_steps, n - off)),
@@ -1381,4 +1604,6 @@ def anneal(
         n_chains=opts.n_chains,
         n_steps=opts.n_steps,
         best_chain=best,
+        n_prop_kind=tuple(int(x) for x in np.asarray(pick.n_prop_kind)),
+        n_acc_kind=tuple(int(x) for x in np.asarray(pick.n_acc_kind)),
     )

@@ -48,6 +48,16 @@ from ccx.model.aggregates import BrokerAggregates, broker_aggregates
 from ccx.model.tensor_model import TensorClusterModel
 
 
+#: move-kind indexes for the per-move-type proposal/acceptance counters
+#: (single covers replica/leadership/disk relocations; the two swap kinds
+#: are the count-preserving pair actions)
+KIND_SINGLE = 0
+KIND_REPLICA_SWAP = 1
+KIND_LEADERSHIP_SWAP = 2
+NUM_MOVE_KINDS = 3
+MOVE_KIND_NAMES = ("single", "replicaSwap", "leadershipSwap")
+
+
 @struct.dataclass
 class SearchState:
     """Dynamic per-chain state. The static cluster attributes (loads,
@@ -70,6 +80,13 @@ class SearchState:
     #: iff the stack scores topic goals; None otherwise
     grouped_assign: jnp.ndarray | None = None   # int32[T, max_pt, R]
     grouped_leader: jnp.ndarray | None = None   # int32[T, max_pt]
+    #: per-move-kind proposal/acceptance counters int32[NUM_MOVE_KINDS]
+    #: (single / replica-swap / leadership-swap — ref ActionType vocabulary).
+    #: Observability only: weight-0 updates keep rejected moves bit-exact
+    #: no-ops on every OTHER field; these two count regardless so frontier
+    #: regressions are diagnosable from artifacts alone.
+    n_prop_kind: jnp.ndarray | None = None
+    n_acc_kind: jnp.ndarray | None = None
 
     @property
     def hard_cost(self) -> jnp.ndarray:
@@ -168,6 +185,132 @@ def gather_views(
 def view_at(views: PartitionView, i: int) -> PartitionView:
     """The i-th PartitionView of a stacked gather."""
     return jax.tree.map(lambda x: x[i], views)
+
+
+# --------------------------------------------------------------------------
+# Usage-coupled swap proposal support (VERDICT r5 next #4): per-broker
+# overload scores for the tiers only count-preserving swaps can fix, plus
+# the static per-replica usage weighting both samplers share.
+# --------------------------------------------------------------------------
+
+#: static resource weights for the combined per-replica usage scalar the
+#: coupled samplers rank candidates by. NW_OUT dominates (the lean rung's
+#: residual frontier tier, NetworkOutboundUsageDistribution); CPU rides at
+#: 0.3 because CPU cells sit one tier below and correlate with the same
+#: hot replicas. NW_IN/DISK excluded: their tiers are already near-solved
+#: at lean and their loads would dilute the NW_OUT ranking.
+USAGE_WEIGHTS = (0.3, 0.0, 1.0, 0.0)  # CPU, NW_IN, NW_OUT, DISK
+
+
+def usage_weights() -> jnp.ndarray:
+    return jnp.asarray(USAGE_WEIGHTS, jnp.float32)
+
+
+def bump_kind_counters(
+    state: "SearchState",
+    kind: jnp.ndarray,
+    proposed: jnp.ndarray,
+    accepted: jnp.ndarray,
+) -> "SearchState":
+    """Scatter-add the per-move-kind proposal/acceptance counters (KIND_*
+    indexes; ``kind`` scalar or [k] with matching int weights). Counting is
+    explicit at the proposal sites — not inside apply_move/apply_swap — so
+    mixed-branch loops (greedy's single-batch vs best-swap cond) attribute
+    each iteration's full proposal mix exactly once. No-op when the state
+    carries no counters."""
+    if state.n_prop_kind is None:
+        return state
+    return state.replace(
+        n_prop_kind=state.n_prop_kind.at[kind].add(proposed),
+        n_acc_kind=state.n_acc_kind.at[kind].add(accepted),
+    )
+
+
+@struct.dataclass
+class BrokerPressure:
+    """Per-broker over/under band-deviation scores, derived from the live
+    [B]-level aggregates each step/iteration (O(B) math — never a [P] pass).
+
+    The *_over arrays are the hot-endpoint sampling weights (replicas ON
+    these brokers want to shed usage/leadership), the *_under arrays the
+    cold-endpoint weights. Band math mirrors ``ccx.goals.kernels``
+    ``_band_penalty`` exactly (hinge outside [avg*(2-t), avg*t] over alive
+    brokers) plus a mild toward-average term so the sampler still pairs
+    endpoints when strict violators have no strict-violator partner —
+    acceptance (lex + hard veto) remains the only correctness gate."""
+
+    usage_over: jnp.ndarray   # f32[B] combined NW_OUT/CPU utilization over
+    usage_under: jnp.ndarray  # f32[B] combined utilization headroom
+    lead_over: jnp.ndarray    # f32[B] leader-count band excess
+    lead_under: jnp.ndarray   # f32[B] leader-count band deficit
+    lbi_over: jnp.ndarray     # f32[B] leader-bytes-in band excess
+    lbi_under: jnp.ndarray    # f32[B] leader-bytes-in band deficit
+
+
+def _band_pressure(values, alive, avg, threshold):
+    """(over, under) hinge distances outside the kernel band, plus a 0.1x
+    toward-average term inside it (sampling weight only), normalized by
+    avg so resources combine."""
+    safe_avg = jnp.maximum(avg, 1e-9)
+    upper = avg * threshold
+    lower = avg * (2.0 - threshold)
+    over = jnp.maximum(values - upper, 0.0) + 0.1 * jnp.maximum(
+        values - avg, 0.0
+    )
+    under = jnp.maximum(lower - values, 0.0) + 0.1 * jnp.maximum(
+        avg - values, 0.0
+    )
+    return (
+        jnp.where(alive, over / safe_avg, 0.0),
+        jnp.where(alive, under / safe_avg, 0.0),
+    )
+
+
+def broker_pressure(
+    m: TensorClusterModel, agg: BrokerAggregates, cfg: GoalConfig
+) -> BrokerPressure:
+    """Live per-broker pressure for the swap-coupled tiers from the
+    incrementally-maintained aggregates (no placement reads)."""
+    alive = m.broker_valid & m.broker_alive
+    usage_over = jnp.zeros(m.B, jnp.float32)
+    usage_under = jnp.zeros(m.B, jnp.float32)
+    for res in (Resource.NW_OUT, Resource.CPU):
+        wr = float(USAGE_WEIGHTS[int(res)])
+        if wr == 0.0:
+            continue
+        cap = m.broker_capacity[res]
+        load = jnp.where(alive, agg.broker_load[res], 0.0)
+        avg_util = jnp.sum(load) / jnp.maximum(
+            jnp.sum(jnp.where(alive, cap, 0.0)), 1e-9
+        )
+        util = load / jnp.where(cap > 0, cap, 1.0)
+        over, under = _band_pressure(
+            util, alive & (cap > 0), avg_util, cfg.balance_threshold[int(res)]
+        )
+        usage_over = usage_over + wr * over
+        usage_under = usage_under + wr * under
+
+    lead_ok = alive & ~m.broker_excl_leadership
+    n_lead = jnp.maximum(jnp.sum(lead_ok), 1).astype(jnp.float32)
+    counts = agg.leader_count.astype(jnp.float32)
+    lead_avg = jnp.sum(jnp.where(lead_ok, counts, 0.0)) / n_lead
+    lead_over, lead_under = _band_pressure(
+        counts, lead_ok, lead_avg, cfg.leader_balance_threshold
+    )
+
+    lbi = jnp.where(lead_ok, agg.leader_bytes_in, 0.0)
+    lbi_avg = jnp.sum(lbi) / n_lead
+    lbi_over, lbi_under = _band_pressure(
+        lbi, lead_ok, lbi_avg, cfg.leader_bytes_in_balance_threshold
+    )
+    return BrokerPressure(
+        usage_over=usage_over,
+        usage_under=usage_under,
+        lead_over=lead_over,
+        lead_under=lead_under,
+        lbi_over=lbi_over,
+        lbi_under=lbi_under,
+    )
 
 
 def max_partitions_per_topic(m: TensorClusterModel) -> int:
@@ -703,6 +846,8 @@ def init_search_state(
         hard_mask=tuple(GOAL_REGISTRY[n].hard for n in goal_names),
         grouped_assign=ga,
         grouped_leader=gl,
+        n_prop_kind=jnp.zeros(NUM_MOVE_KINDS, jnp.int32),
+        n_acc_kind=jnp.zeros(NUM_MOVE_KINDS, jnp.int32),
     )
 
 

@@ -119,6 +119,9 @@ class CruiseControl:
             moves_per_step=self.config["optimizer.moves.per.step"],
             seed=self.config["optimizer.seed"],
             chunk_steps=self.config["optimizer.chunk.steps"],
+            p_swap=self.config["optimizer.swap.p.swap"],
+            p_swap_end=self.config["optimizer.swap.p.swap.end"],
+            swap_coupling=self.config["optimizer.swap.coupling"],
         )
         polish = GreedyOptions(
             n_candidates=self.config["optimizer.polish.candidates"],
@@ -181,6 +184,20 @@ class CruiseControl:
             ),
             repair_backend=self.config["optimizer.repair.backend"],
             overlap_repair=self.config["optimizer.repair.overlap"],
+            # swap-polish moves replicas between brokers: never on the
+            # leadership-only (demote) or intra-broker (disk) fast paths
+            swap_polish_iters=(
+                0 if (leadership_only or disk_only)
+                else self.config["optimizer.swap.polish.iters"]
+            ),
+            swap_polish_post_iters=(
+                0 if (leadership_only or disk_only)
+                else self.config["optimizer.swap.polish.post.iters"]
+            ),
+            swap_polish_candidates=self.config[
+                "optimizer.swap.polish.candidates"
+            ],
+            swap_polish_guarded=self.config["optimizer.swap.polish.guarded"],
         )
 
     def _run_optimizer(self, model, goal_names, opts: OptimizeOptions,
@@ -542,6 +559,26 @@ class CruiseControl:
                     # an operator (or the JVM bridge) confirm wire compat
                     # from the REST state endpoint before routing proposals
                     "sidecarWireVersion": WIRE_VERSION,
+                    # swap-engine state: which move-class escalation this
+                    # analyzer runs (diagnosable from REST, like the wire
+                    # version) — per-request overridable via the same keys
+                    "swapEngine": {
+                        "coupling": self.config["optimizer.swap.coupling"],
+                        "pSwap": self.config["optimizer.swap.p.swap"],
+                        "pSwapEnd": self.config["optimizer.swap.p.swap.end"],
+                        "polishIters": self.config[
+                            "optimizer.swap.polish.iters"
+                        ],
+                        "polishPostIters": self.config[
+                            "optimizer.swap.polish.post.iters"
+                        ],
+                        "polishCandidates": self.config[
+                            "optimizer.swap.polish.candidates"
+                        ],
+                        "polishGuarded": self.config[
+                            "optimizer.swap.polish.guarded"
+                        ],
+                    },
                 }
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self.anomaly_detector.state()

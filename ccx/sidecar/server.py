@@ -139,6 +139,9 @@ class OptimizerSidecar:
                 # omitting the field reuses the SAME compiled program
                 # instead of forcing a second multi-minute B5 compile
                 chunk_steps=int(o.get("chunk_steps", 250)),
+                p_swap=float(o.get("p_swap", 0.15)),
+                p_swap_end=float(o.get("p_swap_end", -1.0)),
+                swap_coupling=float(o.get("swap_coupling", 0.5)),
             ),
             polish=GreedyOptions(
                 n_candidates=int(o.get("polish_candidates", 256)),
@@ -175,6 +178,10 @@ class OptimizerSidecar:
                 if o.get("leader_pass_max_iters") is not None
                 else None
             ),
+            swap_polish_iters=int(o.get("swap_polish_iters", 0)),
+            swap_polish_post_iters=int(o.get("swap_polish_post_iters", 0)),
+            swap_polish_candidates=int(o.get("swap_polish_candidates", 128)),
+            swap_polish_guarded=bool(o.get("swap_polish_guarded", True)),
         )
         yield wire.progress_frame(
             f"Optimizing {model.P}x{model.B} over {len(goals)} goals"

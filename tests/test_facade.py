@@ -169,6 +169,11 @@ def test_state_and_reads(tmp_path):
     from ccx.sidecar.wire import WIRE_VERSION
 
     assert st["AnalyzerState"]["sidecarWireVersion"] == WIRE_VERSION
+    # swap-engine state mirrors the optimizer.swap.* keys (r6)
+    swap = st["AnalyzerState"]["swapEngine"]
+    assert {"coupling", "pSwap", "pSwapEnd", "polishIters",
+            "polishPostIters", "polishCandidates"} <= set(swap)
+    assert 0 <= swap["coupling"] <= 1
     assert "AnomalyDetectorState" in st
     sub = cc.state(("monitor",))
     assert "ExecutorState" not in sub

@@ -1,0 +1,314 @@
+"""Usage-coupled swap engine: scorer parity, invariants, compile hygiene.
+
+Three layers of evidence for the r6 swap engine (the move class the
+residual NwOut/LeaderReplica cells need — VERDICT r5 next #4):
+
+* **Scorer parity** — the vmapped incremental swap tier-delta
+  (ccx.search.state.make_swap_scorer) must equal a from-scratch numpy-side
+  oracle (apply the swap to the model, evaluate_stack) on every goal, for
+  replica swaps, leadership swaps and the degenerate single-move case.
+  Same pattern as tests/test_parity.py: score comparisons, not goldens.
+* **Invariants** — swap_polish preserves every broker's replica count
+  bit-exactly (its whole point is count-preserving descent), never
+  worsens the hard tier, never regresses the cost vector
+  lexicographically, and respects rack/host safety (no new rack
+  violations, nothing lands on dead or excluded brokers).
+* **Compile hygiene** — the swap-polish budget is while_loop DATA: a
+  re-run and a different budget must pay ZERO fresh XLA compiles (the
+  warmth-tripwire contract that keeps the lean rung's warm re-run
+  compile-free; tests/test_sidecar_conformance.py pins the wire path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER, evaluate_stack
+from ccx.model.fixtures import RandomClusterSpec, random_cluster
+from ccx.search.annealer import ProposalParams, propose_swap
+from ccx.search.greedy import SwapPolishOptions, swap_polish
+from ccx.search.state import (
+    broker_pressure,
+    init_search_state,
+    make_swap_scorer,
+    make_topic_group,
+    max_partitions_per_topic,
+    stack_needs_topic,
+)
+
+CFG = GoalConfig()
+SPEC = RandomClusterSpec(
+    n_brokers=14, n_racks=4, n_topics=10, n_partitions=700, seed=31
+)
+
+
+def _state_for(m, goal_names=DEFAULT_GOAL_ORDER):
+    group = (
+        make_topic_group(m, max_partitions_per_topic(m))
+        if stack_needs_topic(goal_names)
+        else None
+    )
+    return init_search_state(
+        m, CFG, goal_names, jax.random.PRNGKey(0), group=group
+    )
+
+
+def _apply_swap_numpy(m, p1, r1, p2, r2, kind):
+    """Oracle: apply the swap to host arrays and rebuild the model."""
+    a = np.asarray(m.assignment).copy()
+    lead = np.asarray(m.leader_slot).copy()
+    disk = np.asarray(m.replica_disk).copy()
+    if kind == "replica":
+        a[p1, r1], a[p2, r2] = a[p2, r2], a[p1, r1]
+        # destination disk: slot 0 mirrors the device plan's D == 1 case
+        disk[p1, r1] = 0
+        disk[p2, r2] = 0
+    elif kind == "leadership":
+        lead[p1], lead[p2] = r1, r2
+    else:
+        raise ValueError(kind)
+    return m.replace(
+        assignment=jax.numpy.asarray(a),
+        leader_slot=jax.numpy.asarray(lead),
+        replica_disk=jax.numpy.asarray(disk),
+    )
+
+
+def test_swap_scorer_matches_numpy_oracle_vmapped():
+    """Vmapped swap tier-deltas == from-scratch stack evaluation of the
+    swapped placement, for a batch of feasible replica swaps."""
+    m = random_cluster(SPEC)
+    goal_names = DEFAULT_GOAL_ORDER
+    state = _state_for(m)
+    scorer = make_swap_scorer(m, goal_names, CFG)
+    a = np.asarray(m.assignment)
+    valid = (a >= 0) & np.asarray(m.partition_valid)[:, None]
+
+    # pick feasible (p1, r1, p2, r2) combos: distinct partitions, distinct
+    # brokers, no duplicate-broker creation
+    rng = np.random.default_rng(5)
+    combos = []
+    while len(combos) < 8:
+        p1, p2 = rng.integers(0, m.P, 2)
+        if p1 == p2 or not (valid[p1].any() and valid[p2].any()):
+            continue
+        r1 = rng.choice(np.nonzero(valid[p1])[0])
+        r2 = rng.choice(np.nonzero(valid[p2])[0])
+        x, y = a[p1, r1], a[p2, r2]
+        if x == y or y in a[p1][valid[p1]] or x in a[p2][valid[p2]]:
+            continue
+        combos.append((int(p1), int(r1), int(p2), int(r2)))
+
+    from ccx.search.state import gather_view
+
+    def one(p1, r1, p2, r2):
+        v1 = gather_view(state, m, p1)
+        v2 = gather_view(state, m, p2)
+        old1 = (v1.assign, v1.leader, v1.disk)
+        old2 = (v2.assign, v2.leader, v2.disk)
+        new1 = (v1.assign.at[r1].set(v2.assign[r2]), v1.leader,
+                v1.disk.at[r1].set(0))
+        new2 = (v2.assign.at[r2].set(v1.assign[r1]), v2.leader,
+                v2.disk.at[r2].set(0))
+        return scorer(state, v1, old1, new1, v2, old2, new2)
+
+    ps1, rs1, ps2, rs2 = (
+        jax.numpy.asarray([c[i] for c in combos]) for i in range(4)
+    )
+    deltas = jax.jit(jax.vmap(one))(ps1, rs1, ps2, rs2)
+
+    for i, (p1, r1, p2, r2) in enumerate(combos):
+        swapped = _apply_swap_numpy(m, p1, r1, p2, r2, "replica")
+        oracle = np.asarray(evaluate_stack(swapped, CFG, goal_names).costs)
+        got = np.asarray(deltas.cost_vec[i])
+        np.testing.assert_allclose(
+            got, oracle, rtol=2e-4, atol=2e-4,
+            err_msg=f"swap {(p1, r1, p2, r2)} cost vector mismatch",
+        )
+
+
+def test_leadership_swap_scorer_matches_numpy_oracle():
+    """The leadership-swap variant (leader slots rotate, rows unchanged)
+    scores exactly like the from-scratch evaluation too."""
+    m = random_cluster(SPEC)
+    state = _state_for(m)
+    scorer = make_swap_scorer(m, DEFAULT_GOAL_ORDER, CFG)
+    a = np.asarray(m.assignment)
+    lead = np.asarray(m.leader_slot)
+    valid = (a >= 0) & np.asarray(m.partition_valid)[:, None]
+    rng = np.random.default_rng(6)
+    done = 0
+    from ccx.search.state import gather_view
+
+    while done < 4:
+        p1, p2 = rng.integers(0, m.P, 2)
+        if p1 == p2 or not (valid[p1].any() and valid[p2].any()):
+            continue
+        # rotate each leadership to another valid slot
+        slots1 = np.nonzero(valid[p1])[0]
+        slots2 = np.nonzero(valid[p2])[0]
+        if len(slots1) < 2 or len(slots2) < 2:
+            continue
+        r1 = int(slots1[slots1 != lead[p1]][0])
+        r2 = int(slots2[slots2 != lead[p2]][0])
+        v1 = gather_view(state, m, p1)
+        v2 = gather_view(state, m, p2)
+        delta = scorer(
+            state,
+            v1, (v1.assign, v1.leader, v1.disk),
+            (v1.assign, jax.numpy.asarray(r1, jax.numpy.int32), v1.disk),
+            v2, (v2.assign, v2.leader, v2.disk),
+            (v2.assign, jax.numpy.asarray(r2, jax.numpy.int32), v2.disk),
+        )
+        swapped = _apply_swap_numpy(m, int(p1), r1, int(p2), r2, "leadership")
+        oracle = np.asarray(
+            evaluate_stack(swapped, CFG, DEFAULT_GOAL_ORDER).costs
+        )
+        np.testing.assert_allclose(
+            np.asarray(delta.cost_vec), oracle, rtol=2e-4, atol=2e-4
+        )
+        done += 1
+
+
+def test_propose_swap_never_plans_infeasible_rows():
+    """Feasibility contract of the (coupled or uniform) swap plan: an
+    ok=True candidate never creates a duplicate-broker row, never lands a
+    replica on a dead/excluded broker, and preserves both partitions'
+    replica counts."""
+    m = random_cluster(
+        dataclasses.replace(SPEC, n_dead_brokers=2, seed=33)
+    )
+    state = _state_for(m)
+    pp = ProposalParams(
+        p_real=int(np.asarray(m.partition_valid).sum()), b_real=m.B
+    )
+    alive = np.asarray(m.broker_alive & m.broker_valid)
+
+    def one(k):
+        return propose_swap(k, state, m, pp)
+
+    keys = jax.random.split(jax.random.PRNGKey(3), 256)
+    out = jax.jit(jax.vmap(one))(keys)
+    p1s, _, o1s, n1s, p2s, _, o2s, n2s, oks, _ = out
+    for i in np.nonzero(np.asarray(oks))[0]:
+        for old, new in ((o1s, n1s), (o2s, n2s)):
+            row = np.asarray(new[0][i])
+            old_row = np.asarray(old[0][i])
+            live = row[row >= 0]
+            assert len(live) == len(set(live)), "duplicate broker in row"
+            assert (len(live)) == (old_row >= 0).sum(), "replica count changed"
+            moved = row[(row != old_row) & (row >= 0)]
+            assert alive[moved].all(), "swap landed on a dead broker"
+
+
+def test_swap_polish_preserves_counts_and_lex_improves():
+    m = random_cluster(
+        RandomClusterSpec(
+            n_brokers=30, n_racks=5, n_topics=12, n_partitions=1500, seed=41
+        )
+    )
+    res = swap_polish(
+        m, CFG, DEFAULT_GOAL_ORDER,
+        SwapPolishOptions(
+            n_swap_candidates=48, n_lead_candidates=16, max_iters=40, seed=2
+        ),
+    )
+    assert res.n_moves > 0, "coupled polish found no improving swap at all"
+
+    def broker_counts(model):
+        a = np.asarray(model.assignment)
+        v = (a >= 0) & np.asarray(model.partition_valid)[:, None]
+        return np.bincount(a[v], minlength=model.B)
+
+    # count preservation is bit-exact: replica swaps exchange brokers,
+    # leadership transfers move no replica
+    np.testing.assert_array_equal(broker_counts(m), broker_counts(res.model))
+
+    before = np.asarray(res.stack_before.costs)
+    after = np.asarray(res.stack_after.costs)
+    names = list(res.stack_after.names)
+    # hard tier never worsens; vector is lex-no-worse overall
+    from ccx.goals.base import GOAL_REGISTRY
+
+    hard = np.asarray([GOAL_REGISTRY[n].hard for n in names])
+    assert np.all(after[hard] <= before[hard] + 1e-4)
+    for x, y in zip(after, before):
+        if x < y - 1e-4:
+            break
+        assert x <= y + 1e-4, (names, after, before)
+
+    # rack safety: no new rack violations
+    b_rack = dict(res.stack_before.by_name())["RackAwareGoal"][0]
+    a_rack = dict(res.stack_after.by_name())["RackAwareGoal"][0]
+    assert float(a_rack) <= float(b_rack)
+
+    # per-move-kind counters populated and consistent
+    assert sum(res.n_acc_kind) == res.n_moves
+    assert res.n_prop_kind[1] > 0  # replica swaps were proposed
+
+
+def test_broker_pressure_matches_band_math():
+    """broker_pressure's hinge must agree with the usage kernel's band:
+    a broker strictly inside every band has zero strict-hinge pressure
+    (only the mild toward-average term), an out-of-band broker nonzero."""
+    from ccx.model.aggregates import broker_aggregates_jit
+
+    m = random_cluster(SPEC)
+    agg = broker_aggregates_jit(m)
+    press = broker_pressure(m, agg, CFG)
+    alive = np.asarray(m.broker_valid & m.broker_alive)
+    from ccx.common.resources import Resource
+
+    load = np.asarray(agg.broker_load[Resource.NW_OUT])
+    cap = np.asarray(m.broker_capacity[Resource.NW_OUT])
+    util = np.where(cap > 0, load / np.where(cap > 0, cap, 1), 0.0)
+    avg = load[alive].sum() / cap[alive].sum()
+    t = CFG.balance_threshold[int(Resource.NW_OUT)]
+    over_band = alive & (util > avg * t)
+    po = np.asarray(press.usage_over)
+    # every strictly-over-band broker carries pressure above the mild
+    # toward-average term alone
+    assert (po[over_band] > 0).all()
+    assert (po[~alive] == 0).all()
+    assert (np.asarray(press.usage_under)[~alive] == 0).all()
+
+
+def test_swap_polish_budget_is_traced_zero_recompiles():
+    """The swap-polish while_loop budget is DATA: a second run — and a
+    different iteration budget — must pay zero fresh XLA compiles (the
+    compile-cache warmth contract the lean rung's warm re-run relies on)."""
+    from ccx.common import compilestats
+
+    m = random_cluster(SPEC)
+    opts = SwapPolishOptions(
+        n_swap_candidates=32, n_lead_candidates=8, max_iters=5
+    )
+    before = compilestats.snapshot()  # registers listeners pre-compile
+    swap_polish(m, CFG, DEFAULT_GOAL_ORDER, opts)
+    cold = compilestats.delta(before, compilestats.snapshot())
+    # anchor: the cold run must visibly compile or persistent-load, or the
+    # zero-pin below would be vacuous (renamed monitoring events read 0)
+    assert cold["backend_compiles"] + cold["persistent_hits"] > 0, cold
+
+    before = compilestats.snapshot()
+    swap_polish(m, CFG, DEFAULT_GOAL_ORDER, opts)
+    swap_polish(
+        m, CFG, DEFAULT_GOAL_ORDER,
+        dataclasses.replace(opts, max_iters=9, patience=3, trd_guard=False),
+    )
+    warm = compilestats.delta(before, compilestats.snapshot())
+    assert warm["backend_compiles"] == 0, warm
+    assert warm["persistent_misses"] == 0, warm
+
+
+def test_swap_polish_rejects_intra_broker_stacks():
+    m = random_cluster(SPEC)
+    from ccx.goals.stack import INTRA_BROKER_GOAL_ORDER
+
+    with pytest.raises(ValueError):
+        swap_polish(m, CFG, INTRA_BROKER_GOAL_ORDER)

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Usage-coupled swap engine Pareto probe (and TPU prewarm for its programs).
+
+Runs the bench lean-rung pipeline at several swap-engine settings on one
+snapshot and prints a quality/wall table — the measurement behind
+docs/perf-notes.md "Usage-coupled swaps" and the frontier evidence the
+r6 issue asks for (NwOut <= 300 / LeaderReplica <= 400 at lean budget, or
+a measured table proving the budget can't reach it).
+
+In a TPU window this doubles as the swap-program compile probe
+(tools/tpu_campaign.sh): PROBE_SWAP_PREWARM=1 runs ONE floored-budget
+pipeline per program shape (prewarm_options floors the swap-polish budget
+too — the budget is while_loop data, so the floored run compiles the
+exact program every real budget reuses) and exits — a pathological
+compile surfaces here, never inside a timed campaign rung.
+
+Env: PROBE_CONFIG (default B5; B5S = 1/10-scale B5 for fast iteration),
+PROBE_SWAP_SETTINGS comma-list of pre:post swap-polish budgets (default
+"0:0,150:300"), PROBE_COUPLING comma-list of SA coupling settings
+(default 0.5), PROBE_SWAP_PREWARM=1 prewarm-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            ),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    from ccx.goals.base import GoalConfig
+    from ccx.goals.stack import DEFAULT_GOAL_ORDER
+    from ccx.model.fixtures import RandomClusterSpec, bench_spec, random_cluster
+    from ccx.optimizer import OptimizeOptions, optimize, prewarm_options
+
+    name = os.environ.get("PROBE_CONFIG", "B5")
+    if name == "B5S":  # 1/10-scale B5: the fast iteration config
+        m = random_cluster(RandomClusterSpec(
+            n_brokers=100, n_racks=10, n_topics=50, n_partitions=10_000,
+            n_dead_brokers=2, seed=7,
+        ))
+    else:
+        m = random_cluster(bench_spec(name))
+    from bench import build_opts
+
+    _, lean_opts, _ = build_opts("B5", "lean")
+    cfg = GoalConfig()
+    log = lambda s: print(f"[swap-probe] {s}", file=sys.stderr, flush=True)  # noqa: E731
+
+    if os.environ.get("PROBE_SWAP_PREWARM") == "1":
+        t0 = time.monotonic()
+        optimize(m, cfg, DEFAULT_GOAL_ORDER, prewarm_options(lean_opts))
+        log(f"prewarm (incl. swap-polish program) {time.monotonic() - t0:.1f}s")
+        return
+
+    import dataclasses
+
+    budgets = []
+    for tok in os.environ.get("PROBE_SWAP_SETTINGS", "0:0,150:300").split(","):
+        pre, _, post = tok.partition(":")
+        budgets.append((int(pre), int(post or 0)))
+    couplings = [
+        float(x) for x in os.environ.get("PROBE_COUPLING", "0.5").split(",")
+    ]
+    # warm every program once so the table rows are compile-free
+    optimize(
+        m, cfg, DEFAULT_GOAL_ORDER,
+        dataclasses.replace(prewarm_options(lean_opts), swap_polish_iters=1),
+    )
+    rows = []
+    for c in couplings:
+        for pre, post in budgets:
+            opts = dataclasses.replace(
+                lean_opts,
+                anneal=dataclasses.replace(lean_opts.anneal, swap_coupling=c),
+                swap_polish_iters=pre,
+                swap_polish_post_iters=post,
+            )
+            t0 = time.monotonic()
+            res = optimize(m, cfg, DEFAULT_GOAL_ORDER, opts)
+            wall = time.monotonic() - t0
+            a = {n: float(v) for n, (v, _) in res.stack_after.by_name().items()}
+            row = {
+                "coupling": c,
+                "swap_polish_iters": [pre, post],
+                "wall_s": round(wall, 1),
+                "verified": bool(res.verification.ok),
+                "NwOutUsage": a["NetworkOutboundUsageDistributionGoal"],
+                "LeaderReplica": a["LeaderReplicaDistributionGoal"],
+                "LeaderBytesIn": a["LeaderBytesInDistributionGoal"],
+                "CpuUsage": a["CpuUsageDistributionGoal"],
+                "TRD": a["TopicReplicaDistributionGoal"],
+                "moveCounters": res.move_counters,
+                "phases": {k: round(v, 1) for k, v in res.phase_seconds.items()},
+            }
+            rows.append(row)
+            log(json.dumps(row))
+    print(json.dumps({"config": name, "rows": rows}, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,15 @@ L="${1:-tpu_campaign.log}"
   echo "--- sidecar-inclusive T1 at B5 (gRPC hop on the real device) ---"
   PROBE_CPU=0 timeout -k 60 2400 python tools/bench_sidecar.py B5
   echo "sidecar rc=$?"
+  echo "--- swap-engine program prewarm probe at B5 ---"
+  # the usage-coupled swap-polish while_loop is a NEW compiled program
+  # (r6): prove its compile on hardware before any timed rung depends on
+  # it (same rationale as the bench prewarm — a >17-min compile must
+  # surface here with a breadcrumb, not eat a rung). The budget is traced
+  # data, so this floored run compiles the exact program every real
+  # budget reuses.
+  PROBE_SWAP_PREWARM=1 timeout -k 60 1800 python tools/probe_swap.py
+  echo "swap-prewarm rc=$?"
   echo "--- MXU aggregates A/B at B5 ---"
   CCX_MXU_AGGREGATES=0 timeout -k 60 1200 python tools/probe_mxu.py B5
   echo "xla rc=$?"
