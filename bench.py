@@ -303,6 +303,7 @@ def _wire_options(opts) -> dict:
         "polish_patience": opts.polish.patience,
         "polish_batch_moves": opts.polish.batch_moves,
         "polish_swap_fraction": opts.polish.swap_fraction,
+        "polish_chunk_iters": opts.polish.chunk_iters,
         "check_evacuation": opts.check_evacuation,
         "max_repair_rounds": opts.max_repair_rounds,
         "require_hard_zero": opts.require_hard_zero,
@@ -324,6 +325,7 @@ def _wire_options(opts) -> dict:
         "swap_polish_post_iters": opts.swap_polish_post_iters,
         "swap_polish_candidates": opts.swap_polish_candidates,
         "swap_polish_guarded": opts.swap_polish_guarded,
+        "swap_polish_chunk_iters": opts.swap_polish_chunk_iters,
     }
 
 
@@ -907,6 +909,10 @@ def main() -> None:
                 opts.anneal.n_chains,
                 opts.anneal.moves_per_step,
                 opts.polish.n_candidates,
+                # the chunk sizes are the only shape-bearing iteration
+                # budgets (polish/swap-polish chunk engines)
+                opts.polish.chunk_iters,
+                opts.swap_polish_chunk_iters,
                 # the swap-polish program is lean-rung-only while target
                 # shares the SA/polish shapes — without this key the
                 # dedup would skip the rung that compiles it (either
@@ -917,16 +923,26 @@ def main() -> None:
             if shape in shapes:
                 continue
             shapes.add(shape)
-            optimize(
-                m_pw, GoalConfig(), goal_names, prewarm_options(opts),
-                progress_cb=lambda p: enter_phase(
-                    f"prewarm:{name}:{rung}:{p}"
-                ),
-            )
+            # per-shape compile attribution (ccx.common.compilestats): the
+            # BENCH line's prewarm block then reports compile WALL-SECONDS
+            # per shape, not just hit/miss totals — a TPU window sees
+            # exactly where its compile budget went
+            with compilestats.attributed(f"prewarm:{rung}"):
+                optimize(
+                    m_pw, GoalConfig(), goal_names, prewarm_options(opts),
+                    progress_cb=lambda p: enter_phase(
+                        f"prewarm:{name}:{rung}:{p}"
+                    ),
+                )
         pw = {
             "seconds": round(time.monotonic() - t0, 2),
             "shapes": len(shapes),
             **compilestats.delta(cs0, compilestats.snapshot()),
+            "per_shape": {
+                k.split(":", 1)[1]: v
+                for k, v in compilestats.attribution().items()
+                if k.startswith("prewarm:")
+            },
         }
         _state["prewarm"] = pw
         del m_pw

@@ -29,11 +29,23 @@ Listeners are registered once per process, lazily at first ``snapshot()``;
 ``jax.monitoring`` fans events out to every listener, so coexisting
 observers are unaffected. Thread-safe: events may fire from any thread
 (the gRPC sidecar compiles in worker threads), so counters take a lock.
+
+Per-label attribution (round 8): ``attributed(label)`` wraps a code region
+and charges every compile that fires inside it — count AND wall-seconds —
+to ``label``; ``attribution()`` returns the accumulated ledger. This is
+what turns "the prewarm paid 74 s of compile" into "the full-rung SA chunk
+cost 41 s, the polish chunk 9 s, ..." on the BENCH line, so a TPU window
+knows exactly where its compile budget went (and which shape to cut when
+one outgrows the window). Deltas are snapshot-based, so nested or
+concurrent regions double-charge — attribute from ONE thread at a time
+(the bench prewarm loop is sequential by construction).
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 
 _COUNTS = {
     "backend_compiles": 0,
@@ -41,6 +53,7 @@ _COUNTS = {
     "persistent_hits": 0,
     "persistent_misses": 0,
 }
+_ATTR: dict = {}
 _LOCK = threading.Lock()
 _REGISTERED = False
 
@@ -94,3 +107,35 @@ def delta(before: dict, after: dict) -> dict:
     d = {k: after[k] - before[k] for k in _COUNTS}
     d["backend_compile_secs"] = round(d["backend_compile_secs"], 2)
     return d
+
+
+@contextlib.contextmanager
+def attributed(label: str):
+    """Charge every compile fired inside the region to ``label`` (summed
+    across re-entries), plus the region's wall seconds — the per-shape
+    compile ledger the bench prewarm emits (module docstring)."""
+    before = snapshot()
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        d = delta(before, snapshot())
+        wall = time.monotonic() - t0
+        with _LOCK:
+            slot = _ATTR.setdefault(
+                label, {**{k: 0 for k in _COUNTS},
+                        "backend_compile_secs": 0.0, "wall_secs": 0.0}
+            )
+            for k in _COUNTS:
+                slot[k] += d[k]
+            slot["backend_compile_secs"] = round(
+                slot["backend_compile_secs"], 2
+            )
+            slot["wall_secs"] = round(slot["wall_secs"] + wall, 2)
+
+
+def attribution() -> dict:
+    """The per-label compile ledger accumulated so far (label -> counter
+    dict + wall_secs)."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _ATTR.items()}

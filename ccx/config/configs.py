@@ -196,6 +196,23 @@ def analyzer_config_def() -> ConfigDef:
              "Greedy polish candidate moves per iteration.", at_least(1))
     d.define("optimizer.polish.max.iters", Type.INT, 400, Importance.LOW,
              "Greedy polish iteration cap.", at_least(1))
+    d.define("optimizer.polish.chunk.iters", Type.INT, 50, Importance.LOW,
+             "Iterations per jitted chunk program of the host-driven "
+             "greedy-polish descent (the leadership pass and the "
+             "topic-rebalance re-polish share the engine). The ONLY "
+             "shape-bearing polish budget: max-iters/patience stay traced "
+             "data, so every budget shares one compiled chunk per shape "
+             "and the worst-case XLA compile is one small chunk program, "
+             "not the whole iteration loop (the round-4 B5 greedy compile "
+             "ran >17 min on TPU and timed out). 0 = monolithic "
+             "while_loop (bit-exact with the chunked engine; the parity "
+             "reference).", at_least(0))
+    d.define("optimizer.swap.polish.chunk.iters", Type.INT, 50,
+             Importance.LOW,
+             "Iterations per jitted chunk program of the usage-coupled "
+             "swap-polish descent — the optimizer.polish.chunk.iters twin "
+             "(0 = monolithic while_loop; budgets stay traced either "
+             "way).", at_least(0))
     d.define("optimizer.topic.rebalance.rounds", Type.INT, 2, Importance.LOW,
              "Sweep+polish rounds of the targeted TopicReplicaDistribution "
              "stage (each enumerates over-band (topic, broker) cells, "

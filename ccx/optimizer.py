@@ -245,6 +245,10 @@ class OptimizeOptions:
     #: shape), split evenly between replica-swap pairs and leadership
     #: transfers so both invocations share ONE compiled program
     swap_polish_candidates: int = 128
+    #: iterations per jitted swap-polish chunk program (config
+    #: `optimizer.swap.polish.chunk.iters`; SwapPolishOptions.chunk_iters).
+    #: 0 = monolithic while_loop. Budgets stay traced; only this is shape.
+    swap_polish_chunk_iters: int = 50
     #: veto swap-polish candidates that significantly worsen the
     #: TopicReplicaDistribution tier (different-topic swaps move topic
     #: cells; the guard keeps a converged shed's TRD=0 from being traded
@@ -289,13 +293,14 @@ def prewarm_options(opts: OptimizeOptions) -> OptimizeOptions:
     """Floor every traced budget in ``opts`` so one ``optimize()`` call
     compiles the pipeline's full program set at minimal execution cost.
 
-    Iteration budgets are while_loop DATA throughout the pipeline (greedy
+    Iteration budgets are loop-bound DATA throughout the pipeline (greedy
     max_iters/patience, the repair sweep budget, SA step counts via fixed
-    chunking), so a floored run traces and compiles the SAME programs the
+    chunking, the polish/swap-polish chunk engines — only chunk_iters is
+    shape), so a floored run traces and compiles the SAME programs the
     real budgets execute: repair loop, device hot list, chain init, one SA
-    chunk, polish + trd-guarded re-polish (guard is traced), the
-    leadership-only pass (its own program — leadership_only is shape), and
-    diff/verify. bench.py runs this once before the effort ladder — on TPU
+    chunk, one polish chunk + trd-guarded re-polish (guard is traced), one
+    swap-polish chunk, the leadership-only pass (its own program —
+    leadership_only is shape), and diff/verify. bench.py runs this once before the effort ladder — on TPU
     a cold full-budget run risks the driver timeout landing mid-compile
     (the round-4 window lost >17 min to one greedy compile); the prewarm
     pass pays compiles at one-chunk/one-iter execution cost and fills the
@@ -618,6 +623,7 @@ def optimize(
                     ),
                     max_iters=iters,
                     trd_guard=opts.swap_polish_guarded,
+                    chunk_iters=opts.swap_polish_chunk_iters,
                 ),
             )
             _tally(sp)

@@ -1404,6 +1404,22 @@ def _init_chains(
     return jax.vmap(lambda k: state0.replace(key=k))(keys)
 
 
+def drive_chunks(run_one, carry, *, total: int, chunk: int):
+    """Host-side chunk driver shared by the SA chunk runner and both
+    chunked polish engines (ccx.search.greedy): invoke
+    ``run_one(carry, off)`` once per chunk offset, threading the (usually
+    donated) carry through. ``run_one`` returns ``(carry, done)``; a
+    non-None truthy ``done`` ends the loop early — ONE scalar device→host
+    sync per chunk, the early-exit check the monolithic while_loop used to
+    do on device. SA chunks have no early exit and return ``done=None``
+    (no sync at all: the chunks stay queued on the device stream)."""
+    for off in range(0, max(int(total), 0), max(int(chunk), 1)):
+        carry, done = run_one(carry, off)
+        if done is not None and bool(done):
+            break
+    return carry
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -1419,6 +1435,7 @@ def _run_chunk(
     t_offset: jnp.ndarray,
     decay: jnp.ndarray,
     swap_ramp: jnp.ndarray,
+    n_total: jnp.ndarray,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
@@ -1440,16 +1457,25 @@ def _run_chunk(
     f32 values — XLA folds the unchunked path's python-float decay to f32
     exactly as `jnp.float32(decay)` does here. ``swap_ramp`` rides along
     the same way (the p_swap schedule is data, not shape).
+
+    ``n_total`` (traced) is the run's REAL step budget: steps with
+    ``t >= n_total`` are inert (identity ``lax.cond`` branch), so a budget
+    that does not divide ``chunk`` runs its remainder as a zeroed-budget
+    tail inside the SAME compiled program — the round-7 restriction
+    ("pick n_steps % chunk_steps == 0 or pay a second compile") is gone.
     """
     step, _ = _build_step(
         m, goal_names, cfg, opts, p_real, b_real, max_pt, swap_ramp=swap_ramp
     )
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
-        temp = opts.t0 * decay**t
-        ss = jax.vmap(step, in_axes=(0, None, None, None, None))(
-            ss, temp, t, evac, n_evac
-        )
+        def active(s):
+            temp = opts.t0 * decay**t
+            return jax.vmap(step, in_axes=(0, None, None, None, None))(
+                s, temp, t, evac, n_evac
+            )
+
+        ss = jax.lax.cond(t < n_total, active, lambda s: s, ss)
         return ss, None
 
     states, _ = jax.lax.scan(body, states, t_offset + jnp.arange(chunk))
@@ -1554,10 +1580,11 @@ def anneal(
     max_pt = max_partitions_per_topic(m)
     if mesh is None and opts.chunk_steps > 0:
         # Chunked path: one compiled chunk program serves every step budget
-        # (see _run_chunk). A trailing remainder chunk compiles separately,
-        # so pick n_steps % chunk_steps == 0 where compile time matters.
-        # With a mesh this gate falls through to the one-shot scan —
-        # chunk_steps documents the restriction.
+        # (see _run_chunk). The chunk length is ALWAYS chunk_steps — a
+        # budget that does not divide it runs its remainder as a
+        # zeroed-budget tail (t >= n inert) inside the same program, so
+        # arbitrary retunes never pay a second compile. With a mesh this
+        # gate falls through to the one-shot scan.
         n = max(opts.n_steps, 1)
         decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
         # the schedule's MAGNITUDE is traced data (swap_ramp below); only
@@ -1574,15 +1601,21 @@ def anneal(
         evac_j = jnp.asarray(evac)
         n_evac_j = jnp.asarray(n_evac, jnp.int32)
         ramp = jnp.asarray(_swap_ramp_of(opts, n), jnp.float32)
-        for off in range(0, n, opts.chunk_steps):
-            states = _run_chunk(
+        decay_j = jnp.asarray(decay, jnp.float32)
+        n_j = jnp.asarray(n, jnp.int32)
+
+        def run_one(states, off):
+            return _run_chunk(
                 states, m, evac_j, n_evac_j,
-                jnp.asarray(off, jnp.int32), jnp.asarray(decay, jnp.float32),
-                ramp,
+                jnp.asarray(off, jnp.int32), decay_j, ramp, n_j,
                 goal_names=goal_names, cfg=cfg, opts=opts_key,
                 p_real=p_real, b_real=b_real, max_pt=max_pt,
-                chunk=int(min(opts.chunk_steps, n - off)),
-            )
+                chunk=int(opts.chunk_steps),
+            ), None
+
+        states = drive_chunks(
+            run_one, states, total=n, chunk=opts.chunk_steps
+        )
     else:
         states = _run_chains(
             m, keys, jnp.asarray(evac), jnp.asarray(n_evac, jnp.int32),

@@ -15,14 +15,39 @@ directly and serves as
   leadership) without breaking higher-priority goals, mirroring the
   reference's sequential re-optimization.
 
-The whole loop runs ON DEVICE as one jitted ``lax.while_loop``: each
-iteration vmaps ``n_candidates`` proposals, scores each in O(R) via the
-incremental move scorer (ccx.search.state — no per-candidate aggregate
-copies), selects the lexicographic argmin on device, applies it, and
+The whole loop runs ON DEVICE: each iteration vmaps ``n_candidates``
+proposals, scores each in O(R) via the incremental move scorer
+(ccx.search.state — no per-candidate aggregate copies), selects the
+lexicographically-best DISJOINT subset on device, applies it, and
 early-exits after ``patience`` consecutive iterations with no improving
 candidate. Round 1's host-driven loop paid one device round-trip + a
 ~0.5 GB/batch aggregate materialization *per iteration* (~3.5 s/iter at B5
 scale); this version's per-iteration cost is a few MB of [B]-level traffic.
+
+Chunked descent engine (round 8): the iteration loop runs EITHER as one
+monolithic ``lax.while_loop`` program (``chunk_iters=0`` — the round-4
+shape whose B5 compile ran >17 min on TPU v5e and timed out) or, by
+default, as a HOST-DRIVEN sequence of small jitted chunk programs: a
+``fori_loop`` of ``chunk_iters`` iterations whose body goes inert (an
+identity ``lax.cond`` branch) once the traced ``max_iters``/``patience``
+exit fires — exactly the zeroed-budget trick the traced budgets already
+use, so chunked and monolithic descents are bit-exact by construction
+(the iteration counter only advances on live iterations, so the RNG
+``fold_in`` stream is identical; pinned by tests/test_polish_chunked.py).
+The host driver (``annealer.drive_chunks`` — shared with the SA chunk
+runner) carries DONATED state between chunks and pays one scalar
+device→host sync per chunk to poll the early-exit flag. Budgets stay
+while_loop data; only ``chunk_iters`` is program shape.
+
+Both entry points (uniform/leadership polish and the usage-coupled
+``swap_polish``) build their per-iteration bodies from ONE shared
+candidate representation — pair candidates ``(a-side edit, b-side edit)``
+with an inert ``-1`` b side for single moves — so the disjoint selection
+(`_select_disjoint`), exact batch composition (`_compose_pairs`) and
+placement apply (`_apply_pairs`) fori_loop machinery is written once.
+That unification also deleted the uniform loop's separate best-swap apply
+path (an entire second ``_placement_updates`` arm under a ``lax.cond``):
+swap candidates now compete inside the same disjoint batch as singles.
 """
 
 from __future__ import annotations
@@ -42,6 +67,7 @@ from ccx.search.annealer import (
     RACK_TARGET_GOALS,
     ProposalParams,
     allows_inter_broker,
+    drive_chunks,
     goal_tols,
     hot_partition_list,
     lead_swap_share,
@@ -52,8 +78,8 @@ from ccx.goals import topic_terms as tt
 from ccx.goals.base import GOAL_REGISTRY
 from ccx.search.state import (
     SearchState,
+    SwapDelta,
     _placement_updates,
-    apply_swap,
     broker_pressure,
     bump_kind_counters,
     gather_views,
@@ -81,17 +107,23 @@ class GreedyOptions:
     p_disk: float = 0.0
     p_biased_dest: float = 0.5
     p_evac: float = 0.3
-    #: fraction of candidates proposed as two-partition REPLICA_SWAPs —
-    #: swaps preserve replica counts, reaching load-balance states single
-    #: relocations cannot (ref ActionType, SURVEY.md C20); forced to 0 for
-    #: intra-broker stacks
-    swap_fraction: float = 0.25
-    #: apply up to this many NON-CONFLICTING improving single moves per
-    #: iteration (disjoint partitions, topics and touched-broker sets, each
-    #: hard-safe and lex-improving vs the iteration's base state — the
-    #: composition is then exactly additive and itself lex-improving).
-    #: 1 restores classic best-move hill climbing; >1 is what lets the
-    #: polish clean thousands of residuals at B5 scale within max_iters.
+    #: fraction of candidates proposed as two-partition REPLICA_SWAPs
+    #: (ref ActionType, SURVEY.md C20). 0 (default since round 8): the
+    #: count-preserving move class belongs to the DEDICATED usage-coupled
+    #: ``swap_polish`` stage now — uniform swap draws almost never find
+    #: the right pairs at scale (the r6 finding), and the branch measured
+    #: strictly worse at 1/10-scale B5: equal-or-worse quality on every
+    #: tier above TRD at 2.2x the target-rung polish wall (44 s vs 20 s),
+    #: plus ~40% of the polish program's XLA compile. >0 restores the
+    #: round-7 mixed-proposal loop for ablation.
+    swap_fraction: float = 0.0
+    #: apply up to this many NON-CONFLICTING improving moves per iteration
+    #: (disjoint partitions, topics and touched-broker sets, each hard-safe
+    #: and lex-improving vs the iteration's base state — the composition is
+    #: then exactly additive and itself lex-improving). Swap candidates
+    #: compete inside the same disjoint batch. 1 restores classic best-move
+    #: hill climbing; >1 is what lets the polish clean thousands of
+    #: residuals at B5 scale within max_iters.
     batch_moves: int = 16
     #: restrict EVERY proposal to leadership movements: single proposals are
     #: all LEADERSHIP_MOVEMENT (p_leadership forced to 1) and swap proposals
@@ -100,6 +132,13 @@ class GreedyOptions:
     #: pipeline (ref: PreferredLeaderElectionGoal runs last in the goal
     #: order, SURVEY.md section 2.3) and the demote fast path.
     leadership_only: bool = False
+    #: iterations per jitted chunk program of the host-driven descent
+    #: (config ``optimizer.polish.chunk.iters``). The ONLY budget knob that
+    #: is program shape: ``max_iters``/``patience`` stay traced data, so
+    #: every iteration budget shares one compiled chunk per shape. 0 runs
+    #: the monolithic ``lax.while_loop`` program instead (the parity
+    #: reference — bit-exact with the chunked engine by construction).
+    chunk_iters: int = 50
     seed: int = 0
 
 
@@ -141,6 +180,403 @@ def _lex_argmin(costs: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(alive)
 
 
+# ==========================================================================
+# Shared disjoint-batch machinery. Every candidate is a PAIR of partition
+# edits (old/new replica rows, leader slot, disk row per side); single
+# moves carry an inert b side (all rows -1 — scatter_partition and
+# _placement_updates drop negative rows, the same inert-write trick the
+# traced budgets use). One selection loop, one composition loop and one
+# apply site serve the uniform polish, the leadership pass and the
+# usage-coupled swap polish — the four near-duplicate fori_loops the
+# round-7 code carried are gone, and so is the uniform loop's separate
+# best-swap apply path.
+# ==========================================================================
+
+
+def _select_disjoint(cost_vec, better, bmask, ta, tb, dual, n_batch, T):
+    """Greedily take the lexicographically best remaining candidate whose
+    {partitions, topics, touched brokers} are disjoint from everything
+    already taken. Disjointness makes every per-broker/per-topic/
+    per-partition goal term exactly additive, so the composed batch is
+    itself hard-safe and lex-improving (its net change at the
+    highest-priority changed tier is a sum of improvements); the exact
+    recompute in `_compose_pairs` guards the non-decomposable couplings.
+
+    ``ta``/``tb`` are the (clipped) topics of the two sides; ``dual[i]``
+    marks candidates whose b side is real (pair candidates) — None when
+    the caller has no pair candidates at all (the b-side bookkeeping is
+    then statically absent from the program). Returns ``(sel_idx
+    int32[n_batch], n_sel)`` with N as the not-taken sentinel; slot 0
+    always holds the lex-best improving candidate — the single-move
+    fallback checkpoint."""
+    N, B = bmask.shape
+
+    def select(k, carry):
+        alive, used_b, used_t, sel, count = carry
+        conf = jnp.any(bmask & used_b[None, :], axis=1) | used_t[ta]
+        if dual is not None:
+            conf = conf | (dual & used_t[tb])
+        ok = alive & ~conf
+        any_ok = jnp.any(ok)
+        idx = _lex_argmin(cost_vec, ok)
+        sel = sel.at[k].set(jnp.where(any_ok, idx, N))
+        used_b = used_b | jnp.where(any_ok, bmask[idx], False)
+        used_t = used_t.at[ta[idx]].max(any_ok)
+        if dual is not None:
+            used_t = used_t.at[tb[idx]].max(any_ok & dual[idx])
+        alive = alive & (jnp.arange(N) != idx)
+        return alive, used_b, used_t, sel, count + any_ok.astype(jnp.int32)
+
+    sel0 = jnp.full((n_batch,), N, jnp.int32)
+    _, _, _, sel_idx, n_sel = jax.lax.fori_loop(
+        0, n_batch, select,
+        (better, jnp.zeros(B, bool), jnp.zeros(T, bool), sel0,
+         jnp.asarray(0, jnp.int32)),
+    )
+    return sel_idx, n_sel
+
+
+def _broker_masks(touched: jnp.ndarray, N: int, B: int) -> jnp.ndarray:
+    """bool[N, B] — which brokers each candidate touches (negative rows
+    dropped)."""
+    bmask = jnp.zeros((N, B), bool)
+    return jax.vmap(lambda z, bb, v: z.at[bb].set(v, mode="drop"))(
+        bmask,
+        jnp.where(touched >= 0, jnp.clip(touched, 0, B - 1), B),
+        touched >= 0,
+    )
+
+
+def _compose_pairs(
+    ss, m, va, vb, olda, newa, oldb, newb, deltas, sel_idx, n_sel, n_batch,
+    vector_fn, trd_norm, guard_on, guard_cols, has_pairs,
+):
+    """Exact composition of the selected disjoint pair-candidates.
+
+    Disjointness makes sum-decomposable goal terms exactly additive, but
+    the leader-evenness and trd-normalizer couplings are not
+    sum-decomposable, and per-candidate vetoes are tolerance-filtered — a
+    composed batch can net-regress a tier even though every member
+    improved vs base. The composed vector is recomputed exactly here; when
+    it is not lex-better than the iteration base (or trips the traced TRD
+    guard), fall back to the best single candidate (slot 0), which IS
+    exactly lex-improving — its vector is the scorer's exact candidate
+    vector, so the fallback needs no second ``vector_fn`` instantiation.
+    ``has_pairs`` (static) elides the b-side scatters when the caller has
+    no pair candidates. Returns ``(accumulators, cost_vec, batch_ok,
+    taken, safe)``."""
+    N = deltas.cost_vec.shape[0]
+    taken = sel_idx < N
+    safe = jnp.clip(sel_idx, 0, N - 1)
+
+    def acc(k, carry):
+        agg, part, mtl, trd, totals = carry
+        i = safe[k]
+        w = taken[k].astype(jnp.float32)
+        wi = taken[k].astype(jnp.int32)
+        va_i = jax.tree.map(lambda x: x[i], va)
+        o1 = tuple(x[i] for x in olda)
+        n1 = tuple(x[i] for x in newa)
+        agg = scatter_partition(agg, m, va_i, *o1, -w, -wi)
+        agg = scatter_partition(agg, m, va_i, *n1, w, wi)
+        totals = totals.at[va_i.topic].add(w * deltas.d_total[i])
+        if has_pairs:
+            vb_i = jax.tree.map(lambda x: x[i], vb)
+            o2 = tuple(x[i] for x in oldb)
+            n2 = tuple(x[i] for x in newb)
+            agg = scatter_partition(agg, m, vb_i, *o2, -w, -wi)
+            agg = scatter_partition(agg, m, vb_i, *n2, w, wi)
+            totals = totals.at[vb_i.topic].add(w * deltas.d_total2[i])
+        part = part + w * (deltas.part_sums[i] - ss.part_sums)
+        mtl = mtl + w * deltas.d_mtl[i]
+        trd = trd + w * deltas.d_trd[i]
+        return agg, part, mtl, trd, totals
+
+    # Slot 0 always holds the lex-best candidate (_select_disjoint), so the
+    # state after acc(0, .) doubles as the single-move fallback checkpoint.
+    first = acc(0, (ss.agg, ss.part_sums, ss.mtl_sum, ss.trd_sum,
+                    ss.topic_totals))
+    full = jax.lax.fori_loop(1, n_batch, acc, first)
+
+    cost_full = vector_fn(*full[:4], trd_norm(full[4]))
+    d_full = cost_full - ss.cost_vec
+    # members are individually guard-safe but the trd normalizer coupling
+    # is not sum-decomposable — re-check the composition
+    full_guard_up = guard_on & jnp.any(
+        (jnp.abs(d_full) > goal_tols(ss.cost_vec))
+        & guard_cols
+        & (d_full > 0)
+    )
+    batch_ok = (n_sel <= 1) | (
+        _lex_lt_batch(cost_full[None, :], ss.cost_vec)[0] & ~full_guard_up
+    )
+    sel = jax.tree.map(lambda x, y: jnp.where(batch_ok, x, y), full, first)
+    # fallback vector: the lex-best candidate's FULL cost vector from the
+    # incremental scorer (exactly what the acceptance test compared) — the
+    # same carried-incremental-vector contract the SA step runs on
+    cost_first = jnp.where(taken[0], deltas.cost_vec[safe[0]], ss.cost_vec)
+    cost_vec = jnp.where(batch_ok, cost_full, cost_first)
+    return sel, cost_vec, batch_ok, taken, safe
+
+
+def _apply_pairs(
+    ss, group, pa, pb, va, vb, newa, newb, acc_sel, cost_vec, batch_ok,
+    taken, safe, n_sel, dual, any_better,
+):
+    """Write the composed accumulators + the selected placements back into
+    the search state. ``dual=None`` (no pair candidates) statically elides
+    the b-side placement writes. Returns ``(state, n_applied,
+    write_a)``."""
+    agg, part, mtl, trd, totals = acc_sel
+    n_batch = taken.shape[0]
+    n_applied = jnp.where(
+        any_better, jnp.where(batch_ok, n_sel, jnp.minimum(n_sel, 1)), 0
+    )
+    write_a = taken & (batch_ok | (jnp.arange(n_batch) == 0)) & any_better
+    if dual is None:
+        write = write_a
+        mirror = write_a & va.pvalid[safe]
+        ps = gps = pa[safe]
+        ts = va.topic[safe]
+        rows, leads, disks = (x[safe] for x in newa)
+    else:
+        write_b = write_a & dual[safe]
+        write = jnp.concatenate([write_a, write_b])
+        mirror = jnp.concatenate(
+            [write_a & va.pvalid[safe], write_b & vb.pvalid[safe]]
+        )
+        ps = gps = jnp.concatenate([pa[safe], pb[safe]])
+        ts = jnp.concatenate([va.topic[safe], vb.topic[safe]])
+        rows = jnp.concatenate([newa[0][safe], newb[0][safe]])
+        leads = jnp.concatenate([newa[1][safe], newb[1][safe]])
+        disks = jnp.concatenate([newa[2][safe], newb[2][safe]])
+    ss = ss.replace(
+        agg=agg,
+        part_sums=part,
+        mtl_sum=mtl,
+        trd_sum=trd,
+        topic_totals=totals,
+        cost_vec=cost_vec,
+        n_accepted=ss.n_accepted + n_applied,
+        **_placement_updates(
+            ss, group, write=write, ps=ps, mirror=mirror, global_ps=gps,
+            ts=ts, rows=rows, leads=leads, disks=disks,
+        ),
+    )
+    return ss, n_applied, write_a
+
+
+def _chunk_step(cond, body):
+    """fori_loop body for a chunk program: run the descent iteration while
+    the traced exit condition holds, identity afterwards — the inert-write
+    trick that keeps chunked and monolithic descents bit-exact (inert
+    iterations advance nothing, including the RNG iteration counter)."""
+
+    def step(_, carry):
+        return jax.lax.cond(cond(carry), body, lambda c: c, carry)
+
+    return step
+
+
+def _run_chunk_body(cond, body, chunk_iters, state, it, stale, moves):
+    """Shared chunk-program tail: ``chunk_iters`` conditional iterations
+    plus the early-exit flag the host polls. Only the STATE is donated by
+    the callers — the scalar counters ride as separate (tiny, non-donated)
+    operands because identical zero scalars can share one device buffer,
+    and donating the same buffer twice is an XLA error."""
+    state, it, stale, moves = jax.lax.fori_loop(
+        0, chunk_iters, _chunk_step(cond, body), (state, it, stale, moves)
+    )
+    return state, it, stale, moves, ~cond((state, it, stale, moves))
+
+
+def _unalias_placement(state: SearchState) -> SearchState:
+    """Copy the placement buffers ``init_search_state`` shares with the
+    source model. The chunk programs DONATE their carry (the buffers are
+    reused in place across chunks); without this copy the first donation
+    would invalidate the caller's model arrays too."""
+    return state.replace(
+        assignment=jnp.array(state.assignment, copy=True),
+        leader_slot=jnp.array(state.leader_slot, copy=True),
+        replica_disk=jnp.array(state.replica_disk, copy=True),
+    )
+
+
+# ==========================================================================
+# Uniform / leadership polish
+# ==========================================================================
+
+
+def _make_greedy_iter(
+    m, evac, n_evac, key0, max_iters, patience, guard_on,
+    *, goal_names, cfg, pp, opts, max_pt,
+):
+    """Build the (cond, body) pair of one polish iteration over the carry
+    ``(state, it, stale, moves)`` — the single source both the monolithic
+    while_loop and the chunked fori_loop drivers trace, so the two engines
+    cannot drift. max_iters/patience arrive as traced scalars (and are
+    ZEROED in the static ``opts`` key by the caller): iteration budgets are
+    loop-bound DATA, not program shape, so lean polish (400 iters) and full
+    polish (1600) share ONE compiled program — a B5-scale greedy compile is
+    >10 min on TPU v5e."""
+    group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
+    scorer = make_move_scorer(m, goal_names, cfg)
+    vector_fn = make_cost_vector_fn(m, goal_names, cfg)
+    hard_arr = jnp.asarray(tuple(GOAL_REGISTRY[n].hard for n in goal_names))
+    # trd-guard column mask: with guard_on (a traced scalar, so guarded and
+    # unguarded polish share ONE compiled program) candidates that
+    # significantly RAISE the TopicReplicaDistribution tier are vetoed like
+    # hard regressions. TRD sits below the usage tiers in lex priority, so
+    # an unguarded polish legally trades freshly-shed topic cells back for
+    # usage cells — the round-4 shed/re-polish ratchet's loss mechanism.
+    guard_cols = jnp.asarray(
+        tuple(n == "TopicReplicaDistributionGoal" for n in goal_names)
+    )
+    n_swap = int(opts.n_candidates * opts.swap_fraction) if pp.p_swap > 0 else 0
+    n_single = max(opts.n_candidates - n_swap, 1)
+    N = n_single + n_swap
+    n_batch = max(min(opts.batch_moves, n_single), 1)
+    swap_scorer = make_swap_scorer(m, goal_names, cfg) if n_swap else None
+    B, T = m.B, m.num_topics
+    trd_norm = lambda totals: tt.trd_normalizer(m, totals)  # noqa: E731
+    # [N] static: b side is real (a pair candidate); None when the program
+    # carries no pair candidates at all (the b-side machinery is then
+    # statically absent — the no-swap polish program is ~40% cheaper to
+    # compile and to run per iteration)
+    dual = (jnp.arange(N) >= n_single) if n_swap else None
+
+    def cond(carry):
+        _, it, stale, _ = carry
+        return (it < max_iters) & (stale < patience)
+
+    def body(carry):
+        ss, it, stale, moves = carry
+        keys = jax.random.split(
+            jax.random.fold_in(key0, it), n_single + max(n_swap, 1)
+        )
+
+        def one(k):
+            p, view, old, new, feasible = propose_move(k, ss, m, pp, evac, n_evac)
+            delta = scorer(ss, view, old, new)
+            return p, view, old, new, feasible, delta
+
+        ps, views, olds, news, feas, sdelta = jax.vmap(one)(keys[:n_single])
+
+        if n_swap:
+            inert = tuple(jnp.full_like(x, -1) for x in olds)
+            def one_swap(k):
+                p1, v1, o1, n1, p2, v2, o2, n2, ok, is_lead = propose_swap(
+                    k, ss, m, pp
+                )
+                delta = swap_scorer(ss, v1, o1, n1, v2, o2, n2)
+                return p1, v1, o1, n1, p2, v2, o2, n2, ok, is_lead, delta
+
+            (p1s, v1, o1, n1_, p2s, v2, o2, n2_, sw_ok, sw_lead, wdelta) = (
+                jax.vmap(one_swap)(keys[n_single:])
+            )
+            cat = lambda a, b: jnp.concatenate([a, b])  # noqa: E731
+            pa, pb = cat(ps, p1s), cat(ps, p2s)
+            va = jax.tree.map(cat, views, v1)
+            vb = jax.tree.map(cat, views, v2)
+            olda = tuple(cat(a, b) for a, b in zip(olds, o1))
+            newa = tuple(cat(a, b) for a, b in zip(news, n1_))
+            oldb = tuple(cat(a, b) for a, b in zip(inert, o2))
+            newb = tuple(cat(a, b) for a, b in zip(inert, n2_))
+            feas_all = cat(feas, sw_ok)
+            deltas = SwapDelta(
+                cost_vec=cat(sdelta.cost_vec, wdelta.cost_vec),
+                part_sums=cat(sdelta.part_sums, wdelta.part_sums),
+                d_mtl=cat(sdelta.d_mtl, wdelta.d_mtl),
+                d_trd=cat(sdelta.d_trd, wdelta.d_trd),
+                d_total=cat(sdelta.d_total, wdelta.d_total),
+                d_total2=cat(
+                    jnp.zeros(n_single, sdelta.d_total.dtype), wdelta.d_total2
+                ),
+            )
+            lead_mask = cat(jnp.zeros(n_single, bool), sw_lead)
+        else:
+            # singles only: MoveDelta already carries every field the
+            # pair composition reads when has_pairs is statically False
+            pa = pb = ps
+            va = vb = views
+            olda, newa = olds, news
+            oldb = newb = None
+            feas_all = feas
+            deltas = sdelta
+            lead_mask = None
+
+        # hard-safety veto on top of lex improvement: lex_lt alone would let
+        # a move improve a high tier while pushing a LOWER-priority hard
+        # goal over (the reference's requirements checks forbid that), and
+        # batch additivity needs every member's hard delta <= 0
+        d_all = deltas.cost_vec - ss.cost_vec[None, :]
+        sig_all = jnp.abs(d_all) > goal_tols(ss.cost_vec)[None, :]
+        hard_up = jnp.any(sig_all & hard_arr[None, :] & (d_all > 0), axis=1)
+        guard_up = guard_on & jnp.any(
+            sig_all & guard_cols[None, :] & (d_all > 0), axis=1
+        )
+        better = (
+            feas_all
+            & ~hard_up
+            & ~guard_up
+            & _lex_lt_batch(deltas.cost_vec, ss.cost_vec)
+        )
+        any_better = jnp.any(better)
+
+        a_rows = [olda[0], newa[0]]
+        if n_swap:
+            a_rows += [oldb[0], newb[0]]
+        bmask = _broker_masks(jnp.concatenate(a_rows, axis=1), N, B)
+        ta = jnp.clip(va.topic, 0, T - 1)
+        tb = jnp.clip(vb.topic, 0, T - 1) if n_swap else None
+        sel_idx, n_sel = _select_disjoint(
+            deltas.cost_vec, better, bmask, ta, tb, dual, n_batch, T
+        )
+        acc_sel, cost_vec, batch_ok, taken, safe = _compose_pairs(
+            ss, m, va, vb, olda, newa, oldb, newb, deltas, sel_idx, n_sel,
+            n_batch, vector_fn, trd_norm, guard_on, guard_cols,
+            has_pairs=bool(n_swap),
+        )
+        ss, n_applied, write_a = _apply_pairs(
+            ss, group, pa, pb, va, vb, newa, newb, acc_sel, cost_vec,
+            batch_ok, taken, safe, n_sel, dual, any_better,
+        )
+
+        # per-move-kind observability: the iteration proposed n_single
+        # singles + n_swap swaps (split by variant); acceptances attribute
+        # by the selected candidates' kinds
+        if n_swap:
+            n_lead_prop = jnp.sum(lead_mask.astype(jnp.int32))
+            acc0 = jnp.sum((write_a & ~dual[safe]).astype(jnp.int32))
+            acc1 = jnp.sum(
+                (write_a & dual[safe] & ~lead_mask[safe]).astype(jnp.int32)
+            )
+            acc2 = jnp.sum(
+                (write_a & dual[safe] & lead_mask[safe]).astype(jnp.int32)
+            )
+            ss = bump_kind_counters(
+                ss,
+                jnp.arange(3),
+                jnp.stack(
+                    [
+                        jnp.asarray(n_single, jnp.int32),
+                        jnp.asarray(n_swap, jnp.int32) - n_lead_prop,
+                        n_lead_prop,
+                    ]
+                ),
+                jnp.stack([acc0, acc1, acc2]),
+            )
+        else:
+            ss = bump_kind_counters(
+                ss, 0, n_single, jnp.sum(write_a.astype(jnp.int32))
+            )
+        it = it + 1
+        stale = jnp.where(any_better, 0, stale + 1)
+        return ss, it, stale, moves + n_applied
+
+    return cond, body
+
+
 @functools.partial(
     jax.jit, static_argnames=("goal_names", "cfg", "pp", "opts", "max_pt")
 )
@@ -160,277 +596,52 @@ def _greedy_loop(
     opts: GreedyOptions,
     max_pt: int,
 ):
-    # max_iters/patience arrive as traced scalars (and are ZEROED in the
-    # static `opts` key by the caller): iteration budgets are while_loop
-    # bound data, not program shape, so lean polish (400 iters) and full
-    # polish (1600) share ONE compiled program — a B5-scale greedy compile
-    # is >10 min on TPU v5e.
-    group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
-    scorer = make_move_scorer(m, goal_names, cfg)
-    vector_fn = make_cost_vector_fn(m, goal_names, cfg)
-    hard_arr = jnp.asarray(tuple(GOAL_REGISTRY[n].hard for n in goal_names))
-    # trd-guard column mask: with guard_on (a traced scalar, so guarded and
-    # unguarded polish share ONE compiled program) candidates that
-    # significantly RAISE the TopicReplicaDistribution tier are vetoed like
-    # hard regressions. TRD sits below the usage tiers in lex priority, so
-    # an unguarded polish legally trades freshly-shed topic cells back for
-    # usage cells — the round-4 shed/re-polish ratchet's loss mechanism.
-    guard_cols = jnp.asarray(
-        tuple(n == "TopicReplicaDistributionGoal" for n in goal_names)
+    """Monolithic while_loop engine (``chunk_iters=0``) — the parity
+    reference the chunked engine is pinned bit-exact against."""
+    cond, body = _make_greedy_iter(
+        m, evac, n_evac, key0, max_iters, patience, guard_on,
+        goal_names=goal_names, cfg=cfg, pp=pp, opts=opts, max_pt=max_pt,
     )
-    n_swap = int(opts.n_candidates * opts.swap_fraction) if pp.p_swap > 0 else 0
-    n_single = max(opts.n_candidates - n_swap, 1)
-    n_batch = max(min(opts.batch_moves, n_single), 1)
-    swap_scorer = make_swap_scorer(m, goal_names, cfg) if n_swap else None
-    B, T = m.B, m.num_topics
-
-    def cond(carry):
-        _, it, stale, _ = carry
-        return (it < max_iters) & (stale < patience)
-
-    def body(carry):
-        ss, it, stale, moves = carry
-        keys = jax.random.split(
-            jax.random.fold_in(key0, it), n_single + max(n_swap, 1)
-        )
-
-        def one(k):
-            p, view, old, new, feasible = propose_move(k, ss, m, pp, evac, n_evac)
-            delta = scorer(ss, view, old, new)
-            return p, view, old, new, feasible, delta
-
-        ps, views, olds, news, feas, deltas = jax.vmap(one)(keys[:n_single])
-        # hard-safety veto on top of lex improvement: lex_lt alone would let
-        # a move improve a high tier while pushing a LOWER-priority hard
-        # goal over (the reference's requirements checks forbid that), and
-        # batch additivity needs every member's hard delta <= 0
-        d_all = deltas.cost_vec - ss.cost_vec[None, :]
-        sig_all = jnp.abs(d_all) > goal_tols(ss.cost_vec)[None, :]
-        hard_up = jnp.any(sig_all & hard_arr[None, :] & (d_all > 0), axis=1)
-        guard_up = guard_on & jnp.any(
-            sig_all & guard_cols[None, :] & (d_all > 0), axis=1
-        )
-        better = (
-            feas
-            & ~hard_up
-            & ~guard_up
-            & _lex_lt_batch(deltas.cost_vec, ss.cost_vec)
-        )
-        any_single = jnp.any(better)
-        best = _lex_argmin(deltas.cost_vec, better)
-        pick = lambda tree: jax.tree.map(lambda a: a[best], tree)  # noqa: E731
-
-        # ---- batched selection: greedily take the lexicographically best
-        # remaining candidate whose {partitions, topic, touched brokers} are
-        # disjoint from everything already taken. Disjointness makes every
-        # per-broker/per-topic/per-partition goal term exactly additive, so
-        # the composed batch is itself hard-safe and lex-improving (its net
-        # change at the highest-priority changed tier is a sum of
-        # improvements).
-        old_rows, new_rows = olds[0], news[0]           # [N, R]
-        touched = jnp.concatenate([old_rows, new_rows], axis=1)   # [N, 2R]
-        tb = jnp.clip(touched, 0, B - 1)
-        bmask = jnp.zeros((n_single, B), bool)
-        bmask = jax.vmap(lambda z, bb, v: z.at[bb].set(v, mode="drop"))(
-            bmask, jnp.where(touched >= 0, tb, B), touched >= 0
-        )
-        cand_t = views.topic                             # [N]
-
-        def select(k, carry):
-            alive, used_b, used_t, sel, count = carry
-            conf = (
-                jnp.any(bmask & used_b[None, :], axis=1)
-                | used_t[jnp.clip(cand_t, 0, T - 1)]
-            )
-            ok = alive & ~conf
-            any_ok = jnp.any(ok)
-            idx = _lex_argmin(deltas.cost_vec, ok)
-            take = any_ok
-            sel = sel.at[k].set(jnp.where(take, idx, n_single))
-            used_b = used_b | jnp.where(take, bmask[idx], False)
-            used_t = used_t.at[jnp.clip(cand_t[idx], 0, T - 1)].max(take)
-            alive = alive & (jnp.arange(n_single) != idx)
-            return alive, used_b, used_t, sel, count + take.astype(jnp.int32)
-
-        sel0 = jnp.full((n_batch,), n_single, jnp.int32)
-        _, _, _, sel_idx, n_sel = jax.lax.fori_loop(
-            0, n_batch, select,
-            (better, jnp.zeros(B, bool), jnp.zeros(T, bool), sel0,
-             jnp.asarray(0, jnp.int32)),
-        )
-
-        def apply_batch(s):
-            taken = sel_idx < n_single                   # [K]
-            safe = jnp.clip(sel_idx, 0, n_single - 1)
-
-            def acc(k, carry):
-                agg, part, mtl, trd, totals = carry
-                i = safe[k]
-                w = taken[k].astype(jnp.float32)
-                wi = taken[k].astype(jnp.int32)
-                view_i = jax.tree.map(lambda a: a[i], views)
-                old_i = tuple(x[i] for x in olds)
-                new_i = tuple(x[i] for x in news)
-                agg = scatter_partition(agg, m, view_i, *old_i, -w, -wi)
-                agg = scatter_partition(agg, m, view_i, *new_i, w, wi)
-                part = part + w * (deltas.part_sums[i] - s.part_sums)
-                mtl = mtl + w * deltas.d_mtl[i]
-                trd = trd + w * deltas.d_trd[i]
-                totals = totals.at[view_i.topic].add(w * deltas.d_total[i])
-                return agg, part, mtl, trd, totals
-
-            # Slot 0 always holds the lex-best candidate (_lex_argmin over
-            # the improving set), so the state after acc(0, .) doubles as the
-            # single-move fallback checkpoint.
-            first = acc(0, (s.agg, s.part_sums, s.mtl_sum, s.trd_sum,
-                            s.topic_totals))
-            full = jax.lax.fori_loop(1, n_batch, acc, first)
-
-            def costs_of(c):
-                agg_c, part_c, mtl_c, trd_c, totals_c = c
-                return vector_fn(
-                    agg_c, part_c, mtl_c, trd_c, tt.trd_normalizer(m, totals_c)
-                )
-
-            cost_full = costs_of(full)
-            # Disjointness makes sum-decomposable goal terms exactly
-            # additive, but the leader-evenness and trd-normalizer couplings
-            # are not sum-decomposable, and per-candidate vetoes are
-            # tolerance-filtered — a composed batch can net-regress a tier
-            # even though every member improved vs base. The composed vector
-            # is recomputed exactly here; when it is not lex-better than the
-            # iteration base, fall back to the best single move, which IS
-            # exactly lex-improving.
-            d_full = cost_full - s.cost_vec
-            full_guard_up = guard_on & jnp.any(
-                (jnp.abs(d_full) > goal_tols(s.cost_vec))
-                & guard_cols
-                & (d_full > 0)
-            )
-            batch_ok = (n_sel <= 1) | (
-                _lex_lt_batch(cost_full[None, :], s.cost_vec)[0]
-                # members are individually guard-safe but the trd normalizer
-                # coupling is not sum-decomposable — re-check the composition
-                & ~full_guard_up
-            )
-            agg, part, mtl, trd, totals = jax.tree.map(
-                lambda a, b: jnp.where(batch_ok, a, b), full, first
-            )
-            cost_vec = jnp.where(batch_ok, cost_full, costs_of(first))
-            n_applied = jnp.where(batch_ok, n_sel, jnp.minimum(n_sel, 1))
-            write = taken & (batch_ok | (jnp.arange(n_batch) == 0))
-            rows_k = new_rows[safe]
-            leads_k = news[1][safe]
-            disks_k = news[2][safe]
-            return s.replace(
-                agg=agg,
-                part_sums=part,
-                mtl_sum=mtl,
-                trd_sum=trd,
-                topic_totals=totals,
-                cost_vec=cost_vec,
-                n_accepted=s.n_accepted + n_applied,
-                **_placement_updates(
-                    s,
-                    group,
-                    write=write,
-                    ps=ps[safe],
-                    mirror=write & views.pvalid[safe],
-                    global_ps=ps[safe],
-                    ts=cand_t[safe],
-                    rows=rows_k,
-                    leads=leads_k,
-                    disks=disks_k,
-                ),
-            )
-
-
-
-        if n_swap:
-            def one_swap(k):
-                p1, v1, o1, n1, p2, v2, o2, n2, ok, is_lead = propose_swap(
-                    k, ss, m, pp
-                )
-                delta = swap_scorer(ss, v1, o1, n1, v2, o2, n2)
-                return p1, v1, o1, n1, p2, v2, o2, n2, ok, is_lead, delta
-
-            sw = jax.vmap(one_swap)(keys[n_single:])
-            sw_ok, sw_lead, sw_delta = sw[8], sw[9], sw[10]
-            sw_d = sw_delta.cost_vec - ss.cost_vec[None, :]
-            sw_sig = jnp.abs(sw_d) > goal_tols(ss.cost_vec)[None, :]
-            sw_hard_up = jnp.any(
-                sw_sig & hard_arr[None, :] & (sw_d > 0), axis=1
-            )
-            sw_guard_up = guard_on & jnp.any(
-                sw_sig & guard_cols[None, :] & (sw_d > 0), axis=1
-            )
-            sw_better = (
-                sw_ok
-                & ~sw_hard_up
-                & ~sw_guard_up
-                & _lex_lt_batch(sw_delta.cost_vec, ss.cost_vec)
-            )
-            any_swap = jnp.any(sw_better)
-            best_w = _lex_argmin(sw_delta.cost_vec, sw_better)
-            pick_w = lambda tree: jax.tree.map(lambda a: a[best_w], tree)  # noqa: E731
-
-            # take the swap iff it is feasible-better and the best single is
-            # not lexicographically ahead of it
-            single_vec = deltas.cost_vec[best]
-            swap_vec = sw_delta.cost_vec[best_w]
-            d = swap_vec - single_vec
-            tol = goal_tols(single_vec)
-            sig = jnp.abs(d) > tol
-            swap_ahead = jnp.any(sig) & (d[jnp.argmax(sig)] < 0)
-            take_swap = any_swap & (~any_single | swap_ahead)
-
-            def apply_best_swap(s):
-                return apply_swap(
-                    s, m, sw[0][best_w], pick_w(sw[1]), pick_w(sw[2]),
-                    pick_w(sw[3]), sw[4][best_w], pick_w(sw[5]), pick_w(sw[6]),
-                    pick_w(sw[7]), pick_w(sw_delta), any_swap, group=group,
-                )
-
-            prev_accepted = ss.n_accepted
-            ss = jax.lax.cond(take_swap, apply_best_swap, apply_batch, ss)
-            any_better = any_single | any_swap
-            n_applied = ss.n_accepted - prev_accepted
-            # per-move-kind observability: the iteration proposed n_single
-            # singles + n_swap swaps (split by variant); acceptances land
-            # on whichever branch the cond took
-            n_lead_prop = jnp.sum(sw_lead.astype(jnp.int32))
-            acc_kind = jnp.where(
-                take_swap, jnp.where(sw_lead[best_w], 2, 1), 0
-            )
-            ss = bump_kind_counters(
-                ss,
-                jnp.arange(3),
-                jnp.stack(
-                    [
-                        jnp.asarray(n_single, jnp.int32),
-                        jnp.asarray(n_swap, jnp.int32) - n_lead_prop,
-                        n_lead_prop,
-                    ]
-                ),
-                jnp.zeros(3, jnp.int32).at[acc_kind].add(n_applied),
-            )
-        else:
-            prev_accepted = ss.n_accepted
-            ss = apply_batch(ss)
-            any_better = any_single
-            n_applied = ss.n_accepted - prev_accepted
-            ss = bump_kind_counters(ss, 0, n_single, n_applied)
-
-        it = it + 1
-        stale = jnp.where(any_better, 0, stale + 1)
-        moves = moves + n_applied
-        return ss, it, stale, moves
-
     zero = jnp.asarray(0, jnp.int32)
     state, n_iters, _, n_moves = jax.lax.while_loop(
         cond, body, (state0, zero, zero, zero)
     )
     return state, n_iters, n_moves
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("goal_names", "cfg", "pp", "opts", "max_pt"),
+    donate_argnums=(0,),
+)
+def _greedy_chunk(
+    state: SearchState,
+    it: jnp.ndarray,
+    stale: jnp.ndarray,
+    moves: jnp.ndarray,
+    m: TensorClusterModel,
+    evac: jnp.ndarray,
+    n_evac: jnp.ndarray,
+    key0: jnp.ndarray,
+    max_iters: jnp.ndarray,
+    patience: jnp.ndarray,
+    guard_on: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    pp: ProposalParams,
+    opts: GreedyOptions,
+    max_pt: int,
+):
+    """One chunk of the host-driven descent: ``opts.chunk_iters`` (the only
+    shape-bearing budget) conditional iterations over the DONATED state.
+    Returns ``(state, it, stale, moves, done)`` — ``done`` is the
+    early-exit flag the host polls between chunks."""
+    cond, body = _make_greedy_iter(
+        m, evac, n_evac, key0, max_iters, patience, guard_on,
+        goal_names=goal_names, cfg=cfg, pp=pp, opts=opts, max_pt=max_pt,
+    )
+    return _run_chunk_body(cond, body, opts.chunk_iters, state, it, stale, moves)
 
 
 def greedy_optimize(
@@ -487,23 +698,39 @@ def greedy_optimize(
     state0 = init_search_state(
         m, cfg, goal_names, jax.random.PRNGKey(opts.seed), group=group0
     )
-    state, n_iters, n_moves = _greedy_loop(
-        m,
-        state0,
-        jnp.asarray(evac_np),
-        jnp.asarray(n_evac_i, jnp.int32),
-        jax.random.PRNGKey(opts.seed + 1),
-        jnp.asarray(opts.max_iters, jnp.int32),
-        jnp.asarray(opts.patience, jnp.int32),
-        jnp.asarray(trd_guard, bool),
-        goal_names=goal_names,
-        cfg=cfg,
-        pp=pp,
-        # iteration budgets are traced operands; zero them (and the RNG
-        # seed, which only enters via PRNGKey data) in the compile key
-        opts=dataclasses.replace(opts, max_iters=0, patience=0, seed=0),
-        max_pt=max_pt,
-    )
+    evac_j = jnp.asarray(evac_np)
+    n_evac_j = jnp.asarray(n_evac_i, jnp.int32)
+    key0 = jax.random.PRNGKey(opts.seed + 1)
+    mi = jnp.asarray(opts.max_iters, jnp.int32)
+    pat = jnp.asarray(opts.patience, jnp.int32)
+    guard = jnp.asarray(trd_guard, bool)
+    # iteration budgets are traced operands; zero them (and the RNG seed,
+    # which only enters via PRNGKey data) in the compile key. chunk_iters
+    # is the ONE shape-bearing budget — kept in the chunk key, zeroed in
+    # the monolith key (the while_loop never reads it).
+    opts_key = dataclasses.replace(opts, max_iters=0, patience=0, seed=0)
+    if opts.chunk_iters > 0:
+        zero = jnp.asarray(0, jnp.int32)
+        carry = (_unalias_placement(state0), zero, zero, zero)
+
+        def run_one(c, off):
+            *c2, done = _greedy_chunk(
+                *c, m, evac_j, n_evac_j, key0, mi, pat, guard,
+                goal_names=goal_names, cfg=cfg, pp=pp, opts=opts_key,
+                max_pt=max_pt,
+            )
+            return tuple(c2), done
+
+        state, n_iters, _, n_moves = drive_chunks(
+            run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
+        )
+    else:
+        state, n_iters, n_moves = _greedy_loop(
+            m, state0, evac_j, n_evac_j, key0, mi, pat, guard,
+            goal_names=goal_names, cfg=cfg, pp=pp,
+            opts=dataclasses.replace(opts_key, chunk_iters=0),
+            max_pt=max_pt,
+        )
 
     result_model = with_placement(m, state)
     stack_after = evaluate_stack(result_model, cfg, goal_names)
@@ -529,9 +756,10 @@ def greedy_optimize(
 # per-replica usage, Gumbel-top-k draws (hot, cold) replica-swap pairs and
 # pressure-ranked leadership transfers, scores them exactly
 # (make_swap_scorer) and batch-applies the lexicographically-best disjoint
-# subset. Pure descent: only lex-improving, hard-safe (optionally
-# TRD-guarded) candidates are ever applied, so the phase's result is
-# adopted unconditionally by the pipeline.
+# subset (the shared pair machinery above). Pure descent: only
+# lex-improving, hard-safe (optionally TRD-guarded) candidates are ever
+# applied, so the phase's result is adopted unconditionally by the
+# pipeline.
 # ==========================================================================
 
 
@@ -553,27 +781,21 @@ class SwapPolishOptions:
     #: between different topics move topic cells; after the shed converges
     #: the guard keeps the phase from trading TRD=0 back for usage cells.
     trd_guard: bool = True
+    #: iterations per jitted chunk program (config
+    #: ``optimizer.swap.polish.chunk.iters``); 0 = monolithic while_loop.
+    #: Same contract as GreedyOptions.chunk_iters: the only shape-bearing
+    #: budget — max_iters/patience stay traced.
+    chunk_iters: int = 50
     seed: int = 0
 
 
-@functools.partial(
-    jax.jit, static_argnames=("goal_names", "cfg", "opts", "max_pt")
-)
-def _swap_polish_loop(
-    m: TensorClusterModel,
-    state0: SearchState,
-    key0: jnp.ndarray,
-    max_iters: jnp.ndarray,
-    patience: jnp.ndarray,
-    guard_on: jnp.ndarray,
-    *,
-    goal_names: tuple[str, ...],
-    cfg: GoalConfig,
-    opts: SwapPolishOptions,
-    max_pt: int,
+def _make_swap_iter(
+    m, key0, max_iters, patience, guard_on,
+    *, goal_names, cfg, opts, max_pt,
 ):
-    # iteration budgets arrive as traced scalars (zeroed in the static opts
-    # key by the caller) — lean and full swap budgets share ONE program
+    """(cond, body) of one usage-coupled swap-polish iteration — shared by
+    the monolithic and chunked drivers, same budget contract as
+    `_make_greedy_iter` (budgets traced, zeroed in the static key)."""
     group = make_topic_group(m, max_pt) if stack_needs_topic(goal_names) else None
     swap_scorer = make_swap_scorer(m, goal_names, cfg)
     vector_fn = make_cost_vector_fn(m, goal_names, cfg)
@@ -588,8 +810,8 @@ def _swap_polish_loop(
     K_ld = max(min(int(opts.n_lead_candidates), P), 0)
     N = K_sw + K_ld
     n_batch = max(min(opts.batch_moves, N), 1)
+    trd_norm = lambda totals: tt.trd_normalizer(m, totals)  # noqa: E731
     from ccx.common.resources import Resource
-    from ccx.goals import topic_terms as tt_
 
     uw = usage_weights()
     u_lead_p = uw @ m.leader_load          # [P] combined usage, leader role
@@ -779,127 +1001,28 @@ def _swap_polish_loop(
         )
         any_better = jnp.any(better)
 
-        # ---- lex-best-first disjoint selection (greedy apply_batch rule:
-        # disjoint {touched brokers} u {topics} makes sum-decomposable terms
-        # exactly additive; the exact recompute below guards the rest) -----
         touched = jnp.concatenate(
             [olda[0], newa[0], oldb[0], newb[0]], axis=1
-        )  # [N, 8R]? (4 row groups x R)
-        bmask = jnp.zeros((N, B), bool)
-        bmask = jax.vmap(lambda z, bb, v: z.at[bb].set(v, mode="drop"))(
-            bmask,
-            jnp.where(touched >= 0, jnp.clip(touched, 0, B - 1), B),
-            touched >= 0,
         )
+        bmask = _broker_masks(touched, N, B)
         ta = jnp.clip(va.topic, 0, T - 1)
         tb = jnp.clip(vb.topic, 0, T - 1)
-
-        def select(k, carry):
-            alive, used_b, used_t, sel, count = carry
-            conf = (
-                jnp.any(bmask & used_b[None, :], axis=1)
-                | used_t[ta]
-                | (is_swap_cand & used_t[tb])
-            )
-            ok = alive & ~conf
-            any_ok = jnp.any(ok)
-            idx = _lex_argmin(deltas.cost_vec, ok)
-            sel = sel.at[k].set(jnp.where(any_ok, idx, N))
-            used_b = used_b | jnp.where(any_ok, bmask[idx], False)
-            used_t = used_t.at[ta[idx]].max(any_ok)
-            used_t = used_t.at[tb[idx]].max(any_ok & is_swap_cand[idx])
-            alive = alive & (jnp.arange(N) != idx)
-            return alive, used_b, used_t, sel, count + any_ok.astype(jnp.int32)
-
-        sel0 = jnp.full((n_batch,), N, jnp.int32)
-        _, _, _, sel_idx, n_sel = jax.lax.fori_loop(
-            0, n_batch, select,
-            (better, jnp.zeros(B, bool), jnp.zeros(T, bool), sel0,
-             jnp.asarray(0, jnp.int32)),
+        sel_idx, n_sel = _select_disjoint(
+            deltas.cost_vec, better, bmask, ta, tb, is_swap_cand, n_batch, T
         )
-        taken = sel_idx < N
-        safe = jnp.clip(sel_idx, 0, N - 1)
-
-        # ---- exact composition over the selected disjoint subset ---------
-        def acc(k, carry):
-            agg, part, mtl, trd, totals = carry
-            i = safe[k]
-            w = taken[k].astype(jnp.float32)
-            wi = taken[k].astype(jnp.int32)
-            va_i = jax.tree.map(lambda x: x[i], va)
-            vb_i = jax.tree.map(lambda x: x[i], vb)
-            o1 = tuple(x[i] for x in olda)
-            n1 = tuple(x[i] for x in newa)
-            o2 = tuple(x[i] for x in oldb)
-            n2 = tuple(x[i] for x in newb)
-            agg = scatter_partition(agg, m, va_i, *o1, -w, -wi)
-            agg = scatter_partition(agg, m, va_i, *n1, w, wi)
-            agg = scatter_partition(agg, m, vb_i, *o2, -w, -wi)
-            agg = scatter_partition(agg, m, vb_i, *n2, w, wi)
-            part = part + w * (deltas.part_sums[i] - ss.part_sums)
-            mtl = mtl + w * deltas.d_mtl[i]
-            trd = trd + w * deltas.d_trd[i]
-            totals = totals.at[va_i.topic].add(w * deltas.d_total[i])
-            totals = totals.at[vb_i.topic].add(w * deltas.d_total2[i])
-            return agg, part, mtl, trd, totals
-
-        first = acc(0, (ss.agg, ss.part_sums, ss.mtl_sum, ss.trd_sum,
-                        ss.topic_totals))
-        full = jax.lax.fori_loop(1, n_batch, acc, first)
-
-        def costs_of(c):
-            agg_c, part_c, mtl_c, trd_c, totals_c = c
-            return vector_fn(
-                agg_c, part_c, mtl_c, trd_c, tt_.trd_normalizer(m, totals_c)
-            )
-
-        cost_full = costs_of(full)
-        d_full = cost_full - ss.cost_vec
-        full_guard_up = guard_on & jnp.any(
-            (jnp.abs(d_full) > goal_tols(ss.cost_vec))
-            & guard_cols
-            & (d_full > 0)
+        acc_sel, cost_vec, batch_ok, taken, safe = _compose_pairs(
+            ss, m, va, vb, olda, newa, oldb, newb, deltas, sel_idx, n_sel,
+            n_batch, vector_fn, trd_norm, guard_on, guard_cols,
+            has_pairs=True,
         )
-        batch_ok = (n_sel <= 1) | (
-            _lex_lt_batch(cost_full[None, :], ss.cost_vec)[0] & ~full_guard_up
+        ss, n_applied, write_a = _apply_pairs(
+            ss, group, pa, pb, va, vb, newa, newb, acc_sel, cost_vec,
+            batch_ok, taken, safe, n_sel, is_swap_cand, any_better,
         )
-        agg, part, mtl, trd, totals = jax.tree.map(
-            lambda x, y: jnp.where(batch_ok, x, y), full, first
-        )
-        cost_vec = jnp.where(batch_ok, cost_full, costs_of(first))
-        n_applied = jnp.where(
-            any_better, jnp.where(batch_ok, n_sel, jnp.minimum(n_sel, 1)), 0
-        )
-        write_a = taken & (batch_ok | (jnp.arange(n_batch) == 0)) & any_better
-        write_b = write_a & is_swap_cand[safe]
+        # coupled leadership transfers are SINGLE moves (kind 0); replica
+        # swaps are kind 1 — this loop proposes no leadership rotations
         acc_sw = jnp.sum((write_a & is_swap_cand[safe]).astype(jnp.int32))
         acc_ld = jnp.sum((write_a & ~is_swap_cand[safe]).astype(jnp.int32))
-        ss = ss.replace(
-            agg=agg,
-            part_sums=part,
-            mtl_sum=mtl,
-            trd_sum=trd,
-            topic_totals=totals,
-            cost_vec=cost_vec,
-            n_accepted=ss.n_accepted + n_applied,
-            **_placement_updates(
-                ss,
-                group,
-                write=jnp.concatenate([write_a, write_b]),
-                ps=jnp.concatenate([pa[safe], pb[safe]]),
-                mirror=jnp.concatenate(
-                    [
-                        write_a & va.pvalid[safe],
-                        write_b & vb.pvalid[safe],
-                    ]
-                ),
-                global_ps=jnp.concatenate([pa[safe], pb[safe]]),
-                ts=jnp.concatenate([va.topic[safe], vb.topic[safe]]),
-                rows=jnp.concatenate([newa[0][safe], newb[0][safe]]),
-                leads=jnp.concatenate([newa[1][safe], newb[1][safe]]),
-                disks=jnp.concatenate([newa[2][safe], newb[2][safe]]),
-            ),
-        )
         ss = bump_kind_counters(
             ss,
             jnp.arange(3),
@@ -910,11 +1033,66 @@ def _swap_polish_loop(
         stale = jnp.where(any_better, 0, stale + 1)
         return ss, it, stale, moves + n_applied
 
+    return cond, body
+
+
+@functools.partial(
+    jax.jit, static_argnames=("goal_names", "cfg", "opts", "max_pt")
+)
+def _swap_polish_loop(
+    m: TensorClusterModel,
+    state0: SearchState,
+    key0: jnp.ndarray,
+    max_iters: jnp.ndarray,
+    patience: jnp.ndarray,
+    guard_on: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    opts: SwapPolishOptions,
+    max_pt: int,
+):
+    """Monolithic while_loop engine (``chunk_iters=0``) — the parity
+    reference for the chunked swap-polish driver."""
+    cond, body = _make_swap_iter(
+        m, key0, max_iters, patience, guard_on,
+        goal_names=goal_names, cfg=cfg, opts=opts, max_pt=max_pt,
+    )
     zero = jnp.asarray(0, jnp.int32)
     state, n_iters, _, n_moves = jax.lax.while_loop(
         cond, body, (state0, zero, zero, zero)
     )
     return state, n_iters, n_moves
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("goal_names", "cfg", "opts", "max_pt"),
+    donate_argnums=(0,),
+)
+def _swap_polish_chunk(
+    state: SearchState,
+    it: jnp.ndarray,
+    stale: jnp.ndarray,
+    moves: jnp.ndarray,
+    m: TensorClusterModel,
+    key0: jnp.ndarray,
+    max_iters: jnp.ndarray,
+    patience: jnp.ndarray,
+    guard_on: jnp.ndarray,
+    *,
+    goal_names: tuple[str, ...],
+    cfg: GoalConfig,
+    opts: SwapPolishOptions,
+    max_pt: int,
+):
+    """One donated-state chunk of the swap-polish descent (see
+    `_greedy_chunk`)."""
+    cond, body = _make_swap_iter(
+        m, key0, max_iters, patience, guard_on,
+        goal_names=goal_names, cfg=cfg, opts=opts, max_pt=max_pt,
+    )
+    return _run_chunk_body(cond, body, opts.chunk_iters, state, it, stale, moves)
 
 
 def swap_polish(
@@ -943,22 +1121,36 @@ def swap_polish(
     state0 = init_search_state(
         m, cfg, goal_names, jax.random.PRNGKey(opts.seed), group=group0
     )
-    state, n_iters, n_moves = _swap_polish_loop(
-        m,
-        state0,
-        jax.random.PRNGKey(opts.seed + 1),
-        jnp.asarray(opts.max_iters, jnp.int32),
-        jnp.asarray(opts.patience, jnp.int32),
-        jnp.asarray(opts.trd_guard, bool),
-        goal_names=goal_names,
-        cfg=cfg,
-        # iteration budgets and the guard are traced operands; zero them in
-        # the compile key so every budget shares one program
-        opts=dataclasses.replace(
-            opts, max_iters=0, patience=0, seed=0, trd_guard=False
-        ),
-        max_pt=max_pt,
+    key0 = jax.random.PRNGKey(opts.seed + 1)
+    mi = jnp.asarray(opts.max_iters, jnp.int32)
+    pat = jnp.asarray(opts.patience, jnp.int32)
+    guard = jnp.asarray(opts.trd_guard, bool)
+    # iteration budgets and the guard are traced operands; zero them in
+    # the compile key so every budget shares one program per chunk shape
+    opts_key = dataclasses.replace(
+        opts, max_iters=0, patience=0, seed=0, trd_guard=False
     )
+    if opts.chunk_iters > 0:
+        zero = jnp.asarray(0, jnp.int32)
+        carry = (_unalias_placement(state0), zero, zero, zero)
+
+        def run_one(c, off):
+            *c2, done = _swap_polish_chunk(
+                *c, m, key0, mi, pat, guard,
+                goal_names=goal_names, cfg=cfg, opts=opts_key, max_pt=max_pt,
+            )
+            return tuple(c2), done
+
+        state, n_iters, _, n_moves = drive_chunks(
+            run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
+        )
+    else:
+        state, n_iters, n_moves = _swap_polish_loop(
+            m, state0, key0, mi, pat, guard,
+            goal_names=goal_names, cfg=cfg,
+            opts=dataclasses.replace(opts_key, chunk_iters=0),
+            max_pt=max_pt,
+        )
     result_model = with_placement(m, state)
     stack_after = evaluate_stack(result_model, cfg, goal_names)
     return GreedyResult(

@@ -127,6 +127,7 @@ class CruiseControl:
             n_candidates=self.config["optimizer.polish.candidates"],
             max_iters=self.config["optimizer.polish.max.iters"],
             batch_moves=self.config["optimizer.polish.batch.moves"],
+            chunk_iters=self.config["optimizer.polish.chunk.iters"],
         )
         import dataclasses as _dc
 
@@ -198,6 +199,9 @@ class CruiseControl:
                 "optimizer.swap.polish.candidates"
             ],
             swap_polish_guarded=self.config["optimizer.swap.polish.guarded"],
+            swap_polish_chunk_iters=self.config[
+                "optimizer.swap.polish.chunk.iters"
+            ],
         )
 
     def _run_optimizer(self, model, goal_names, opts: OptimizeOptions,
@@ -577,6 +581,19 @@ class CruiseControl:
                         ],
                         "polishGuarded": self.config[
                             "optimizer.swap.polish.guarded"
+                        ],
+                    },
+                    # chunked-descent engine state (r8): the chunk sizes
+                    # are the only shape-bearing polish budgets — an
+                    # operator can confirm from REST that a budget retune
+                    # cannot trigger a recompile (chunkIters unchanged);
+                    # 0 flags that engine deliberately monolithic
+                    "polishEngine": {
+                        "chunkIters": self.config[
+                            "optimizer.polish.chunk.iters"
+                        ],
+                        "swapPolishChunkIters": self.config[
+                            "optimizer.swap.polish.chunk.iters"
                         ],
                     },
                 }

@@ -118,6 +118,15 @@ class OptimizerSidecar:
         if "StructuralFeasibility" not in goals:
             goals = ("StructuralFeasibility",) + tuple(goals)
         o = req.get("options") or {}
+        unknown_opts = set(o) - wire.PROPOSE_OPTION_KEYS
+        if unknown_opts:
+            # a typo'd engine knob must fail the RPC loudly (structured
+            # invalid-argument), never silently run the server default —
+            # the bench._wire_options footgun, now closed server-side
+            raise ValueError(
+                f"unknown options keys: {sorted(unknown_opts)}; this end "
+                "speaks the keys in ccx.sidecar.wire.PROPOSE_OPTION_KEYS"
+            )
         repair_backend = str(o.get("repair_backend", "device"))
         if repair_backend not in ("device", "host"):
             # mirror the config layer's one_of gate: a misspelled backend
@@ -148,7 +157,10 @@ class OptimizerSidecar:
                 max_iters=int(o.get("polish_max_iters", 400)),
                 patience=int(o.get("polish_patience", 8)),
                 batch_moves=int(o.get("polish_batch_moves", 16)),
-                swap_fraction=float(o.get("polish_swap_fraction", 0.25)),
+                # 0 since r8: count-preserving moves belong to the coupled
+                # swap-polish stage (matches GreedyOptions.swap_fraction)
+                swap_fraction=float(o.get("polish_swap_fraction", 0.0)),
+                chunk_iters=int(o.get("polish_chunk_iters", 50)),
             ),
             check_evacuation=bool(o.get("check_evacuation", True)),
             max_repair_rounds=int(o.get("max_repair_rounds", 3)),
@@ -182,6 +194,9 @@ class OptimizerSidecar:
             swap_polish_post_iters=int(o.get("swap_polish_post_iters", 0)),
             swap_polish_candidates=int(o.get("swap_polish_candidates", 128)),
             swap_polish_guarded=bool(o.get("swap_polish_guarded", True)),
+            swap_polish_chunk_iters=int(
+                o.get("swap_polish_chunk_iters", 50)
+            ),
         )
         yield wire.progress_frame(
             f"Optimizing {model.P}x{model.B} over {len(goals)} goals"

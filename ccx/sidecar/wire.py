@@ -49,6 +49,36 @@ ERR_INVALID = "invalid-argument"
 ERR_INTERNAL = "internal"
 
 
+#: Every ``Propose`` ``options`` key the sidecar understands — the single
+#: source the server validates requests against (``ccx/sidecar/server.py``)
+#: and the bench serializer (``bench._wire_options``) must stay a subset
+#: of. An unknown key is a structured ``invalid-argument`` error, never a
+#: silent fallback to the server default: a typo'd engine knob (or a field
+#: added to build_opts but not serialized) must fail the RPC loudly
+#: instead of quietly benchmarking the wrong configuration. Additions are
+#: wire-compatible (older clients simply never send them); an older
+#: server REJECTS keys it cannot honor rather than misreporting results.
+PROPOSE_OPTION_KEYS = frozenset({
+    # SA engine
+    "chains", "steps", "moves_per_step", "seed", "chunk_steps",
+    "p_swap", "p_swap_end", "swap_coupling",
+    # greedy polish / leadership pass (chunked descent engine)
+    "polish_candidates", "polish_max_iters", "polish_patience",
+    "polish_batch_moves", "polish_swap_fraction", "polish_chunk_iters",
+    # pipeline stages
+    "check_evacuation", "max_repair_rounds", "require_hard_zero",
+    "run_polish", "run_leader_pass", "run_cold_greedy",
+    "repair_backend", "overlap_repair",
+    "topic_rebalance_rounds", "topic_rebalance_max_sweeps",
+    "topic_rebalance_move_leaders", "topic_rebalance_guarded",
+    "topic_rebalance_polish_iters", "leader_pass_max_iters",
+    # usage-coupled swap polish
+    "swap_polish_iters", "swap_polish_post_iters",
+    "swap_polish_candidates", "swap_polish_guarded",
+    "swap_polish_chunk_iters",
+})
+
+
 class WireError(ValueError):
     """A structured wire-contract violation: ``code`` is one of the ERR_*
     constants and rides the wire next to the message (error frame ``code``

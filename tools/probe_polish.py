@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Chunked-polish compile probe — the TPU-window smoke test for the
+descent-engine programs (tools/tpu_campaign.sh runs it BEFORE any timed
+rung, the same insurance the bench prewarm and tools/probe_swap.py give
+the SA/swap programs).
+
+For each requested config shape it times, via the per-label compile
+accounting in ``ccx.common.compilestats``, the COLD compile and one-chunk
+WARM run of every polish-family program the pipeline executes:
+
+* ``polish``      — the uniform greedy chunk (shared by the pre-shed
+                    polish, the trd-guarded re-polish and the portfolio
+                    candidate: budgets and the guard are traced),
+* ``leader-pass`` — the leadership-only chunk (its own program —
+                    leadership_only is shape),
+* ``swap-polish`` — the usage-coupled swap chunk (shared by the pre- and
+                    post-leader invocations).
+
+The round-4 TPU window died on exactly this compile (>17 min greedy
+while_loop, timed out): this probe surfaces a pathological polish compile
+in minutes, with a per-program breakdown, before a timed campaign rung is
+at stake. ``PROBE_POLISH_MONOLITH=1`` also times the monolithic
+(``chunk_iters=0``) while_loop programs — the measurement behind the
+docs/perf-notes.md "Chunked polish" compile table.
+
+Runnable under ``JAX_PLATFORMS=cpu``;
+tests/test_polish_chunked.py::test_probe_polish_b1_smoke runs the B1
+shape as a fast smoke-marked tier-1 test (``pytest -m smoke``).
+
+Env: PROBE_CONFIGS comma-list (default "B1,B5"; B5S = 1/10-scale B5),
+PROBE_POLISH_MONOLITH=1 adds the monolith timings, PROBE_CHUNK_ITERS
+overrides the chunk size (default: the engine default).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _cluster(name: str):
+    from ccx.model.fixtures import RandomClusterSpec, bench_spec, random_cluster
+
+    if name == "B5S":  # 1/10-scale B5: the fast iteration config
+        return random_cluster(RandomClusterSpec(
+            n_brokers=100, n_racks=10, n_topics=50, n_partitions=10_000,
+            n_dead_brokers=2, seed=7,
+        ))
+    return random_cluster(bench_spec(name))
+
+
+def probe_config(
+    name: str,
+    chunk_iters: int | None = None,
+    monolith: bool = False,
+    n_candidates: int = 256,
+    swap_candidates: int = 128,
+) -> dict:
+    """Compile+run ledger for every polish-family program at one config
+    shape: ``{program: {compile_s, backend_compiles, run_s, iters}}``.
+    ``chunk_iters=0`` (or ``monolith=True`` for the extra ``*-monolith``
+    rows) times the while_loop engine instead."""
+    from ccx.common import compilestats
+    from ccx.goals.base import GoalConfig
+    from ccx.goals.stack import DEFAULT_GOAL_ORDER
+    from ccx.search.greedy import (
+        GreedyOptions,
+        SwapPolishOptions,
+        greedy_optimize,
+        swap_polish,
+    )
+
+    m = _cluster(name)
+    cfg = GoalConfig()
+    goals = DEFAULT_GOAL_ORDER if name != "B1" else (
+        "StructuralFeasibility", "ReplicaDistributionGoal",
+    )
+    ci = GreedyOptions().chunk_iters if chunk_iters is None else chunk_iters
+    # one chunk's worth of real iterations: cold run pays compile + one
+    # chunk, warm run times the chunk alone
+    iters = max(ci, 1)
+
+    def g_opts(lead_only: bool, chunk: int) -> GreedyOptions:
+        return GreedyOptions(
+            n_candidates=n_candidates, max_iters=iters, patience=iters,
+            leadership_only=lead_only, chunk_iters=chunk,
+        )
+
+    def s_opts(chunk: int) -> SwapPolishOptions:
+        ksw = max(swap_candidates // 2, 1)
+        return SwapPolishOptions(
+            n_swap_candidates=ksw, n_lead_candidates=swap_candidates - ksw,
+            max_iters=iters, patience=iters, chunk_iters=chunk,
+        )
+
+    programs = [
+        ("polish", lambda c: greedy_optimize(m, cfg, goals, g_opts(False, c))),
+        ("leader-pass",
+         lambda c: greedy_optimize(m, cfg, goals, g_opts(True, c))),
+    ]
+    if name != "B1":  # the bench B1 rung never runs the swap-polish stage
+        programs.append(
+            ("swap-polish", lambda c: swap_polish(m, cfg, goals, s_opts(c)))
+        )
+
+    out: dict = {}
+    variants = [("", ci)] + ([("-monolith", 0)] if monolith and ci else [])
+    for suffix, chunk in variants:
+        for prog, run in programs:
+            label = f"probe:{name}:{prog}{suffix}"
+            with compilestats.attributed(label):
+                run(chunk)
+            cold = compilestats.attribution()[label]
+            t0 = time.monotonic()
+            run(chunk)
+            out[prog + suffix] = {
+                "compile_s": cold["backend_compile_secs"],
+                "backend_compiles": cold["backend_compiles"],
+                "cold_wall_s": cold["wall_secs"],
+                "run_s": round(time.monotonic() - t0, 2),
+                "iters": iters,
+                "chunk_iters": chunk,
+            }
+    return out
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            ),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    log = lambda s: print(f"[polish-probe] {s}", file=sys.stderr, flush=True)  # noqa: E731
+    configs = os.environ.get("PROBE_CONFIGS", "B1,B5").split(",")
+    monolith = os.environ.get("PROBE_POLISH_MONOLITH") == "1"
+    chunk = os.environ.get("PROBE_CHUNK_ITERS")
+    results = {}
+    for name in (c.strip() for c in configs if c.strip()):
+        t0 = time.monotonic()
+        results[name] = probe_config(
+            name, chunk_iters=int(chunk) if chunk else None, monolith=monolith
+        )
+        log(f"{name} done in {time.monotonic() - t0:.1f}s")
+        for prog, row in results[name].items():
+            log(f"  {name}/{prog}: compile={row['compile_s']}s "
+                f"({row['backend_compiles']} programs) run={row['run_s']}s")
+    print(json.dumps({"backend": jax.default_backend(),
+                      "results": results}, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()

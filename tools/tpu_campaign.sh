@@ -34,6 +34,14 @@ L="${1:-tpu_campaign.log}"
     echo "device probe FAILED or non-TPU backend — aborting campaign"
     exit 1
   fi
+  echo "--- chunked-polish compile probe at B1+B5 (before any timed rung) ---"
+  # the descent-engine chunk programs are what the round-4 window died
+  # compiling (>17 min greedy while_loop): prove their compile on
+  # hardware FIRST, with a per-program breakdown, and fill the
+  # persistent cache the bench prewarm then hits. A pathological compile
+  # surfaces here with a [polish-probe] breadcrumb, never inside a rung.
+  timeout -k 60 2400 python tools/probe_polish.py
+  echo "polish-probe rc=$?"
   echo "--- bench pass 1 (cold compiles -> persistent cache) ---"
   # bench.py now opens with a PREWARM pass (one floored-budget optimize
   # that compiles the ladder's whole shared program set at one-chunk/
