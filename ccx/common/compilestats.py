@@ -139,3 +139,27 @@ def attribution() -> dict:
     dict + wall_secs)."""
     with _LOCK:
         return {k: dict(v) for k, v in _ATTR.items()}
+
+
+def export_gauges(registry=None) -> None:
+    """Register the live counters as gauges on the metrics registry so an
+    operator can watch compile activity DURING a wedged run from /metrics
+    (a climbing ``backend_compile_secs`` under a flat heartbeat is the
+    ">17-min compile" signature — docs/observability.md). Idempotent:
+    re-registration just replaces the gauge callables. The gauge reads go
+    through ``snapshot()``, so the first scrape also installs the
+    jax.monitoring listeners."""
+    if registry is None:
+        from ccx.common.metrics import REGISTRY as registry  # noqa: N811
+    docs = {
+        "backend_compiles": "fresh XLA backend compiles in this process",
+        "backend_compile_secs": "wall seconds spent in XLA backend compiles",
+        "persistent_hits": "programs loaded from the persistent compile cache",
+        "persistent_misses": "fresh compiles written to the persistent cache",
+    }
+    for key in _COUNTS:
+        registry.gauge(
+            f"compile-{key.replace('_', '-')}",
+            (lambda k=key: snapshot()[k]),
+            help=docs.get(key, key),
+        )

@@ -1,17 +1,29 @@
-"""Metrics registry — timers/meters/gauges with a Prometheus-text view.
+"""Metrics registry — timers/meters/gauges/histograms with a Prometheus
+text-exposition view.
 
 Parity: the reference exports Dropwizard ``MetricRegistry`` timers and
 meters over JMX domain ``kafka.cruisecontrol`` — e.g. GoalOptimizer's
 ``proposal-computation-timer`` and per-endpoint servlet timers (SURVEY.md
 §5.1/§5.5). Python has no JMX; the idiomatic equivalent is a registry
-rendered in Prometheus text exposition format, which SURVEY.md §7.2 step 5
-prescribes for the rebuild.
+rendered in Prometheus text exposition format (version 0.0.4), which
+SURVEY.md §7.2 step 5 prescribes for the rebuild.
+
+Exposition contract (pinned by tests/test_observability.py with a strict
+format parser): every metric family gets ``# HELP`` and ``# TYPE`` lines;
+timers render as summaries (``_seconds_sum``/``_seconds_count``) plus a
+``_seconds_max`` gauge; counters follow the ``_total`` naming convention;
+histograms emit cumulative ``_bucket{le=...}`` series ending at ``+Inf``.
+The servlet serves it with ``PROMETHEUS_CONTENT_TYPE``.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
+
+#: the text-exposition content type the /metrics endpoint must serve
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class Timer:
@@ -56,57 +68,147 @@ class Counter:
             self.value += n
 
 
+class Histogram:
+    """Prometheus-style cumulative histogram. The default buckets span
+    5 ms .. 10 min — sized for optimizer phases and sidecar RPCs, where
+    the interesting tail is a multi-minute TPU compile, not a microsecond
+    cache hit."""
+
+    DEFAULT_BUCKETS = (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+        30.0, 60.0, 120.0, 300.0, 600.0,
+    )
+
+    def __init__(self, buckets: tuple[float, ...] | None = None) -> None:
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self._counts = [0] * (len(self.buckets) + 1)  # last slot = +Inf
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> dict:
+        """Cumulative per-bucket counts keyed by upper bound (+Inf last)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self.count, self.sum
+        cum = 0
+        out: dict = {"count": total, "sum": s, "buckets": {}}
+        for le, c in zip(self.buckets, counts):
+            cum += c
+            out["buckets"][le] = cum
+        out["buckets"][math.inf] = total
+        return out
+
+
+def _fmt_le(le: float) -> str:
+    if math.isinf(le):
+        return "+Inf"
+    s = f"{le:g}"
+    return s
+
+
 class MetricsRegistry:
-    """Process-wide named timers/counters/gauges (ref MetricRegistry)."""
+    """Process-wide named timers/counters/gauges/histograms (ref
+    MetricRegistry)."""
 
     def __init__(self, prefix: str = "ccx") -> None:
         self.prefix = prefix
         self._timers: dict[str, Timer] = {}
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, object] = {}  # name -> callable() -> float
+        self._histograms: dict[str, Histogram] = {}
+        self._help: dict[str, str] = {}
         self._lock = threading.Lock()
 
-    def timer(self, name: str) -> Timer:
+    def _set_help(self, name: str, help: str | None) -> None:
+        if help and name not in self._help:
+            self._help[name] = help
+
+    def timer(self, name: str, help: str | None = None) -> Timer:
         with self._lock:
+            self._set_help(name, help)
             return self._timers.setdefault(name, Timer())
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str, help: str | None = None) -> Counter:
         with self._lock:
+            self._set_help(name, help)
             return self._counters.setdefault(name, Counter())
 
-    def gauge(self, name: str, fn) -> None:
+    def gauge(self, name: str, fn, help: str | None = None) -> None:
         with self._lock:
+            self._set_help(name, help)
             self._gauges[name] = fn
 
+    def histogram(self, name: str, buckets: tuple[float, ...] | None = None,
+                  help: str | None = None) -> Histogram:
+        with self._lock:
+            self._set_help(name, help)
+            return self._histograms.setdefault(name, Histogram(buckets))
+
     def render_prometheus(self) -> str:
-        """Prometheus text exposition of everything registered."""
+        """Prometheus text exposition (0.0.4) of everything registered:
+        ``# HELP`` + ``# TYPE`` per family, summaries for timers,
+        ``_total`` counters, gauges, cumulative histograms."""
         out: list[str] = []
 
         def sanitize(name: str) -> str:
             return name.replace("-", "_").replace(".", "_").replace(" ", "_")
 
+        def esc(text: str) -> str:
+            return text.replace("\\", "\\\\").replace("\n", "\\n")
+
         with self._lock:
             timers = dict(self._timers)
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+            helps = dict(self._help)
+
+        def head(raw_name: str, family: str, typ: str, default_help: str):
+            out.append(
+                f"# HELP {family} {esc(helps.get(raw_name, default_help))}"
+            )
+            out.append(f"# TYPE {family} {typ}")
+
         for name, t in sorted(timers.items()):
-            n = f"{self.prefix}_{sanitize(name)}"
-            out.append(f"# TYPE {n}_seconds_total counter")
-            out.append(f"{n}_seconds_total {t.total_s:.6f}")
+            n = f"{self.prefix}_{sanitize(name)}_seconds"
+            head(name, n, "summary", f"{name} timer (seconds)")
+            out.append(f"{n}_sum {t.total_s:.6f}")
             out.append(f"{n}_count {t.count}")
-            out.append(f"{n}_seconds_max {t.max_s:.6f}")
+            head(name + "/max", f"{n}_max", "gauge",
+                 f"{name} timer max single observation (seconds)")
+            out.append(f"{n}_max {t.max_s:.6f}")
         for name, c in sorted(counters.items()):
-            n = f"{self.prefix}_{sanitize(name)}"
-            out.append(f"# TYPE {n} counter")
+            n = f"{self.prefix}_{sanitize(name)}_total"
+            head(name, n, "counter", f"{name} counter")
             out.append(f"{n} {c.value}")
         for name, fn in sorted(gauges.items()):
-            n = f"{self.prefix}_{sanitize(name)}"
             try:
                 v = float(fn())
             except Exception:
                 continue
-            out.append(f"# TYPE {n} gauge")
+            n = f"{self.prefix}_{sanitize(name)}"
+            head(name, n, "gauge", f"{name} gauge")
             out.append(f"{n} {v}")
+        for name, h in sorted(histograms.items()):
+            n = f"{self.prefix}_{sanitize(name)}"
+            snap = h.snapshot()
+            head(name, n, "histogram", f"{name} histogram")
+            for le, cum in snap["buckets"].items():
+                out.append(f'{n}_bucket{{le="{_fmt_le(le)}"}} {cum}')
+            out.append(f"{n}_sum {snap['sum']:.6f}")
+            out.append(f"{n}_count {snap['count']}")
         return "\n".join(out) + "\n"
 
     def snapshot(self) -> dict:
@@ -117,6 +219,10 @@ class MetricsRegistry:
                     for k, t in self._timers.items()
                 },
                 "counters": {k: c.value for k, c in self._counters.items()},
+                "histograms": {
+                    k: {"count": h.count, "sumSec": round(h.sum, 4)}
+                    for k, h in self._histograms.items()
+                },
             }
 
 

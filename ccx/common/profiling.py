@@ -35,12 +35,19 @@ def annotate(name: str):
 @contextlib.contextmanager
 def trace(log_dir: str | None):
     """Capture a jax.profiler trace into ``log_dir`` (no-op if falsy or if a
-    trace is already active — nested requests must not kill the outer one)."""
+    trace is already active — nested requests must not kill the outer one).
+
+    Captures are noted in the flight recorder (ccx.common.tracing) so a
+    recording cross-references the XProf artifact covering the same wall
+    window — "which device trace shows this stalled chunk" is answerable
+    from the JSONL alone."""
     global _ACTIVE
     if not log_dir:
         yield False
         return
     import jax.profiler
+
+    from ccx.common.tracing import TRACER
 
     with _LOCK:
         if _ACTIVE:
@@ -49,6 +56,8 @@ def trace(log_dir: str | None):
             jax.profiler.start_trace(log_dir)
             _ACTIVE = started = True
     try:
+        if started:
+            TRACER._record({"ev": "xprof-start", "dir": log_dir})
         yield started
     finally:
         if started:
@@ -57,3 +66,4 @@ def trace(log_dir: str | None):
                     jax.profiler.stop_trace()
                 finally:
                     _ACTIVE = False
+            TRACER._record({"ev": "xprof-stop", "dir": log_dir})

@@ -1,0 +1,674 @@
+"""Flight-recorder tracing — structured spans, chunk heartbeats, stall
+watchdog. The observability layer the TPU campaign runs under so a burned
+window is never blind (ISSUE 5; ROADMAP "Bank the number on hardware").
+
+Five rounds of TPU windows died without evidence: a >17-min compile that
+timed out, a SIGKILLed process whose ``phase_seconds`` dict evaporated with
+it. The reference's host-side story is Dropwizard timers + OperationProgress
+(SURVEY.md §5.1/§5.5); production reconfiguration systems treat live
+per-stage telemetry as the prerequisite for diagnosing stalls mid-flight
+(PAPERS.md "Integrative Dynamic Reconfiguration..."). This module is that
+layer for the TPU-native pipeline, in three pieces:
+
+**Spans.** ``TRACER.span(name, kind=..., **attrs)`` wraps a code region:
+wall time, caller-supplied shape/config attributes, and the compile
+attribution that fired inside it (``ccx.common.compilestats`` deltas — the
+"which phase paid that 17-minute compile" answer). Spans nest per thread;
+a completed root span's tree is exported three ways: ``OptimizerResult.
+span_tree`` (→ BENCH lines and the sidecar result), ``AnalyzerState.
+observability`` over REST, and per-phase/per-RPC Prometheus histograms in
+``ccx.common.metrics``. Timing is host wall-clock by default; with
+``observability.trace.sync`` (config) / ``CCX_TRACE_SYNC=1`` (env) every
+span close drains the device stream first (``block_until_ready`` on a
+freshly dispatched scalar — in-order execution makes that an upper bound on
+prior queued work), trading dispatch-pipeline overlap for device-honest
+per-phase walls. Default OFF: the pipelined repair/anneal overlap is a
+measured win the default must not silently forfeit.
+
+**Flight recorder.** ``arm(path)`` (config ``observability.flight.recorder.
+path`` or env ``CCX_FLIGHT_RECORDER``) streams every span start/end, every
+chunk heartbeat (one record per ``drive_chunks`` sync point — phase, chunk
+index, compile counters), and watchdog dumps to a JSONL file. Crash-safe
+by construction: each record is ONE ``os.write`` to an ``O_APPEND`` fd —
+atomic for regular files, and OS-buffered data survives SIGKILL — so a
+killed or driver-timed-out run leaves a file whose last line names the
+exact phase, chunk index, and cumulative compile attribution at death.
+Parse it with ``python -m ccx.common.tracing <file>`` or see
+docs/observability.md ("how to read a dead window's recording").
+
+**Stall watchdog.** With ``observability.watchdog.seconds`` > 0 (env
+``CCX_WATCHDOG_SECONDS``) a daemon thread watches the event stream; when
+no span event or heartbeat arrives for that long while spans are active,
+it dumps all-thread stacks, the active span stacks, and live compilestats
+into the recorder (and stderr) — one dump per stall episode, re-armed by
+the next heartbeat. A wedged device or a pathological compile therefore
+self-reports from inside the dying process.
+
+Overhead contract (pinned by tests/test_observability.py): spans and
+heartbeats are host-side only — no jax arrays are touched unless
+``sync`` is explicitly enabled — so tracing can never perturb program
+shapes or cost a warm rung a recompile; unarmed, a heartbeat is two
+attribute writes and a timestamp.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+#: recorder schema version, stamped on every ``arm`` header record
+RECORDER_VERSION = 1
+
+#: env knobs (the config keys ``observability.*`` take precedence when a
+#: facade is constructed; env covers bench/tools/subprocess paths)
+ENV_RECORDER = "CCX_FLIGHT_RECORDER"
+ENV_WATCHDOG = "CCX_WATCHDOG_SECONDS"
+ENV_SYNC = "CCX_TRACE_SYNC"
+
+
+def _device_sync() -> None:
+    """Drain the device stream (best effort): dispatch a trivial scalar and
+    block on it — per-device execution is in-order, so this bounds every
+    previously queued program. Never raises (a wedged device must not turn
+    a span close into a hang worse than the one being measured — the call
+    itself may block, which IS the honest timing)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jnp.zeros(()) + 0)
+    except Exception:  # noqa: BLE001 — tracing must never break the host
+        pass
+
+
+class Span:
+    """One traced region. Mutable fields are written by the owning thread
+    only; the watchdog reads paths/attrs without a lock (stale reads are
+    acceptable in a stall dump)."""
+
+    __slots__ = (
+        "name", "kind", "path", "attrs", "children", "t_wall",
+        "t0", "wall_s", "compile0", "compile", "done",
+    )
+
+    def __init__(self, name: str, kind: str | None, path: str,
+                 attrs: dict, compile0: dict | None) -> None:
+        self.name = name
+        self.kind = kind
+        self.path = path
+        self.attrs = attrs
+        self.children: list[Span] = []
+        self.t_wall = time.time()
+        self.t0 = time.monotonic()
+        self.wall_s: float | None = None
+        self.compile0 = compile0
+        self.compile: dict | None = None
+        self.done = False
+
+    def to_json(self) -> dict:
+        out: dict = {"name": self.name, "startedAt": round(self.t_wall, 3)}
+        if self.kind:
+            out["kind"] = self.kind
+        if self.wall_s is not None:
+            out["wallSeconds"] = round(self.wall_s, 4)
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.compile:
+            out["compile"] = self.compile
+        if self.children:
+            out["children"] = [c.to_json() for c in self.children]
+        return out
+
+
+def _compile_snapshot() -> dict | None:
+    """Live compilestats counters; None when jax is unimportable (keeps the
+    tracer usable from dependency-light tools)."""
+    try:
+        from ccx.common import compilestats
+
+        return compilestats.snapshot()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _compile_delta(before: dict | None) -> dict | None:
+    if before is None:
+        return None
+    after = _compile_snapshot()
+    if after is None:
+        return None
+    from ccx.common import compilestats
+
+    d = compilestats.delta(before, after)
+    return d if any(d.values()) else None
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self._tl = threading.local()
+        self._lock = threading.Lock()
+        #: thread ident -> that thread's live span stack (for the watchdog
+        #: and the REST observability view)
+        self._stacks: dict[int, list[Span]] = {}
+        self._fd: int | None = None
+        self._path: str | None = None
+        self._records = 0
+        self.sync = False
+        self._last_event = time.monotonic()
+        #: per-thread last event time (GIL-atomic dict writes): stall
+        #: detection must be per thread, or a healthy Ping span every 60 s
+        #: would mask a Propose worker wedged in a 17-minute compile
+        self._thread_last: dict[int, float] = {}
+        #: threads already dumped for the CURRENT stall episode
+        self._stalled_dumped: set[int] = set()
+        self._watchdog_s = 0.0
+        self._watchdog_stop: threading.Event | None = None
+        self._watchdog_thread: threading.Thread | None = None
+        self._watchdog_dumps = 0
+        self._last_root: dict | None = None
+        self._env_checked = False
+        #: live record taps (sidecar Propose streams heartbeats to the JVM
+        #: through one) — called with each record dict, never raising
+        self._listeners: list = []
+
+    # ----- configuration ----------------------------------------------------
+
+    def _maybe_env(self) -> None:
+        """One-shot env arming: lets ANY proposal path (bench subprocess,
+        campaign rung, kill-test child) leave a recording without code —
+        export CCX_FLIGHT_RECORDER and the first span arms it."""
+        if self._env_checked:
+            return
+        self._env_checked = True
+        if os.environ.get(ENV_SYNC) == "1":
+            self.sync = True
+        wd = os.environ.get(ENV_WATCHDOG)
+        if wd:
+            try:
+                self.set_watchdog(float(wd))
+            except ValueError:
+                pass
+        path = os.environ.get(ENV_RECORDER)
+        if path and self._fd is None:
+            try:
+                self.arm(path)
+            except OSError:
+                pass
+
+    def configure(self, sync: bool | None = None,
+                  watchdog_seconds: float | None = None,
+                  path: str | None = None) -> None:
+        """Config-driven setup (facade construction). ``path``/knobs left
+        None keep their current (possibly env-armed) values."""
+        self._maybe_env()
+        if sync is not None:
+            self.sync = bool(sync)
+        if watchdog_seconds is not None:
+            self.set_watchdog(float(watchdog_seconds))
+        if path:
+            self.arm(path)
+
+    def arm(self, path: str) -> None:
+        """Open (append) the flight-recorder file and write the header
+        record. Re-arming on the same path is a no-op; a new path closes
+        the old recorder first."""
+        with self._lock:
+            if self._fd is not None and self._path == path:
+                return
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+            self._fd = os.open(
+                path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+            self._path = path
+            self._records = 0
+        self._record({
+            "ev": "arm", "v": RECORDER_VERSION, "pid": os.getpid(),
+            "argv": sys.argv[:4],
+        })
+
+    def disarm(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+            self._fd = None
+            self._path = None
+
+    def set_watchdog(self, seconds: float) -> None:
+        """(Re)arm the stall watchdog; 0 stops it."""
+        self._watchdog_s = max(float(seconds), 0.0)
+        if self._watchdog_s <= 0:
+            if self._watchdog_stop is not None:
+                self._watchdog_stop.set()
+                self._watchdog_thread = None
+                self._watchdog_stop = None
+            return
+        if self._watchdog_thread is None or not self._watchdog_thread.is_alive():
+            self._watchdog_stop = threading.Event()
+            self._watchdog_thread = threading.Thread(
+                target=self._watch, name="ccx-stall-watchdog", daemon=True
+            )
+            self._watchdog_thread.start()
+
+    # ----- spans ------------------------------------------------------------
+
+    def _stack(self) -> list[Span]:
+        st = getattr(self._tl, "stack", None)
+        if st is None:
+            st = self._tl.stack = []
+            with self._lock:
+                self._stacks[threading.get_ident()] = st
+        return st
+
+    def start(self, name: str, kind: str | None = None, **attrs) -> Span:
+        self._maybe_env()
+        st = self._stack()
+        path = (st[-1].path + "/" + name) if st else name
+        s = Span(name, kind, path, attrs, _compile_snapshot())
+        if st:
+            st[-1].children.append(s)
+        st.append(s)
+        self._record({
+            "ev": "start", "span": path,
+            **({"kind": kind} if kind else {}),
+            **({"attrs": attrs} if attrs else {}),
+        })
+        return s
+
+    def end(self, span: Span) -> None:
+        if span.done:
+            return
+        if self.sync:
+            _device_sync()
+        span.wall_s = time.monotonic() - span.t0
+        span.compile = _compile_delta(span.compile0)
+        span.done = True
+        st = getattr(self._tl, "stack", None)
+        root_closed = False
+        if st is not None and span in st:
+            # pop through to this span — an unwound exception may leave
+            # unclosed children above it; close them with honest walls
+            while st and st[-1] is not span:
+                inner = st.pop()
+                if not inner.done:
+                    inner.wall_s = time.monotonic() - inner.t0
+                    inner.done = True
+            if st and st[-1] is span:
+                st.pop()
+            root_closed = not st
+        self._record({
+            "ev": "end", "span": span.path,
+            "wall_s": round(span.wall_s, 4),
+            **({"compile": span.compile} if span.compile else {}),
+        })
+        if root_closed:
+            # root closed: bank the tree and deregister this thread's
+            # stack — the sidecar spawns a worker thread per Propose, so
+            # keeping dead-thread entries would grow the registry (and
+            # every watchdog/REST scan of it) without bound. The next
+            # span on this thread re-registers via _stack(). Must run
+            # AFTER the end record above — _record re-stamps this
+            # thread's liveness entry, which would undo the pop.
+            tid = threading.get_ident()
+            self._tl.stack = None
+            with self._lock:
+                self._last_root = span.to_json()
+                self._stacks.pop(tid, None)
+            self._thread_last.pop(tid, None)
+        if span.kind:
+            # bucketed per-phase / per-RPC / per-verb latency — the
+            # Prometheus face of the span stream
+            from ccx.common.metrics import REGISTRY
+
+            REGISTRY.histogram(
+                f"{span.kind}-{span.name}-seconds",
+                help=f"ccx {span.kind} '{span.name}' wall seconds (span close)",
+            ).observe(span.wall_s)
+
+    @contextlib.contextmanager
+    def span(self, name: str, kind: str | None = None, **attrs):
+        s = self.start(name, kind=kind, **attrs)
+        try:
+            yield s
+        finally:
+            self.end(s)
+
+    def heartbeat(self, chunk: int, offset: int | None = None,
+                  total: int | None = None) -> None:
+        """One record per host↔device chunk sync point (``annealer.
+        drive_chunks``). Unarmed cost: two attr writes + a timestamp."""
+        st = getattr(self._tl, "stack", None)
+        span = st[-1] if st else None
+        if span is not None:
+            span.attrs["chunk"] = int(chunk)
+            if total is not None:
+                span.attrs["chunkTotal"] = int(total)
+        if self._fd is None and not self._listeners:
+            now = time.monotonic()
+            tid = threading.get_ident()
+            self._last_event = now
+            self._thread_last[tid] = now
+            self._stalled_dumped.discard(tid)
+            return
+        rec = {"ev": "chunk", "chunk": int(chunk)}
+        if span is not None:
+            rec["span"] = span.path
+        if offset is not None:
+            rec["offset"] = int(offset)
+        if total is not None:
+            rec["total"] = int(total)
+        snap = _compile_snapshot()
+        if snap is not None:
+            rec["compile"] = snap
+        self._record(rec)
+
+    # ----- recorder ---------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Tap the record stream (every span start/end, heartbeat, watchdog
+        dump — armed or not). Used by the sidecar to relay heartbeats as
+        Propose progress frames. ``fn(rec)`` must be fast and non-raising;
+        exceptions are swallowed."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _record(self, rec: dict, event: bool = True) -> None:
+        # watchdog dumps pass event=False: the dump's own write must not
+        # count as liveness, or one stall would re-arm the watchdog into
+        # dumping every interval instead of once per episode
+        if event:
+            now = time.monotonic()
+            tid = threading.get_ident()
+            self._last_event = now
+            self._thread_last[tid] = now
+            # a live event re-arms this thread's stall episode HERE, not
+            # just in the watchdog poll: a thread that recovers and exits
+            # within one poll interval must not leave its (recyclable)
+            # ident marked already-dumped forever
+            self._stalled_dumped.discard(tid)
+        rec = {"t": round(time.time(), 3), "tid": threading.get_ident(), **rec}
+        for fn in list(self._listeners):
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — a tap must not break tracing
+                pass
+        fd = self._fd
+        if fd is None:
+            return
+        try:
+            line = json.dumps(rec, default=str) + "\n"
+        except (TypeError, ValueError):
+            line = json.dumps({"t": rec.get("t"), "ev": "unserializable"}) + "\n"
+        try:
+            # ONE os.write on an O_APPEND fd: atomic for regular files, and
+            # already in the page cache when a SIGKILL lands — the crash
+            # contract the kill-test pins
+            os.write(fd, line.encode())
+            with self._lock:
+                self._records += 1
+        except OSError:
+            pass
+
+    # ----- watchdog ---------------------------------------------------------
+
+    def _active(self) -> dict[int, list[dict]]:
+        out: dict[int, list[dict]] = {}
+        with self._lock:
+            stacks = dict(self._stacks)
+        for tid, st in stacks.items():
+            entries = []
+            for s in list(st):
+                # attrs are mutated lock-free by the owning thread (a
+                # heartbeat's first insertion resizes the dict); a racing
+                # copy may raise — retry once, then settle for the path
+                for _ in range(2):
+                    try:
+                        attrs = dict(s.attrs)
+                        break
+                    except RuntimeError:
+                        attrs = {}
+                entries.append(
+                    {"span": s.path, **({"attrs": attrs} if attrs else {})}
+                )
+            if entries:
+                out[tid] = entries
+        return out
+
+    def _watch(self) -> None:
+        stop = self._watchdog_stop
+        while stop is not None and not stop.wait(
+            min(max(self._watchdog_s / 4.0, 0.05), 1.0)
+        ):
+            if self._watchdog_s <= 0:
+                return
+            try:
+                # per-thread stall detection: a thread is stalled when ITS
+                # last event is old — global liveness would let a healthy
+                # Ping span every minute mask a Propose worker wedged in a
+                # 17-minute compile (the exact failure this exists for).
+                # One dump per thread per stall episode; a thread's next
+                # event clears it for re-arming.
+                now = time.monotonic()
+                active = self._active()
+                stalled = {}
+                for tid in active:
+                    idle = now - self._thread_last.get(
+                        tid, self._last_event
+                    )
+                    if idle >= self._watchdog_s:
+                        stalled[tid] = idle
+                    else:
+                        self._stalled_dumped.discard(tid)
+                fresh = {
+                    tid: idle for tid, idle in stalled.items()
+                    if tid not in self._stalled_dumped
+                }
+                if not fresh:
+                    continue
+                self._stalled_dumped.update(fresh)
+                self._dump_stall(
+                    max(fresh.values()),
+                    {tid: active[tid] for tid in stalled},
+                )
+            except Exception:  # noqa: BLE001 — the watchdog thread must
+                # survive anything (an escaped exception would silently
+                # kill stall detection for the rest of the process)
+                pass
+
+    @staticmethod
+    def _thread_stacks() -> dict[str, list[str]]:
+        """All-thread stack dump, trimmed to the innermost 12 frames —
+        shared by watchdog stall dumps and the REST threads=true view."""
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        return {
+            f"{names.get(tid, '?')}:{tid}": [
+                ln.rstrip() for ln in traceback.format_stack(frame)[-12:]
+            ]
+            for tid, frame in frames.items()
+        }
+
+    def _dump_stall(self, idle_s: float, stalled: dict) -> None:
+        threads = self._thread_stacks()
+        snap = _compile_snapshot()
+        attr = None
+        try:
+            from ccx.common import compilestats
+
+            attr = compilestats.attribution() or None
+        except Exception:  # noqa: BLE001
+            pass
+        rec = {
+            "ev": "watchdog", "stalled_s": round(idle_s, 1),
+            "spans": {str(k): v for k, v in stalled.items()},
+            **({"compile": snap} if snap else {}),
+            **({"compileAttribution": attr} if attr else {}),
+            "threads": threads,
+        }
+        with self._lock:
+            self._watchdog_dumps += 1
+        self._record(rec, event=False)
+        print(
+            f"[ccx-watchdog] no span event for {idle_s:.0f}s; stalled "
+            "spans: "
+            + "; ".join(
+                s[-1]["span"] for s in stalled.values()
+            ),
+            file=sys.stderr, flush=True,
+        )
+
+    # ----- export -----------------------------------------------------------
+
+    def last_tree(self) -> dict | None:
+        """Most recent completed ROOT span tree (any thread)."""
+        with self._lock:
+            return self._last_root
+
+    def recorder_state(self) -> dict:
+        with self._lock:
+            return {
+                "armed": self._fd is not None,
+                "path": self._path,
+                "records": self._records,
+            }
+
+    def observability_summary(self) -> dict:
+        """VIEWER-safe subset for ``AnalyzerState.observability``: arming /
+        watchdog / sync state plus the last completed span tree (same
+        sensitivity as the viewer-visible proposal result's ``spanTree``),
+        WITHOUT the recorder's server filesystem path or live span/thread
+        stacks — those are USER-gated on the /observability endpoint."""
+        state = self.recorder_state()
+        return {
+            "flightRecorder": {
+                "armed": state["armed"], "records": state["records"],
+            },
+            "watchdogSeconds": self._watchdog_s,
+            "watchdogDumps": self._watchdog_dumps,
+            "traceSync": self.sync,
+            "lastSpanTree": self.last_tree(),
+        }
+
+    def observability_json(self, threads: bool = False) -> dict:
+        """The REST observability block (AnalyzerState.observability and
+        the /observability endpoint): recorder + watchdog state, live span
+        stacks, the last completed span tree, live compile counters —
+        everything an operator needs to see INTO a wedged run."""
+        out = {
+            "flightRecorder": self.recorder_state(),
+            "watchdogSeconds": self._watchdog_s,
+            "watchdogDumps": self._watchdog_dumps,
+            "traceSync": self.sync,
+            "activeSpans": {
+                str(k): v for k, v in self._active().items()
+            },
+            "lastSpanTree": self.last_tree(),
+        }
+        snap = _compile_snapshot()
+        if snap is not None:
+            out["compile"] = snap
+            try:
+                from ccx.common import compilestats
+
+                out["compileAttribution"] = compilestats.attribution()
+            except Exception:  # noqa: BLE001
+                pass
+        if threads:
+            out["threads"] = self._thread_stacks()
+        return out
+
+
+#: the process-wide tracer (one flight recorder per process, like the one
+#: MetricRegistry — sidecar worker threads and the facade share it)
+TRACER = Tracer()
+
+
+def summarize(path: str) -> dict:
+    """Parse a flight-recorder JSONL into a dead-window diagnosis: last
+    record (phase/chunk/compile at death), open spans never closed,
+    watchdog dumps. Tolerates a torn final line (truncated write)."""
+    records: list[dict] = []
+    torn = 0
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                torn += 1
+    # segment at "arm" records: a shared campaign JSONL holds several
+    # processes' runs back to back, and a later healthy run's end records
+    # must not cancel an earlier crashed run's open spans — each segment
+    # keeps its own open-span ledger (the crashed rung's diagnosis is the
+    # whole point of the file)
+    segments: list[tuple[object, dict]] = []
+    cur_pid: object = None
+    cur_open: dict[str, dict] = {}
+    started = False
+    last_chunk: dict | None = None
+    watchdogs = []
+    for r in records:
+        ev = r.get("ev")
+        if ev == "arm":
+            if started:
+                segments.append((cur_pid, cur_open))
+            cur_pid, cur_open, started = r.get("pid"), {}, True
+        elif ev == "start":
+            started = True
+            cur_open[r.get("span", "?")] = r
+        elif ev == "end":
+            cur_open.pop(r.get("span", "?"), None)
+        elif ev == "chunk":
+            last_chunk = r
+        elif ev == "watchdog":
+            watchdogs.append(r)
+    segments.append((cur_pid, cur_open))
+    multi = len(segments) > 1
+    open_spans = sorted(
+        f"pid={pid} {span}" if multi and pid is not None else span
+        for pid, opens in segments for span in opens
+    )
+    return {
+        "records": len(records),
+        "runs": len(segments),
+        "tornLines": torn,
+        "last": records[-1] if records else None,
+        "lastChunk": last_chunk,
+        "openSpans": open_spans,
+        "watchdogDumps": len(watchdogs),
+        "lastWatchdog": watchdogs[-1] if watchdogs else None,
+    }
+
+
+def main(argv=None) -> int:
+    """``python -m ccx.common.tracing recording.jsonl`` — print the
+    diagnosis of a (possibly dead) run's flight recording."""
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) != 1:
+        print("usage: python -m ccx.common.tracing <recording.jsonl>",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(summarize(args[0]), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

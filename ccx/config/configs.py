@@ -330,6 +330,39 @@ def analyzer_config_def() -> ConfigDef:
     return d
 
 
+def observability_config_def() -> ConfigDef:
+    """Flight-recorder tracing keys (ccx.common.tracing; SURVEY.md §5.1
+    rebuild note — the host-side OperationProgress/Dropwizard analogue,
+    extended so a SIGKILLed TPU window still leaves a diagnosis)."""
+    d = ConfigDef()
+    d.define("observability.flight.recorder.path", Type.STRING, "",
+             Importance.MEDIUM,
+             "When non-empty, stream every span start/end, chunk heartbeat "
+             "and watchdog dump to this JSONL file (append + atomic "
+             "per-record write, so a killed or timed-out proposal run "
+             "leaves a file whose last line names the active phase, chunk "
+             "index and compile attribution at death — read it with "
+             "`python -m ccx.common.tracing <file>`). Empty = recorder "
+             "disarmed unless the CCX_FLIGHT_RECORDER env var is set.")
+    d.define("observability.watchdog.seconds", Type.DOUBLE, 0.0,
+             Importance.MEDIUM,
+             "Stall watchdog: when > 0 and no span event or chunk "
+             "heartbeat arrives for this long while spans are active, dump "
+             "all-thread stacks + the active span stacks + live "
+             "compilestats into the flight recorder (and stderr) — one "
+             "dump per stall episode, re-armed by the next heartbeat. 0 "
+             "disables (env override: CCX_WATCHDOG_SECONDS).", at_least(0))
+    d.define("observability.trace.sync", Type.BOOLEAN, False,
+             Importance.LOW,
+             "Device-honest span timing: drain the device stream "
+             "(block_until_ready on a freshly dispatched scalar) at every "
+             "span close, so per-phase walls measure device completion "
+             "rather than dispatch. Default off — syncing forfeits the "
+             "measured repair/anneal dispatch overlap; enable for TPU "
+             "timing studies only (env override: CCX_TRACE_SYNC=1).")
+    return d
+
+
 def executor_config_def() -> ConfigDef:
     d = ConfigDef()
     d.define("num.concurrent.partition.movements.per.broker", Type.INT, 5,
@@ -526,6 +559,7 @@ def cruise_control_config_def() -> ConfigDef:
     for sub in (
         monitor_config_def(),
         analyzer_config_def(),
+        observability_config_def(),
         executor_config_def(),
         anomaly_detector_config_def(),
         webserver_config_def(),

@@ -14,11 +14,13 @@ violation summary. This module is that entry point for the tensor model:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
 
 from ccx.common.profiling import annotate
+from ccx.common.tracing import TRACER
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import (
     DEFAULT_GOAL_ORDER,
@@ -70,6 +72,11 @@ class OptimizerResult:
     #: regressions (e.g. a swap acceptance collapse) are diagnosable from
     #: artifacts alone.
     move_counters: dict = dataclasses.field(default_factory=dict)
+    #: completed span tree of this optimize() call (ccx.common.tracing):
+    #: per-phase wall + chunk progress + compile attribution, the
+    #: flight-recorder view that rides BENCH lines and the sidecar result.
+    #: Volatile (timings) — stripped from golden wire fixtures.
+    span_tree: dict | None = None
     #: input placement, kept so the ClusterModelStats blocks (ref
     #: model/ClusterModelStats.java, SURVEY.md C4) can be derived lazily —
     #: computing them costs an aggregate pass + host transfer, which must not
@@ -137,6 +144,7 @@ class OptimizerResult:
                 k: round(v, 3) for k, v in self.phase_seconds.items()
             },
             "moveCounters": self.move_counters,
+            **({"spanTree": self.span_tree} if self.span_tree else {}),
             **(
                 {
                     "clusterModelStats": {
@@ -385,8 +393,32 @@ def optimize(
 
     ``progress_cb(phase: str)`` is invoked as each phase *starts* — the
     analogue of the reference's OperationProgress steps; bench/servlet use it
-    so a timed-out run still shows which phase it died in.
+    so a timed-out run still shows which phase it died in. The whole call
+    runs under a tracing root span (ccx.common.tracing): every phase is a
+    child span, chunk heartbeats stream to the flight recorder when armed,
+    and the completed tree rides out as ``OptimizerResult.span_tree`` — so
+    even a run that never returns leaves its diagnosis on disk.
     """
+    root = TRACER.start(
+        "optimize", kind="op",
+        P=int(m.P), B=int(m.B), goals=len(goal_names),
+    )
+    try:
+        res = _optimize(m, cfg, goal_names, opts, progress_cb)
+    finally:
+        # the root MUST close on every exit path — a leaked root would nest
+        # every later call on this thread under a dead tree
+        TRACER.end(root)
+    return dataclasses.replace(res, span_tree=root.to_json())
+
+
+def _optimize(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...],
+    opts: OptimizeOptions,
+    progress_cb,
+) -> OptimizerResult:
     t0 = time.monotonic()
     phases: dict[str, float] = {}
     kind_prop = [0, 0, 0]
@@ -398,10 +430,22 @@ def optimize(
             kind_prop[i] += int(r.n_prop_kind[i])
             kind_acc[i] += int(r.n_acc_kind[i])
 
-    def _enter(name: str) -> float:
+    @contextlib.contextmanager
+    def _phase(name: str, **attrs):
+        """One pipeline phase: OperationProgress callback, tracing span
+        (flight-recorder record; drive_chunks heartbeats attach here),
+        XProf annotation, and the phase_seconds entry. phase_seconds is
+        taken from the CLOSED span so observability.trace.sync makes the
+        headline per-phase numbers device-honest too, not just the tree."""
         if progress_cb is not None:
             progress_cb(name)
-        return time.monotonic()
+        s = TRACER.start(name, kind="phase", **attrs)
+        try:
+            with annotate(f"ccx:{name}"):
+                yield
+        finally:
+            TRACER.end(s)
+            phases[name] = s.wall_s
 
     stack_before = evaluate_stack(m, cfg, goal_names)
     inter = allows_inter_broker(goal_names)
@@ -414,8 +458,7 @@ def optimize(
     n_repair_lazy = None
     repair_box: dict = {}
     repair_thread = None
-    t = _enter("repair")
-    with annotate("ccx:repair"):
+    with _phase("repair", backend=opts.repair_backend, overlap=overlap):
         if overlap:
             # repair converges in the background while the first SA chunk
             # anneals the still-infeasible input state; the anneal phase
@@ -444,9 +487,12 @@ def optimize(
             n_repair = 0
         else:
             repaired, n_repair = hard_repair(m, cfg, goal_names)
-    phases["repair"] = time.monotonic() - t
-    t = _enter("anneal")
-    with annotate("ccx:anneal"):
+    with _phase(
+        "anneal",
+        chains=opts.anneal.n_chains,
+        steps=opts.anneal.n_steps,
+        chunkSteps=opts.anneal.chunk_steps,
+    ):
         if overlap:
             chunk = opts.anneal.chunk_steps
             sa1 = anneal(
@@ -493,7 +539,6 @@ def optimize(
             sa = anneal(repaired, cfg, goal_names, opts.anneal, evac=evac)
         else:
             sa = anneal(repaired, cfg, goal_names, opts.anneal)
-    phases["anneal"] = time.monotonic() - t
     _tally(sa)
     if n_repair_lazy is not None:
         # the anneal consumed the repaired arrays, so this sync is free
@@ -501,9 +546,8 @@ def optimize(
     model = sa.model
     stack_after = sa.stack_after
     n_polish = n_repair
-    t = _enter("polish")
-    if opts.run_polish:
-        with annotate("ccx:polish"):
+    with _phase("polish", iters=opts.polish.max_iters, run=opts.run_polish):
+        if opts.run_polish:
             polish = greedy_optimize(model, cfg, goal_names, opts.polish)
             _tally(polish)
             model = polish.model
@@ -523,25 +567,23 @@ def optimize(
                 model = polish.model
                 stack_after = polish.stack_after
                 n_polish += polish.n_moves
-    else:
-        # hard-violation recovery must not hinge on the polish flag: the
-        # lean rung skips the pre-shed polish (the topic-rebalance stage
-        # re-polishes instead), but residual post-SA hard violations still
-        # get the repair retries the polish block would have run
-        for _ in range(max(opts.max_repair_rounds - 1, 0)):
-            if float(stack_after.hard_violations) <= 0:
-                break
-            model, n_r = hard_repair(
-                model, cfg, goal_names, backend=opts.repair_backend
-            )
-            if n_r == 0:
-                break
-            n_polish += n_r
-            stack_after = evaluate_stack(model, cfg, goal_names)
-    phases["polish"] = time.monotonic() - t
+        else:
+            # hard-violation recovery must not hinge on the polish flag: the
+            # lean rung skips the pre-shed polish (the topic-rebalance stage
+            # re-polishes instead), but residual post-SA hard violations
+            # still get the repair retries the polish block would have run
+            for _ in range(max(opts.max_repair_rounds - 1, 0)):
+                if float(stack_after.hard_violations) <= 0:
+                    break
+                model, n_r = hard_repair(
+                    model, cfg, goal_names, backend=opts.repair_backend
+                )
+                if n_r == 0:
+                    break
+                n_polish += n_r
+                stack_after = evaluate_stack(model, cfg, goal_names)
     if opts.run_cold_greedy:
-        t = _enter("portfolio")
-        with annotate("ccx:portfolio"):
+        with _phase("portfolio"):
             cold = greedy_optimize(m, cfg, goal_names, opts.polish)
             _tally(cold)
             if _lex_better(cold.stack_after, stack_after):
@@ -551,7 +593,6 @@ def optimize(
                 # input placement) — report its move count, not the
                 # abandoned SA path's
                 n_polish = cold.n_moves
-        phases["portfolio"] = time.monotonic() - t
     if (
         opts.topic_rebalance_rounds > 0
         and "TopicReplicaDistributionGoal" in goal_names
@@ -564,8 +605,7 @@ def optimize(
         # improvement — a soft-goal sweep must never cost a higher tier.
         # Runs AFTER the portfolio selection so it applies to whichever
         # candidate won (a cold-greedy winner needs the stage most).
-        t = _enter("topic-rebalance")
-        with annotate("ccx:topic-rebalance"):
+        with _phase("topic-rebalance", rounds=opts.topic_rebalance_rounds):
             repolish = (
                 opts.polish
                 if opts.topic_rebalance_polish_iters is None
@@ -602,7 +642,7 @@ def optimize(
                 model = cand.model
                 stack_after = cand.stack_after
                 n_polish += n_swept + cand.n_moves
-        phases["topic-rebalance"] = time.monotonic() - t
+
     def _run_swap_polish(model_in, iters, phase_name):
         # usage-coupled swap polish: the count-preserving descent for the
         # residual NwOut/LeaderReplica cells single moves cannot reach
@@ -611,8 +651,7 @@ def optimize(
         # candidate budget splits evenly between replica-swap pairs and
         # leadership transfers, so the pre-leader and post-leader
         # invocations share ONE compiled program.
-        t_sp = _enter(phase_name)
-        with annotate(f"ccx:{phase_name}"):
+        with _phase(phase_name, iters=iters):
             ksw = max(opts.swap_polish_candidates // 2, 1)
             sp = swap_polish(
                 model_in, cfg, goal_names,
@@ -627,7 +666,6 @@ def optimize(
                 ),
             )
             _tally(sp)
-        phases[phase_name] = time.monotonic() - t_sp
         return sp
 
     if opts.swap_polish_iters > 0 and allows_inter_broker(goal_names):
@@ -648,8 +686,7 @@ def optimize(
         # final preferred-leadership pass over whichever candidate won:
         # greedy only applies lex-improving moves, so the result is adopted
         # unconditionally
-        t = _enter("leader-pass")
-        with annotate("ccx:leader-pass"):
+        with _phase("leader-pass"):
             lead = greedy_optimize(
                 model,
                 cfg,
@@ -670,7 +707,6 @@ def optimize(
             model = lead.model
             stack_after = lead.stack_after
             n_polish += lead.n_moves
-        phases["leader-pass"] = time.monotonic() - t
     if opts.swap_polish_post_iters > 0 and allows_inter_broker(goal_names):
         # post-leader invocation: the uniform leader pass stalls on the
         # LeaderReplica/LeaderBytesIn cells whose fix needs the coupled
@@ -685,27 +721,24 @@ def optimize(
     # exact final guarantee: fold leadership decisions into canonical
     # replica order (leader first), zeroing fixable PLE violations without
     # perturbing any other tier — see repair.finalize_preferred_leaders
-    t = _enter("preferred-leader")
-    model, stack_after, _ = finalize_preferred_leaders(
-        model, cfg, goal_names, stack_after
-    )
-    phases["preferred-leader"] = time.monotonic() - t
-    t = _enter("diff")
-    proposals = diff(m, model)
-    phases["diff"] = time.monotonic() - t
-    t = _enter("verify")
-    verification = verify_optimization(
-        m,
-        model,
-        cfg,
-        goal_names,
-        proposals=proposals,
-        require_hard_zero=opts.require_hard_zero,
-        check_evacuation=opts.check_evacuation,
-        stack_before=stack_before,
-        stack_after=stack_after,
-    )
-    phases["verify"] = time.monotonic() - t
+    with _phase("preferred-leader"):
+        model, stack_after, _ = finalize_preferred_leaders(
+            model, cfg, goal_names, stack_after
+        )
+    with _phase("diff"):
+        proposals = diff(m, model)
+    with _phase("verify"):
+        verification = verify_optimization(
+            m,
+            model,
+            cfg,
+            goal_names,
+            proposals=proposals,
+            require_hard_zero=opts.require_hard_zero,
+            check_evacuation=opts.check_evacuation,
+            stack_before=stack_before,
+            stack_after=stack_after,
+        )
     from ccx.common.metrics import REGISTRY
     from ccx.search.state import MOVE_KIND_NAMES
 

@@ -1412,9 +1412,21 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int):
     non-None truthy ``done`` ends the loop early — ONE scalar device→host
     sync per chunk, the early-exit check the monolithic while_loop used to
     do on device. SA chunks have no early exit and return ``done=None``
-    (no sync at all: the chunks stay queued on the device stream)."""
-    for off in range(0, max(int(total), 0), max(int(chunk), 1)):
+    (no sync at all: the chunks stay queued on the device stream).
+
+    Every chunk boundary emits a flight-recorder heartbeat (tracing): the
+    chunk index lands on the enclosing phase span and — when the recorder
+    is armed — in the JSONL, so a SIGKILLed run's last record names
+    exactly how deep into which phase it died, and the stall watchdog
+    re-arms on live progress. Host-side only (no device sync is added):
+    unarmed, the heartbeat is two attribute writes."""
+    from ccx.common.tracing import TRACER
+
+    step = max(int(chunk), 1)
+    n = max(int(total), 0)
+    for i, off in enumerate(range(0, n, step)):
         carry, done = run_one(carry, off)
+        TRACER.heartbeat(i, offset=off, total=n)
         if done is not None and bool(done):
             break
     return carry

@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ccx.common.tracing import TRACER
 from ccx.goals.base import GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
 from ccx.model.tensor_model import TensorClusterModel
@@ -709,28 +710,37 @@ def greedy_optimize(
     # is the ONE shape-bearing budget — kept in the chunk key, zeroed in
     # the monolith key (the while_loop never reads it).
     opts_key = dataclasses.replace(opts, max_iters=0, patience=0, seed=0)
-    if opts.chunk_iters > 0:
-        zero = jnp.asarray(0, jnp.int32)
-        carry = (_unalias_placement(state0), zero, zero, zero)
+    # shape-keyed descent span (see swap_polish): names the compiled
+    # program a stalled recording died inside; chunk heartbeats attach here
+    with TRACER.span(
+        "greedy-descent",
+        candidates=opts.n_candidates,
+        chunkIters=opts.chunk_iters,
+        maxIters=opts.max_iters,
+        leadershipOnly=lead_only,
+    ):
+        if opts.chunk_iters > 0:
+            zero = jnp.asarray(0, jnp.int32)
+            carry = (_unalias_placement(state0), zero, zero, zero)
 
-        def run_one(c, off):
-            *c2, done = _greedy_chunk(
-                *c, m, evac_j, n_evac_j, key0, mi, pat, guard,
-                goal_names=goal_names, cfg=cfg, pp=pp, opts=opts_key,
+            def run_one(c, off):
+                *c2, done = _greedy_chunk(
+                    *c, m, evac_j, n_evac_j, key0, mi, pat, guard,
+                    goal_names=goal_names, cfg=cfg, pp=pp, opts=opts_key,
+                    max_pt=max_pt,
+                )
+                return tuple(c2), done
+
+            state, n_iters, _, n_moves = drive_chunks(
+                run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
+            )
+        else:
+            state, n_iters, n_moves = _greedy_loop(
+                m, state0, evac_j, n_evac_j, key0, mi, pat, guard,
+                goal_names=goal_names, cfg=cfg, pp=pp,
+                opts=dataclasses.replace(opts_key, chunk_iters=0),
                 max_pt=max_pt,
             )
-            return tuple(c2), done
-
-        state, n_iters, _, n_moves = drive_chunks(
-            run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
-        )
-    else:
-        state, n_iters, n_moves = _greedy_loop(
-            m, state0, evac_j, n_evac_j, key0, mi, pat, guard,
-            goal_names=goal_names, cfg=cfg, pp=pp,
-            opts=dataclasses.replace(opts_key, chunk_iters=0),
-            max_pt=max_pt,
-        )
 
     result_model = with_placement(m, state)
     stack_after = evaluate_stack(result_model, cfg, goal_names)
@@ -1130,27 +1140,39 @@ def swap_polish(
     opts_key = dataclasses.replace(
         opts, max_iters=0, patience=0, seed=0, trd_guard=False
     )
-    if opts.chunk_iters > 0:
-        zero = jnp.asarray(0, jnp.int32)
-        carry = (_unalias_placement(state0), zero, zero, zero)
+    # shape-keyed descent span: attrs name the compiled-program shape
+    # (candidate counts + chunk size) so a flight recording of a stalled
+    # descent identifies WHICH program was being compiled/run — heartbeats
+    # from drive_chunks attach the live chunk index to this span
+    with TRACER.span(
+        "swap-polish-descent",
+        swapCandidates=opts.n_swap_candidates,
+        leadCandidates=opts.n_lead_candidates,
+        chunkIters=opts.chunk_iters,
+        maxIters=opts.max_iters,
+    ):
+        if opts.chunk_iters > 0:
+            zero = jnp.asarray(0, jnp.int32)
+            carry = (_unalias_placement(state0), zero, zero, zero)
 
-        def run_one(c, off):
-            *c2, done = _swap_polish_chunk(
-                *c, m, key0, mi, pat, guard,
-                goal_names=goal_names, cfg=cfg, opts=opts_key, max_pt=max_pt,
+            def run_one(c, off):
+                *c2, done = _swap_polish_chunk(
+                    *c, m, key0, mi, pat, guard,
+                    goal_names=goal_names, cfg=cfg, opts=opts_key,
+                    max_pt=max_pt,
+                )
+                return tuple(c2), done
+
+            state, n_iters, _, n_moves = drive_chunks(
+                run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
             )
-            return tuple(c2), done
-
-        state, n_iters, _, n_moves = drive_chunks(
-            run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
-        )
-    else:
-        state, n_iters, n_moves = _swap_polish_loop(
-            m, state0, key0, mi, pat, guard,
-            goal_names=goal_names, cfg=cfg,
-            opts=dataclasses.replace(opts_key, chunk_iters=0),
-            max_pt=max_pt,
-        )
+        else:
+            state, n_iters, n_moves = _swap_polish_loop(
+                m, state0, key0, mi, pat, guard,
+                goal_names=goal_names, cfg=cfg,
+                opts=dataclasses.replace(opts_key, chunk_iters=0),
+                max_pt=max_pt,
+            )
     result_model = with_placement(m, state)
     stack_after = evaluate_stack(result_model, cfg, goal_names)
     return GreedyResult(

@@ -25,6 +25,7 @@ from ccx.common.exceptions import (
 )
 from ccx.common import profiling
 from ccx.common.metrics import REGISTRY
+from ccx.common.tracing import TRACER
 
 #: the reference's separate operations log (SURVEY.md §5.1: log4j
 #: `operationLogger` recording every request/decision)
@@ -69,6 +70,31 @@ class CruiseControl:
         self._precompute_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._start_ms = self.clock()
+        # observability wiring (ccx.common.tracing): arm the flight
+        # recorder / stall watchdog / device-honest span timing from the
+        # observability.* keys (env CCX_FLIGHT_RECORDER et al. still apply
+        # when the keys are unset); live compile counters become /metrics
+        # gauges so a wedged run is observable from outside
+        from ccx.common import compilestats
+
+        # tri-state precedence: a key ABSENT from the operator's properties
+        # passes None (the env arming — CCX_FLIGHT_RECORDER et al. —
+        # survives facade construction); a key explicitly set wins over
+        # env, including explicit falsy values (watchdog.seconds=0 /
+        # trace.sync=false are documented off-switches)
+        def _explicit(key):
+            return (
+                config[key]
+                if key in getattr(config, "originals", {})
+                else None
+            )
+
+        TRACER.configure(
+            sync=_explicit("observability.trace.sync"),
+            watchdog_seconds=_explicit("observability.watchdog.seconds"),
+            path=config["observability.flight.recorder.path"] or None,
+        )
+        compilestats.export_gauges(REGISTRY)
 
     # ----- lifecycle (ref startUp order: monitor -> detector -> servlet) ----
 
@@ -205,11 +231,17 @@ class CruiseControl:
         )
 
     def _run_optimizer(self, model, goal_names, opts: OptimizeOptions,
-                       progress=None) -> OptimizerResult:
+                       progress=None, verb: str = "proposal") -> OptimizerResult:
         backend = self.config["goal.optimizer.backend"]
         if progress:
             progress.step(f"Optimizing ({backend} backend, {len(goal_names)} goals)")
+        # verb span: the facade layer of the span pipeline (verb →
+        # optimizer phases → chunk heartbeats → sidecar RPCs) — per-verb
+        # Prometheus histogram + the flight-recorder breadcrumb naming
+        # which operation a dead process was serving
         with REGISTRY.timer("proposal-computation").time(), \
+                TRACER.span(verb, kind="verb", backend=backend,
+                            goals=len(goal_names)), \
                 profiling.trace(self.config["optimizer.profile.dir"]):
             return self._run_optimizer_timed(model, goal_names, opts, progress, backend)
 
@@ -339,7 +371,7 @@ class CruiseControl:
         model = _restrict_destinations(model, metadata, destination_brokers)
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing),
-            self._optimize_options(), progress,
+            self._optimize_options(), progress, verb="rebalance",
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress,
                             replication_throttle)
@@ -363,7 +395,7 @@ class CruiseControl:
         )
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing),
-            self._optimize_options(), progress,
+            self._optimize_options(), progress, verb="add-brokers",
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress,
                             replication_throttle)
@@ -382,7 +414,7 @@ class CruiseControl:
         model = _restrict_destinations(model, metadata, destination_brokers)
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing),
-            self._optimize_options(), progress,
+            self._optimize_options(), progress, verb="remove-brokers",
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress,
                             replication_throttle)
@@ -400,7 +432,7 @@ class CruiseControl:
             model,
             ("StructuralFeasibility", "PreferredLeaderElectionGoal"),
             self._optimize_options(leadership_only=True),
-            progress,
+            progress, verb="demote-brokers",
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress)
 
@@ -412,7 +444,7 @@ class CruiseControl:
         model, metadata, gen = self._model(progress=progress)
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing=True),
-            self._optimize_options(), progress,
+            self._optimize_options(), progress, verb="fix-offline-replicas",
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress)
 
@@ -425,6 +457,7 @@ class CruiseControl:
         res = self._run_optimizer(
             model, INTRA_BROKER_GOAL_ORDER,
             self._optimize_options(disk_only=True), progress,
+            verb="rebalance-disk",
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress)
 
@@ -506,6 +539,14 @@ class CruiseControl:
         model, metadata, gen = self._model(progress=progress)
         return self.provisioner.rightsize(model).to_json()
 
+    def observability(self, include_threads: bool = False) -> dict:
+        """The flight-deck endpoint (GET /observability): tracer + flight-
+        recorder + watchdog state, live span stacks with chunk progress,
+        live compile counters, and — with ``threads=true`` — an all-thread
+        stack dump. Works DURING a wedged proposal: the optimizer holds no
+        lock this path needs, and a stuck device call releases the GIL."""
+        return TRACER.observability_json(threads=include_threads)
+
     # ----- cached proposals (ref GoalOptimizer precompute, C14) -------------
 
     def proposals(self, progress=None, ignore_cache: bool = False) -> dict:
@@ -521,7 +562,8 @@ class CruiseControl:
                 return out
         model, metadata, gen = self._model(progress=progress)
         res = self._run_optimizer(
-            model, self._resolve_goals(), self._optimize_options(), progress
+            model, self._resolve_goals(), self._optimize_options(), progress,
+            verb="proposals",
         )
         with self._proposal_lock:
             self._proposal_cache = res
@@ -596,6 +638,12 @@ class CruiseControl:
                             "optimizer.swap.polish.chunk.iters"
                         ],
                     },
+                    # flight-recorder / watchdog / span state (ccx.common.
+                    # tracing), VIEWER-safe summary: STATE is viewer-
+                    # readable, so this must not leak what security.py
+                    # gates at USER on /observability (recorder file path,
+                    # live span/thread stacks)
+                    "observability": TRACER.observability_summary(),
                 }
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self.anomaly_detector.state()

@@ -28,6 +28,7 @@ class EndPoint(enum.Enum):
     PERMISSIONS = "permissions"
     BOOTSTRAP = "bootstrap"
     TRAIN = "train"
+    OBSERVABILITY = "observability"
     # POST
     REBALANCE = "rebalance"
     ADD_BROKER = "add_broker"
@@ -48,7 +49,7 @@ GET_ENDPOINTS = frozenset(
         EndPoint.STATE, EndPoint.LOAD, EndPoint.PARTITION_LOAD,
         EndPoint.PROPOSALS, EndPoint.KAFKA_CLUSTER_STATE, EndPoint.USER_TASKS,
         EndPoint.REVIEW_BOARD, EndPoint.PERMISSIONS, EndPoint.BOOTSTRAP,
-        EndPoint.TRAIN,
+        EndPoint.TRAIN, EndPoint.OBSERVABILITY,
     }
 )
 POST_ENDPOINTS = frozenset(set(EndPoint) - GET_ENDPOINTS)
@@ -134,6 +135,12 @@ PARAMETERS: dict[EndPoint, tuple[ParamSpec, ...]] = {
     EndPoint.TRAIN: _COMMON + (
         ParamSpec("start", ParamType.INT, None),
         ParamSpec("end", ParamType.INT, None),
+    ),
+    # the flight deck (ccx.common.tracing): tracer/recorder/watchdog state,
+    # live span stacks + chunk progress, live compile counters; threads=true
+    # adds an all-thread stack dump — usable DURING a wedged proposal
+    EndPoint.OBSERVABILITY: _COMMON + (
+        ParamSpec("threads", ParamType.BOOLEAN, False),
     ),
     EndPoint.REBALANCE: _COMMON + _MUTATION + (
         ParamSpec("rebalance_disk", ParamType.BOOLEAN, False),

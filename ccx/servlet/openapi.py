@@ -46,6 +46,10 @@ _SUMMARY = {
     EndPoint.PERMISSIONS: "Caller's roles",
     EndPoint.BOOTSTRAP: "Replay a historical metric range into the monitor",
     EndPoint.TRAIN: "Fit the linear-regression CPU estimation model",
+    EndPoint.OBSERVABILITY: (
+        "Flight-recorder/tracing state: live span stacks, chunk progress, "
+        "compile counters, optional all-thread stack dump"
+    ),
     EndPoint.REBALANCE: "Compute (and optionally execute) a rebalance",
     EndPoint.ADD_BROKER: "Move replicas onto new brokers",
     EndPoint.REMOVE_BROKER: "Evacuate brokers before decommissioning",

@@ -32,7 +32,11 @@ _VIEWER_OK = frozenset(
         EndPoint.PERMISSIONS,
     }
 )
-_USER_OK = _VIEWER_OK | {EndPoint.USER_TASKS, EndPoint.REVIEW_BOARD}
+_USER_OK = _VIEWER_OK | {
+    EndPoint.USER_TASKS, EndPoint.REVIEW_BOARD,
+    # thread stack dumps + file paths: operator-grade, not viewer-grade
+    EndPoint.OBSERVABILITY,
+}
 # everything else (mutating POSTs, admin, review) needs ADMIN
 
 

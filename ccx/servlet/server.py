@@ -180,6 +180,8 @@ class CruiseControlApp:
         f = self.facade
         if endpoint is EndPoint.STATE:
             return f.state(params["substates"])
+        if endpoint is EndPoint.OBSERVABILITY:
+            return f.observability(include_threads=params["threads"])
         if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
             return f.kafka_cluster_state()
         if endpoint is EndPoint.PERMISSIONS:
@@ -381,11 +383,19 @@ def _make_handler(app: CruiseControlApp):
 
                         self._send(200, openapi_document(URL_PREFIX))
                     else:
-                        from ccx.common.metrics import REGISTRY
+                        from ccx.common import compilestats
+                        from ccx.common.metrics import (
+                            PROMETHEUS_CONTENT_TYPE,
+                            REGISTRY,
+                        )
 
+                        # live compile counters ride every scrape (idempotent
+                        # re-registration) — a wedged run's compile activity
+                        # is visible from outside the process
+                        compilestats.export_gauges(REGISTRY)
                         self._send_raw(
                             200, REGISTRY.render_prometheus().encode(),
-                            "text/plain; version=0.0.4",
+                            PROMETHEUS_CONTENT_TYPE,
                         )
                     return
                 if not parsed.path.startswith(URL_PREFIX + "/"):

@@ -22,6 +22,7 @@ import threading
 from concurrent import futures
 
 from ccx import __version__
+from ccx.common.tracing import TRACER
 from ccx.sidecar import GRPC_MESSAGE_OPTIONS
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER
@@ -208,6 +209,7 @@ class OptimizerSidecar:
         # client's partial dump, same as the in-process path)
         import queue as _queue
         import threading as _threading
+        import time as _time
 
         q: _queue.Queue = _queue.Queue()
         box: dict = {}
@@ -216,7 +218,7 @@ class OptimizerSidecar:
             try:
                 box["res"] = optimize(
                     model, self.goal_config, goals, opts,
-                    progress_cb=lambda p: q.put(p),
+                    progress_cb=lambda p: q.put(("phase", p)),
                 )
             except BaseException as e:  # re-raised below, at the RPC edge
                 box["err"] = e
@@ -225,11 +227,41 @@ class OptimizerSidecar:
 
         worker = _threading.Thread(target=_run, daemon=True)
         worker.start()
-        while True:
-            phase = q.get()
-            if phase is None:
-                break
-            yield wire.progress_frame(phase)
+        # chunk-heartbeat relay: tap the tracer's record stream for THIS
+        # worker's chunk events and forward them as structured progress
+        # frames (wire.heartbeat_frame), throttled to one per second so a
+        # 500-chunk anneal does not flood the stream — the JVM's
+        # OperationProgress sees live per-phase chunk progress instead of
+        # silence between phase boundaries
+        last_beat = [0.0]
+
+        def _tap(rec):
+            if rec.get("ev") != "chunk" or rec.get("tid") != worker.ident:
+                return
+            now = _time.monotonic()
+            if now - last_beat[0] >= 1.0:
+                last_beat[0] = now
+                q.put(("beat", rec))
+
+        TRACER.add_listener(_tap)
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                kind, payload = item
+                if kind == "phase":
+                    yield wire.progress_frame(payload)
+                else:
+                    yield wire.heartbeat_frame(
+                        f"{payload.get('span', '?')} chunk "
+                        f"{payload['chunk']}",
+                        span=payload.get("span"),
+                        chunk=payload["chunk"],
+                        total=payload.get("total"),
+                    )
+        finally:
+            TRACER.remove_listener(_tap)
         worker.join()
         if "err" in box:
             raise box["err"]
@@ -277,12 +309,22 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
     """Returns (grpc server, bound port)."""
     import grpc
 
-    sidecar = sidecar or OptimizerSidecar()
+    from ccx.common import compilestats
 
-    def unary(fn):
+    sidecar = sidecar or OptimizerSidecar()
+    # live compile counters as gauges on the process registry — whoever
+    # renders /metrics in this process sees compile activity mid-RPC
+    compilestats.export_gauges()
+
+    def unary(fn, rpc_name):
         def handler(request: bytes, context):
             try:
-                return fn(request)
+                # per-RPC span (kind="rpc"): Prometheus histogram per
+                # method + flight-recorder records naming which RPC a
+                # dead sidecar was serving
+                with TRACER.span(rpc_name, kind="rpc",
+                                 bytes=len(request or b"")):
+                    return fn(request)
             except Exception as e:  # noqa: BLE001 — RPC boundary
                 log.exception("rpc failed")
                 # structured detail: "<code>: <message>" so a client can
@@ -296,8 +338,10 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
 
     def propose_stream(request: bytes, context):
         try:
-            for update in sidecar.propose(request):
-                yield wire.pack_frame(update)
+            with TRACER.span("Propose", kind="rpc",
+                             bytes=len(request or b"")):
+                for update in sidecar.propose(request):
+                    yield wire.pack_frame(update)
         except Exception as e:  # noqa: BLE001
             log.exception("propose failed")
             yield wire.pack_frame(wire.error_frame(str(e), wire.code_of(e)))
@@ -308,11 +352,12 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
             response_serializer=_identity,
         ),
         "PutSnapshot": grpc.unary_unary_rpc_method_handler(
-            unary(sidecar.put_snapshot), request_deserializer=_identity,
+            unary(sidecar.put_snapshot, "PutSnapshot"),
+            request_deserializer=_identity,
             response_serializer=_identity,
         ),
         "Ping": grpc.unary_unary_rpc_method_handler(
-            unary(sidecar.ping), request_deserializer=_identity,
+            unary(sidecar.ping, "Ping"), request_deserializer=_identity,
             response_serializer=_identity,
         ),
     }

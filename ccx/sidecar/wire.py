@@ -211,6 +211,24 @@ def progress_frame(text: str) -> dict:
     return _stamped({"progress": text})
 
 
+def heartbeat_frame(text: str, span: str | None = None,
+                    chunk: int | None = None,
+                    total: int | None = None) -> dict:
+    """A progress frame carrying structured span context — the wire face
+    of the flight-recorder chunk heartbeats (ccx.common.tracing), so the
+    JVM's OperationProgress can show live per-phase chunk progress during
+    a long TPU window. Additive and wire-compatible: pre-observability
+    clients read only the ``progress`` text and ignore the extra keys."""
+    f: dict = {"progress": text}
+    if span is not None:
+        f["span"] = span
+    if chunk is not None:
+        f["chunk"] = int(chunk)
+    if total is not None:
+        f["total"] = int(total)
+    return _stamped(f)
+
+
 def result_frame(result: dict) -> dict:
     return _stamped({"result": result})
 

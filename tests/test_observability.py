@@ -1,0 +1,441 @@
+"""Flight-recorder tracing tests (ISSUE 5 acceptance criteria): the span
+pipeline, the crash-safe JSONL contract under SIGKILL, the stall watchdog,
+the zero-recompile overhead tripwire, and the Prometheus exposition format
+(# HELP/# TYPE, histogram invariants) the /metrics endpoint serves."""
+
+import json
+import math
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ccx.common import tracing
+from ccx.common.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    Histogram,
+    MetricsRegistry,
+)
+from ccx.common.tracing import TRACER
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """The tracer is process-global: every test leaves it disarmed with the
+    watchdog off so the rest of the suite runs untraced."""
+    yield
+    TRACER.disarm()
+    TRACER.set_watchdog(0)
+    TRACER.sync = False
+
+
+# ----- span model -----------------------------------------------------------
+
+def test_span_tree_nesting_and_attrs():
+    with TRACER.span("outer", kind="phase", P=8) as outer:
+        with TRACER.span("inner"):
+            TRACER.heartbeat(3, offset=30, total=100)
+    tree = outer.to_json()
+    assert tree["name"] == "outer"
+    assert tree["attrs"]["P"] == 8
+    assert tree["wallSeconds"] >= 0
+    (inner,) = tree["children"]
+    assert inner["name"] == "inner"
+    # the heartbeat attached the live chunk index to the innermost span
+    assert inner["attrs"]["chunk"] == 3
+    assert inner["attrs"]["chunkTotal"] == 100
+    # outer was a root: it becomes the last completed tree
+    assert TRACER.last_tree()["name"] == "outer"
+
+
+def test_span_end_closes_unwound_children():
+    outer = TRACER.start("outer")
+    TRACER.start("leaked")  # never ended (exception-unwind analogue)
+    TRACER.end(outer)
+    tree = outer.to_json()
+    assert tree["children"][0]["name"] == "leaked"
+    assert tree["children"][0]["wallSeconds"] is not None
+    # the thread stack is empty again — no dead-root nesting for later spans
+    with TRACER.span("fresh") as s:
+        pass
+    assert TRACER.last_tree()["name"] == "fresh"
+    assert s.path == "fresh"
+
+
+# ----- flight recorder ------------------------------------------------------
+
+def test_flight_recorder_stream(tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+    TRACER.arm(path)
+    with TRACER.span("alpha", kind="phase"):
+        TRACER.heartbeat(0, offset=0, total=4)
+        TRACER.heartbeat(1, offset=2, total=4)
+    TRACER.disarm()
+    recs = [json.loads(ln) for ln in open(path)]
+    evs = [r["ev"] for r in recs]
+    assert evs == ["arm", "start", "chunk", "chunk", "end"]
+    assert recs[0]["v"] == tracing.RECORDER_VERSION
+    assert recs[2]["span"] == "alpha" and recs[2]["chunk"] == 0
+    # heartbeats carry live compile counters — the "in-flight compile"
+    # attribution a dead window's last line must name
+    assert "compile" in recs[2]
+    assert recs[-1]["wall_s"] >= 0
+    assert all("t" in r and "tid" in r for r in recs)
+
+
+def test_summarize_tolerates_torn_final_line(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text(
+        json.dumps({"t": 1, "ev": "start", "span": "optimize"}) + "\n"
+        + json.dumps({"t": 2, "ev": "chunk", "span": "optimize/anneal",
+                      "chunk": 7}) + "\n"
+        + '{"t": 3, "ev": "chu'  # write torn mid-record by a crash
+    )
+    s = tracing.summarize(str(path))
+    assert s["records"] == 2 and s["tornLines"] == 1
+    assert s["lastChunk"]["chunk"] == 7
+    assert s["openSpans"] == ["optimize"]
+
+
+def test_summarize_segments_per_run(tmp_path):
+    """A shared campaign JSONL holds several runs: a later healthy run's
+    end records must not cancel a crashed earlier run's open spans."""
+    path = tmp_path / "campaign.jsonl"
+    lines = [
+        {"ev": "arm", "pid": 100},
+        {"ev": "start", "span": "optimize"},
+        {"ev": "start", "span": "optimize/anneal"},
+        {"ev": "chunk", "span": "optimize/anneal", "chunk": 9},
+        # rung killed here; next rung appends to the same file
+        {"ev": "arm", "pid": 200},
+        {"ev": "start", "span": "optimize"},
+        {"ev": "start", "span": "optimize/anneal"},
+        {"ev": "end", "span": "optimize/anneal"},
+        {"ev": "end", "span": "optimize"},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    s = tracing.summarize(str(path))
+    assert s["runs"] == 2
+    assert "pid=100 optimize/anneal" in s["openSpans"]
+    assert "pid=100 optimize" in s["openSpans"]
+    assert not any("pid=200" in o for o in s["openSpans"])
+
+
+def test_recorder_survives_sigkill_mid_anneal(tmp_path):
+    """The crash contract (acceptance criterion): SIGKILL a proposal run
+    mid-anneal; the JSONL must be fully parseable and its last record must
+    name the active phase, the chunk index, and the compile counters."""
+    path = str(tmp_path / "killed.jsonl")
+    child_src = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from ccx.goals.base import GoalConfig\n"
+        "from ccx.model.fixtures import small_deterministic\n"
+        "from ccx.optimizer import OptimizeOptions, optimize\n"
+        "from ccx.search.annealer import AnnealOptions\n"
+        "from ccx.search.greedy import GreedyOptions\n"
+        "optimize(\n"
+        "    small_deterministic(), GoalConfig(),\n"
+        "    ('StructuralFeasibility', 'ReplicaDistributionGoal'),\n"
+        "    OptimizeOptions(\n"
+        "        anneal=AnnealOptions(n_chains=2, n_steps=1_000_000,\n"
+        "                             chunk_steps=2, moves_per_step=1),\n"
+        "        polish=GreedyOptions(n_candidates=8, max_iters=2),\n"
+        "        require_hard_zero=False, run_cold_greedy=False,\n"
+        "        topic_rebalance_rounds=0, run_leader_pass=False,\n"
+        "    ),\n"
+        ")\n"
+    )
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", CCX_FLIGHT_RECORDER=path,
+        CCX_WATCHDOG_SECONDS="0",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        # wait for live anneal heartbeats, then kill mid-flight (the anneal
+        # budget is ~500k chunks — it can never finish on its own)
+        deadline = time.monotonic() + 180
+        beats = 0
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                beats = sum(
+                    1 for ln in open(path, errors="replace")
+                    if '"ev": "chunk"' in ln and "anneal" in ln
+                )
+                if beats >= 3:
+                    break
+            if proc.poll() is not None:
+                pytest.fail("child exited before any anneal heartbeat")
+            time.sleep(0.1)
+        assert beats >= 3, "no anneal heartbeats within the deadline"
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        proc.wait()
+    # every line parses: records are single O_APPEND os.write calls
+    recs = [json.loads(ln) for ln in open(path)]
+    assert len(recs) >= 5
+    last = recs[-1]
+    # the last record names the active phase, chunk index, and compile
+    # attribution at death — the diagnosis five TPU rounds never had
+    assert last["ev"] == "chunk"
+    assert last["span"].endswith("anneal")
+    assert isinstance(last["chunk"], int)
+    assert "compile" in last
+    s = tracing.summarize(path)
+    assert s["tornLines"] == 0
+    assert "optimize/anneal" in s["openSpans"]
+    assert s["lastChunk"]["chunk"] == last["chunk"]
+
+
+# ----- stall watchdog -------------------------------------------------------
+
+def test_watchdog_dumps_stall_once(tmp_path):
+    path = str(tmp_path / "stall.jsonl")
+    TRACER.arm(path)
+    TRACER.set_watchdog(0.3)
+    span = TRACER.start("wedged-phase", kind="phase")
+    try:
+        deadline = time.monotonic() + 10
+        dumps = []
+        while time.monotonic() < deadline and not dumps:
+            time.sleep(0.1)
+            dumps = [
+                json.loads(ln) for ln in open(path)
+                if '"ev": "watchdog"' in ln
+            ]
+        assert dumps, "watchdog never fired on a stalled span"
+        d = dumps[0]
+        assert d["stalled_s"] >= 0.3
+        # the active span stack names the wedged phase...
+        flat = [s["span"] for stack in d["spans"].values() for s in stack]
+        assert "wedged-phase" in flat
+        # ...and the all-thread stack dump includes this very test frame
+        assert any(
+            "test_observability" in ln
+            for stack in d["threads"].values() for ln in stack
+        )
+        # one dump per stall episode: the dump's own record must not count
+        # as liveness and re-trigger it
+        time.sleep(0.8)
+        n = sum(1 for ln in open(path) if '"ev": "watchdog"' in ln)
+        assert n == 1
+    finally:
+        TRACER.end(span)
+        TRACER.set_watchdog(0)
+        TRACER.disarm()
+
+
+def test_watchdog_not_masked_by_healthy_threads(tmp_path):
+    """Per-thread liveness: a healthy Ping-style span churn on one thread
+    must not mask another thread wedged mid-phase (the round-4 failure
+    mode: a 17-min compile while health checks keep arriving)."""
+    import threading
+
+    path = str(tmp_path / "masked.jsonl")
+    TRACER.arm(path)
+    TRACER.set_watchdog(0.4)
+    stop = threading.Event()
+
+    def healthy():
+        while not stop.is_set():
+            with TRACER.span("Ping", kind="rpc"):
+                pass
+            time.sleep(0.05)
+
+    def wedged():
+        span = TRACER.start("wedged-compile", kind="phase")
+        stop.wait(3.0)
+        TRACER.end(span)
+
+    threads = [threading.Thread(target=healthy),
+               threading.Thread(target=wedged)]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        dumps = []
+        while time.monotonic() < deadline and not dumps:
+            time.sleep(0.1)
+            dumps = [json.loads(ln) for ln in open(path)
+                     if '"ev": "watchdog"' in ln]
+        assert dumps, "healthy thread churn masked the wedged thread"
+        flat = [s["span"] for stack in dumps[0]["spans"].values()
+                for s in stack]
+        assert "wedged-compile" in flat
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        TRACER.set_watchdog(0)
+        TRACER.disarm()
+
+
+def test_state_observability_block_is_viewer_safe():
+    """AnalyzerState embeds the summary, not the full view: no recorder
+    filesystem path, no live span/thread stacks (USER-gated on the
+    /observability endpoint)."""
+    s = TRACER.observability_summary()
+    assert "path" not in s["flightRecorder"]
+    assert "activeSpans" not in s and "threads" not in s
+    assert set(s) >= {"flightRecorder", "watchdogSeconds", "traceSync"}
+
+
+# ----- overhead contract ----------------------------------------------------
+
+def test_spans_preserve_program_shapes(tmp_path):
+    """Zero-warm-fresh-compile tripwire: tracing (recorder armed) must not
+    perturb program shapes — the warm rerun pays no fresh XLA compile, and
+    the span tree rides the result."""
+    from ccx.common import compilestats
+    from ccx.goals.base import GoalConfig
+    from ccx.model.fixtures import small_deterministic
+    from ccx.optimizer import OptimizeOptions, optimize
+    from ccx.search.annealer import AnnealOptions
+    from ccx.search.greedy import GreedyOptions
+
+    m = small_deterministic()
+    goals = ("StructuralFeasibility", "ReplicaDistributionGoal")
+    opts = OptimizeOptions(
+        anneal=AnnealOptions(n_chains=2, n_steps=8, chunk_steps=4),
+        polish=GreedyOptions(n_candidates=8, max_iters=4, chunk_iters=2),
+        require_hard_zero=False, run_cold_greedy=False,
+        topic_rebalance_rounds=0,
+    )
+    TRACER.arm(str(tmp_path / "overhead.jsonl"))
+    res_cold = optimize(m, GoalConfig(), goals, opts)  # may compile
+    before = compilestats.snapshot()
+    res_warm = optimize(m, GoalConfig(), goals, opts)
+    delta = compilestats.delta(before, compilestats.snapshot())
+    TRACER.disarm()
+    assert delta["backend_compiles"] == 0, delta
+    for res in (res_cold, res_warm):
+        assert res.span_tree["name"] == "optimize"
+        names = [c["name"] for c in res.span_tree["children"]]
+        assert "anneal" in names and "verify" in names
+        # chunk progress landed on the anneal span
+        anneal = next(c for c in res.span_tree["children"]
+                      if c["name"] == "anneal")
+        assert anneal["attrs"]["chunk"] == 1  # 8 steps / 4-step chunks
+    assert res_warm.to_json(include_proposals=False)["spanTree"]
+
+
+# ----- Prometheus exposition ------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+
+
+def _parse_exposition(text: str) -> dict:
+    """Strict format check for the text exposition (version 0.0.4): every
+    sample must belong to a family declared by a preceding # TYPE, names
+    must be legal, histograms cumulative with a terminal +Inf."""
+    families: dict[str, dict] = {}
+    current = None
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert _NAME_RE.fullmatch(name), name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ", 3)
+            assert typ in ("counter", "gauge", "summary", "histogram"), typ
+            assert name not in families, f"duplicate TYPE for {name}"
+            current = families[name] = {"type": typ, "samples": {}}
+            current["name"] = name
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = re.fullmatch(
+            r"([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})? (\S+)", line
+        )
+        assert m, f"unparseable sample line: {line!r}"
+        sample, labels, value = m.group(1), m.group(2), float(m.group(3))
+        assert current is not None, f"sample before any TYPE: {line!r}"
+        fam = current["name"]
+        ok_suffixes = {
+            "counter": ("",),
+            "gauge": ("",),
+            "summary": ("_sum", "_count"),
+            "histogram": ("_bucket", "_sum", "_count"),
+        }[current["type"]]
+        assert any(
+            sample == fam + sfx for sfx in ok_suffixes
+        ), f"sample {sample} outside family {fam}"
+        current["samples"].setdefault(sample, []).append((labels, value))
+    for fam in families.values():
+        if fam["type"] == "histogram":
+            buckets = fam["samples"][fam["name"] + "_bucket"]
+            les = [lab.split('"')[1] for lab, _ in buckets]
+            counts = [v for _, v in buckets]
+            assert les[-1] == "+Inf"
+            assert counts == sorted(counts), "buckets must be cumulative"
+            (_, total), = fam["samples"][fam["name"] + "_count"]
+            assert counts[-1] == total, "+Inf bucket != count"
+    return families
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry(prefix="t")
+    reg.timer("proposal-computation", help="proposal wall").update(1.5)
+    reg.counter("operations").inc(3)
+    reg.gauge("compile-backend-compiles", lambda: 7.0, help="live compiles")
+    h = reg.histogram("phase-anneal-seconds", help="anneal phase wall")
+    for v in (0.004, 0.3, 2.0, 700.0):
+        h.observe(v)
+    fams = _parse_exposition(reg.render_prometheus())
+    assert fams["t_proposal_computation_seconds"]["type"] == "summary"
+    assert fams["t_operations_total"]["type"] == "counter"
+    (_, ops), = fams["t_operations_total"]["samples"]["t_operations_total"]
+    assert ops == 3
+    assert fams["t_phase_anneal_seconds"]["type"] == "histogram"
+    assert "0.0.4" in PROMETHEUS_CONTENT_TYPE
+
+
+def test_histogram_buckets_cumulative():
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["buckets"][0.1] == 1
+    assert snap["buckets"][1.0] == 2
+    assert snap["buckets"][10.0] == 3
+    assert snap["buckets"][math.inf] == 4
+    assert snap["sum"] == pytest.approx(55.55)
+
+
+def test_phase_histograms_recorded_on_span_close():
+    from ccx.common.metrics import REGISTRY
+
+    with TRACER.span("unit-test-phase", kind="phase"):
+        pass
+    snap = REGISTRY.snapshot()["histograms"]
+    assert snap["phase-unit-test-phase-seconds"]["count"] >= 1
+
+
+# ----- wire face ------------------------------------------------------------
+
+def test_heartbeat_frame_is_versioned_progress():
+    from ccx.sidecar import wire
+
+    f = wire.heartbeat_frame("anneal chunk 4", span="optimize/anneal",
+                             chunk=4, total=500)
+    # a heartbeat IS a progress frame (pre-observability clients read only
+    # the text) with structured span context on top
+    assert f["progress"] and f["wire"] == wire.WIRE_VERSION
+    assert f["span"] == "optimize/anneal"
+    assert f["chunk"] == 4 and f["total"] == 500
+    decoded = wire.decode_frame(wire.pack_frame(f))
+    assert decoded["chunk"] == 4

@@ -39,8 +39,10 @@ _BENCH_KNOBS = ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
                 "CCX_BENCH_POLISH_ITERS")
 #: volatile result keys excluded from the golden propose_result.json
 #: (phaseSeconds is per-phase wall clock — round 6: its unnoticed arrival
-#: in to_json had silently broken the replay test until regeneration here)
-VOLATILE = ("wallSeconds", "phaseSeconds")
+#: in to_json had silently broken the replay test until regeneration here;
+#: spanTree is the r9 observability block — per-phase walls, chunk
+#: progress and compile attribution, all timing-volatile by construction)
+VOLATILE = ("wallSeconds", "phaseSeconds", "spanTree")
 
 REQUEST_NAMES = ("ping_request.bin", "put_full_request.bin",
                  "put_delta_request.bin", "propose_request.bin")

@@ -16,8 +16,20 @@
 set -u
 cd "$(dirname "$0")/.."
 L="${1:-tpu_campaign.log}"
+# Flight recorder + stall watchdog for EVERY rung (ccx.common.tracing):
+# each python below auto-arms on these env vars, appending span starts/
+# ends, per-chunk heartbeats and watchdog stall dumps (all-thread stacks +
+# compile counters) to one crash-safe JSONL. A wedge, driver timeout or
+# SIGKILL anywhere in the campaign leaves a recording whose last line
+# names the phase, chunk index and compile attribution at death — read it
+# with `python -m ccx.common.tracing "$CCX_FLIGHT_RECORDER"`. 300 s
+# watchdog: longer than any healthy chunk, far shorter than the >17-min
+# compile the round-4 window died in.
+export CCX_FLIGHT_RECORDER="${CCX_FLIGHT_RECORDER:-tpu_flight_$(date -u +%Y%m%dT%H%M%SZ).jsonl}"
+export CCX_WATCHDOG_SECONDS="${CCX_WATCHDOG_SECONDS:-300}"
 {
   echo "=== TPU campaign start $(date -u +%FT%TZ) ==="
+  echo "flight recorder: $CCX_FLIGHT_RECORDER (watchdog ${CCX_WATCHDOG_SECONDS}s)"
   echo "--- probe ---"
   # Require an actual TPU device: a missing/failed axon plugin makes jax
   # fall back to CPU with rc=0, which would bank hours of CPU numbers as
@@ -96,5 +108,10 @@ L="${1:-tpu_campaign.log}"
       timeout -k 60 1800 python bench.py
     echo "$c rc=$?"
   done
+  echo "--- flight-recorder summary ---"
+  # one-line diagnosis of the whole campaign's recording (works the same
+  # when a wedge cut the campaign short and this block never ran — the
+  # JSONL itself is the artifact; this summary is a convenience)
+  timeout -k 10 60 python -m ccx.common.tracing "$CCX_FLIGHT_RECORDER"
   echo "=== TPU campaign end $(date -u +%FT%TZ) ==="
 } >> "$L" 2>&1
