@@ -41,8 +41,8 @@ the device-probe timeout; CCX_BENCH_FULL=1 forces the full rung even on the
 CPU fallback (by default the fallback runs only the target+lean rungs to
 fit the driver timeout on a much slower backend — fallback lines are NOT
 same-workload comparable with full-effort runs; identify them by the
-"backend" field's "(fallback: ...)" suffix and compare only equal "rung" +
-"effort" dicts, which are self-describing on every line);
+"backend_detail" field (present only on fallback lines) and compare only
+equal "rung" + "effort" dicts, which are self-describing on every line);
 CCX_BENCH_CPU_FIRST=0 disables the banking of a CPU baseline ladder
 (subprocess, CCX_BENCH_CPU_FIRST_TIMEOUT, default 900 s) before the TPU
 ladder on a healthy device (CCX_BENCH_SUBRUN marks that internal
@@ -63,13 +63,29 @@ automatic Pallas-MXU aggregates A/B (tools/probe_mxu.py, XLA twin vs
 kernel) that runs on a healthy TPU before the ladder.
 
 Observability: ``--samples N`` (or CCX_BENCH_SAMPLES) runs N warm samples
-per rung and puts min/median/max on the BENCH line (value = median;
-default 1 keeps driver timings single-sample). Every non-smoke rung line
-carries the warm run's "spanTree" (per-phase wall + chunk progress +
-compile attribution, ccx.common.tracing). Exporting CCX_FLIGHT_RECORDER=
-<path> (tools/tpu_campaign.sh does) streams every span/heartbeat to a
-crash-safe JSONL so even a SIGKILLed ladder leaves a per-chunk diagnosis;
-CCX_WATCHDOG_SECONDS arms the stall watchdog on top.
+per rung and puts min/median/max PLUS the raw "walls" sample list on the
+BENCH line (value = median; default 1 keeps driver timings
+single-sample — the ledger computes cross-round dispersion from the raw
+list). Every non-smoke rung line carries the warm run's "spanTree"
+(per-phase wall + chunk progress + compile attribution,
+ccx.common.tracing) and its "costModel" block (captured XLA
+FLOPs/bytes/HBM per program + roofline projections per phase,
+ccx.common.costmodel — cost capture is armed by default for the whole
+ladder, CCX_COST_CAPTURE=0 disables; capture itself runs only on the
+cold/prewarm path, never inside a warm timing). The rung's backend is
+structured: "backend" is the bare jax backend name and "backend_detail"
+carries the fallback reason when one applied (pre-round-10 lines glued
+both into one string — tools/bench_ledger.py parses either form).
+Exporting CCX_FLIGHT_RECORDER=<path> (tools/tpu_campaign.sh does)
+streams every span/heartbeat to a crash-safe JSONL so even a SIGKILLed
+ladder leaves a per-chunk diagnosis; CCX_WATCHDOG_SECONDS arms the
+stall watchdog on top. CCX_PROFILE_DIR=<dir> (the campaign exports it)
+captures a jax.profiler (XProf) device trace of the TARGET rung — one
+rung keeps the trace small — as one EXTRA warm run after the timed
+samples (trace overhead never pollutes the headline walls), TPU
+backends only (CPU tracing of a B5 program measured >10 min for no
+device timeline), with the trace path echoed into the flight-recorder
+JSONL (xprof-start/xprof-stop records).
 """
 
 from __future__ import annotations
@@ -434,6 +450,7 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
             "proposals": len(res.proposals),
             "phases": dict(res.phase_seconds),
             "span_tree": res.span_tree,
+            "cost_model": res.cost_model,
             "before": res.stack_before.by_name(),
             "after": res.stack_after.by_name(),
         }
@@ -466,6 +483,7 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
                 "proposals": int(res["numProposals"]),
                 "phases": dict(res.get("phaseSeconds", {})),
                 "span_tree": res.get("spanTree"),
+                "cost_model": res.get("costModel"),
                 "before": before,
                 "after": after,
             }
@@ -499,14 +517,38 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
     log(f"{tag}{name} cold={t_cold:.2f}s phases=" + " ".join(
         f"{k}={v:.2f}s" for k, v in r_cold["phases"].items()))
 
-    # --samples N: N warm runs, min/median/max on the BENCH line (VERDICT
-    # r5 weak #5 "single-sample driver number"). Default 1 keeps driver
-    # timings unchanged; the headline value is the MEDIAN warm wall.
+    # --samples N: N warm runs, min/median/max + the raw walls list on the
+    # BENCH line (VERDICT r5 weak #5 "single-sample driver number"; the
+    # ledger computes cross-round dispersion from the raw samples).
+    # Default 1 keeps driver timings unchanged; the headline value is the
+    # MEDIAN warm wall.
     n_samples = 1 if smoke else max(int(samples), 1)
     walls = []
     for i in range(n_samples):
         t_i, r = one_run("warm" if n_samples == 1 else f"warm{i + 1}")
         walls.append(t_i)
+    import jax as _jax
+
+    if (
+        rung == "target"
+        and os.environ.get("CCX_PROFILE_DIR")
+        and _jax.default_backend() == "tpu"
+    ):
+        # CCX_PROFILE_DIR: capture an XProf device trace of the TARGET
+        # rung only (one rung keeps the trace small) as one EXTRA warm
+        # run AFTER the timed samples — trace overhead must never pollute
+        # the headline walls the ledger gates at 10% (the campaign
+        # exports the env by default). TPU backends only: tracing a
+        # B5-size program on the CPU fallback is host-event collection of
+        # the entire interpreter — measured >10 min for a ~20 s run —
+        # with no device timeline to show for it. profiling.trace echoes
+        # the dir into the flight recorder (xprof-start/xprof-stop
+        # records).
+        from ccx.common.profiling import trace as xprof_trace
+
+        enter_phase(f"{tag}{name}:xprof")
+        with xprof_trace(os.environ["CCX_PROFILE_DIR"]):
+            one_run("warm-profiled")
     import statistics
 
     t_warm = statistics.median(walls)
@@ -550,6 +592,7 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
         "sidecar": sidecar_info,
         "effort": effort,
         "span_tree": r.get("span_tree"),
+        "cost_model": r.get("cost_model"),
         **(
             {
                 "samples": {
@@ -557,6 +600,9 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
                     "min": round(min(walls), 3),
                     "median": round(t_warm, 3),
                     "max": round(max(walls), 3),
+                    # the raw per-sample warm walls, in run order — the
+                    # ledger needs the distribution, not just its extremes
+                    "walls": [round(w, 3) for w in walls],
                 }
             }
             if n_samples > 1
@@ -938,6 +984,16 @@ def main() -> None:
     # PREWARM=0: that path's contract is banking a number FAST on a
     # disk-warm cache before the driver timeout); CCX_BENCH_PREWARM
     # overrides either way.
+    # Device cost observatory (ccx.common.costmodel): arm capture for the
+    # whole ladder so every program the prewarm (or a cold run) compiles
+    # also banks its XLA cost/memory record — the capture flush rides the
+    # optimizer's own cost-capture phase on the COLD path only, so warm
+    # timings never pay it. CCX_COST_CAPTURE=0 disables.
+    from ccx.common import costmodel
+
+    if os.environ.get("CCX_COST_CAPTURE") != "0":
+        costmodel.set_capture(True)
+
     if rungs and os.environ.get(
         "CCX_BENCH_PREWARM", "0" if probe_failed else "1"
     ) == "1":
@@ -991,6 +1047,9 @@ def main() -> None:
                 for k, v in compilestats.attribution().items()
                 if k.startswith("prewarm:")
             },
+            # cost-observatory coverage after the prewarm: every program
+            # the ladder will run should have a captured record by now
+            "cost_programs": len(costmodel.records()),
         }
         _state["prewarm"] = pw
         del m_pw
@@ -1011,11 +1070,15 @@ def main() -> None:
                 "verification_failures": r["failures"],
                 "proposals": r["proposals"],
                 "cold_s": round(r["cold"], 3),
-                "backend": jax.default_backend()
-                + (
-                    f" (fallback: {backend_forced})"
+                # structured backend: the bare jax backend name, with the
+                # fallback reason (when one applied) in its own field —
+                # the old glued "cpu (fallback: ...)" string is retired
+                # (tools/bench_ledger.py parses both forms)
+                "backend": jax.default_backend(),
+                **(
+                    {"backend_detail": f"fallback: {backend_forced}"}
                     if backend_forced
-                    else ""
+                    else {}
                 ),
                 "rung": rung,
                 "lean": rung == "lean",
@@ -1026,6 +1089,14 @@ def main() -> None:
                 # + compile attribution — ccx.common.tracing): the BENCH
                 # line now carries the flight-recorder view of the run
                 **({"spanTree": r["span_tree"]} if r.get("span_tree") else {}),
+                # ... and its cost-observatory block (ccx.common.costmodel):
+                # captured XLA FLOPs/bytes/HBM per program + per-phase
+                # roofline projections — the device-honest budget table
+                **(
+                    {"costModel": r["cost_model"]}
+                    if r.get("cost_model")
+                    else {}
+                ),
                 # cache hit-ness per run: a warm run with ANY fresh
                 # backend compile is a cache regression
                 # (tests/test_bench_contract.py pins warm == 0)

@@ -1,0 +1,665 @@
+"""Device cost observatory — XLA cost/memory accounting + roofline
+projections for every compiled program (ISSUE 6 tentpole).
+
+The flight recorder (``ccx.common.tracing``) says *where* a run was when it
+died; this module says *what the compiled programs cost*. Each jitted
+engine program (SA chunk, polish/swap-polish chunks, repair loop, stack
+eval, aggregates — see the ``instrument`` call sites) is wrapped so that:
+
+* every invocation is **counted** per (program label, argument-shape
+  signature) — a few tree-flatten attribute reads, no jax arrays touched,
+  so counting can never perturb program shapes or cost a warm rung a
+  recompile (pinned by tests/test_costmodel.py);
+* the first invocation of a new shape **enqueues a capture spec**
+  (``jax.ShapeDtypeStruct`` skeletons — the arrays themselves are never
+  retained); an explicit ``capture_pending()`` flush (the optimizer's
+  ``cost-capture`` phase, i.e. the bench prewarm-ledger seam and the
+  sidecar's compile path) then AOT-lowers and compiles each spec and
+  records ``compiled.cost_analysis()`` + ``compiled.memory_analysis()``:
+  per-program FLOPs, bytes accessed, argument/output/temp HBM.
+
+Capture is OFF by default (``set_capture`` / env ``CCX_COST_CAPTURE`` /
+config ``observability.cost.capture``): the AOT compile of an
+already-jitted program is one extra backend compile per program shape —
+served by the persistent compile cache when one is armed (bench always
+arms ``.jax_cache/``), charged to the ``costmodel:<label>`` compilestats
+attribution either way, and paid on the COLD path only. A warm run never
+captures (the shape key is already in the ledger), which is what the
+zero-warm-fresh-compile tripwire pins.
+
+Graceful degradation is the contract: CPU and TPU backends expose
+different ``cost_analysis`` key sets (CPU returns a list of per-partition
+dicts with ``flops``/``bytes accessed``; TPU may omit either or raise for
+helper executables) — a missing field records ``None``, an analysis
+failure records the error string, and nothing here ever raises into the
+optimizer.
+
+From the captured numbers plus a small device-spec table (v5e/v5p/v4
+peak FLOP/s + HBM GB/s, CPU host estimates; override via
+``observability.cost.peak.tflops`` / ``observability.cost.hbm.gbps``),
+``projection()`` computes roofline times — ``max(flops/peak,
+bytes/bandwidth)`` per call — per program and per device. One honesty
+caveat is handled explicitly: XLA's cost analysis counts a while/scan
+body ONCE, so call sites whose loop trip count is static program shape
+declare it via ``instrument(label, iters=...)`` and projections scale by
+it; traced-budget while_loops stay at 1 and their projections are
+explicit floors. The per-phase
+rollup rides ``OptimizerResult.costModel`` (BENCH lines, the sidecar
+result — VOLATILE in golden fixtures), the span tree (each phase span
+carries its executed programs' projected device seconds and HBM
+watermark), ``GET /observability`` and Prometheus gauges;
+``tools/bench_ledger.py --roofline`` renders it as the budget table that
+replaces the hand-summed one in docs/perf-notes.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+#: env switch for capture arming (config ``observability.cost.capture``
+#: takes precedence when a facade is constructed; bench/tools use the env)
+ENV_CAPTURE = "CCX_COST_CAPTURE"
+
+#: Device-spec table: peak dense FLOP/s (bf16 MXU peak for TPUs — the
+#: roofline ceiling XLA schedules against; the engine's f32 element-wise
+#: work runs below it, so projections are LOWER bounds) and HBM bytes/s.
+#: Sources: published v5e/v5p/v4 chip specs. The CPU row is an honest
+#: order-of-magnitude host estimate (few-GHz core × SIMD width, DDR
+#: stream bandwidth) — marked ``estimate`` and overridable.
+DEVICE_SPECS = {
+    "cpu": {"peakFlops": 5.0e10, "hbmBytesPerSec": 2.0e10, "estimate": True},
+    "tpu-v5e": {"peakFlops": 1.97e14, "hbmBytesPerSec": 8.19e11},
+    "tpu-v5p": {"peakFlops": 4.59e14, "hbmBytesPerSec": 2.765e12},
+    "tpu-v4": {"peakFlops": 2.75e14, "hbmBytesPerSec": 1.228e12},
+}
+
+#: device_kind substring -> spec key (first match wins, order matters:
+#: "v5 lite"/"v5e" must be tested before the bare "v5" of "v5p")
+_KIND_MATCHES = (
+    ("v5 lite", "tpu-v5e"),
+    ("v5e", "tpu-v5e"),
+    ("v5p", "tpu-v5p"),
+    ("v4", "tpu-v4"),
+    ("cpu", "cpu"),
+)
+
+_LOCK = threading.Lock()
+#: shape key -> cumulative invocation count (always on — the per-phase
+#: attribution the tracing spans difference)
+_CALLS: dict[str, int] = {}
+#: shape key -> captured record (see ``_capture_one``)
+_RECORDS: dict[str, dict] = {}
+#: shape key -> (label, fn, arg specs, kwargs) awaiting capture
+_PENDING: dict[str, tuple] = {}
+_CAPTURE = None  # tri-state: None = follow env, else explicit bool
+#: operator override of the CURRENT device's roofline ceilings
+#: (observability.cost.peak.tflops / observability.cost.hbm.gbps; 0=auto)
+_OVERRIDE: dict = {}
+#: serializes capture flushes (compiles can be slow; the counter lock
+#: must not be held across them)
+_CAPTURE_LOCK = threading.Lock()
+
+
+def set_capture(on: bool | None) -> None:
+    """Arm/disarm capture; ``None`` restores the env default."""
+    global _CAPTURE
+    _CAPTURE = on if on is None else bool(on)
+
+
+def capture_enabled() -> bool:
+    if _CAPTURE is not None:
+        return _CAPTURE
+    import os
+
+    return os.environ.get(ENV_CAPTURE) == "1"
+
+
+def set_device_override(peak_tflops: float = 0.0, hbm_gbps: float = 0.0) -> None:
+    """Operator roofline ceilings for the current device (config
+    ``observability.cost.peak.tflops`` / ``observability.cost.hbm.gbps``);
+    0 keeps the table value."""
+    with _LOCK:
+        _OVERRIDE.clear()
+        if peak_tflops and peak_tflops > 0:
+            _OVERRIDE["peakFlops"] = float(peak_tflops) * 1e12
+        if hbm_gbps and hbm_gbps > 0:
+            _OVERRIDE["hbmBytesPerSec"] = float(hbm_gbps) * 1e9
+
+
+def reset() -> None:
+    """Clear counters/ledger/pending (tests only — the ledger is
+    process-global by design, like compilestats)."""
+    with _LOCK:
+        _CALLS.clear()
+        _RECORDS.clear()
+        _PENDING.clear()
+
+
+# ----- instrumentation seam --------------------------------------------------
+
+
+def _leaf_sig(x) -> object:
+    """One leaf's contribution to the shape signature. Array-likes reduce
+    to (shape, dtype) — reading ``.shape``/``.dtype`` never touches device
+    data (works on donated/deleted buffers too). Hashable statics (opts
+    dataclasses, goal tuples) contribute their hash; anything else its
+    type name (conservative: distinct programs may share a key, which only
+    means one shared cost record)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype))
+    if isinstance(x, (int, float, bool, str, bytes, type(None))):
+        return repr(x)
+    try:
+        return f"{type(x).__name__}#{hash(x)}"
+    except TypeError:
+        return type(x).__name__
+
+
+def _spec_of(x):
+    """Capture-spec leaf: ShapeDtypeStruct skeleton for array-likes (no
+    buffer retained), the value itself otherwise (static kwargs, python
+    scalars — ``jit.lower`` accepts both)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        import jax
+
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+    return x
+
+
+class _Instrumented:
+    """Transparent wrapper around a jitted callable: counts invocations
+    per shape key and (capture armed) enqueues a one-time capture spec.
+    Attribute access (``.lower``, ``.clear_cache``, …) passes through.
+
+    ``iters``: XLA's cost analysis counts a while/scan BODY once — it
+    cannot know trip counts — so a chunk program's captured FLOPs/bytes
+    are per structure, not per execution. Where the trip count IS static
+    program shape (the SA chunk's ``chunk``, the descent engines'
+    ``chunk_iters``), the call site declares an extractor
+    ``iters(kwargs) -> int`` and projections scale flops/bytes by it;
+    programs without one (while_loop monoliths with traced budgets) stay
+    at 1, making their projections explicit floors."""
+
+    __slots__ = ("_fn", "_label", "_iters", "__wrapped__")
+
+    def __init__(self, label: str, fn, iters=None) -> None:
+        self._label = label
+        self._fn = fn
+        self._iters = iters
+        self.__wrapped__ = fn
+
+    def __call__(self, *args, **kwargs):
+        try:
+            self._observe(args, kwargs)
+        except Exception:  # noqa: BLE001 — accounting must never break
+            pass  # the engine (degradation contract, module docstring)
+        return self._fn(*args, **kwargs)
+
+    def _observe(self, args, kwargs) -> None:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig = repr((self._label, tuple(_leaf_sig(x) for x in leaves)))
+        digest = hashlib.blake2b(
+            (sig + str(treedef)).encode(), digest_size=6
+        ).hexdigest()
+        key = f"{self._label}#{digest}"
+        new = False
+        with _LOCK:
+            n = _CALLS.get(key, 0)
+            _CALLS[key] = n + 1
+            new = n == 0 and key not in _RECORDS and key not in _PENDING
+        if new and capture_enabled():
+            spec_args, spec_kwargs = jax.tree_util.tree_map(
+                _spec_of, (args, dict(kwargs))
+            )
+            loop_iters = 1
+            if self._iters is not None:
+                try:
+                    loop_iters = max(int(self._iters(kwargs)), 1)
+                except Exception:  # noqa: BLE001 — floor, never crash
+                    loop_iters = 1
+            with _LOCK:
+                if key not in _RECORDS and key not in _PENDING:
+                    _PENDING[key] = (
+                        self._label, self._fn, spec_args, spec_kwargs,
+                        loop_iters,
+                    )
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def instrument(label: str, iters=None):
+    """Decorator naming one engine program for the cost ledger:
+
+        @costmodel.instrument("sa-chunk", iters=lambda k: k["chunk"])
+        @functools.partial(jax.jit, ...)
+        def _run_chunk(...): ...
+
+    ``iters(kwargs) -> int`` declares the program's static loop trip
+    count (see ``_Instrumented``: XLA costs loop bodies once)."""
+
+    def deco(fn):
+        return _Instrumented(label, fn, iters=iters)
+
+    return deco
+
+
+# ----- capture ---------------------------------------------------------------
+
+
+def _normalize_cost(raw) -> tuple[dict, list[str], str | None]:
+    """cost_analysis() output -> (fields, raw key list, error). Backends
+    disagree on the container (CPU: list of per-partition dicts; TPU: one
+    dict or None) and on the key set — absent metrics become None, never
+    a crash."""
+    fields = {"flops": None, "bytesAccessed": None, "transcendentals": None}
+    if isinstance(raw, (list, tuple)):
+        # multi-partition executables return one dict per partition — sum
+        # numeric metrics across partitions (keeping only partition 0
+        # would silently under-report a sharded program by the partition
+        # count while still claiming capture)
+        dicts = [d for d in raw if isinstance(d, dict)]
+        if len(dicts) > 1:
+            merged: dict = {}
+            for d in dicts:
+                for k, v in d.items():
+                    if isinstance(v, (int, float)):
+                        merged[k] = merged.get(k, 0.0) + float(v)
+            raw = merged
+        else:
+            raw = dicts[0] if dicts else (raw[0] if raw else None)
+    if not isinstance(raw, dict):
+        return fields, [], None if raw is None else f"unexpected {type(raw).__name__}"
+    for out_key, src in (
+        ("flops", "flops"),
+        ("bytesAccessed", "bytes accessed"),
+        ("transcendentals", "transcendentals"),
+    ):
+        v = raw.get(src)
+        if isinstance(v, (int, float)):
+            fields[out_key] = float(v)
+    return fields, sorted(raw.keys()), None
+
+
+def _normalize_memory(stats) -> dict:
+    """memory_analysis() output -> byte fields (None where the backend
+    does not expose the attribute)."""
+    out = {}
+    for out_key, attr in (
+        ("argumentBytes", "argument_size_in_bytes"),
+        ("outputBytes", "output_size_in_bytes"),
+        ("tempBytes", "temp_size_in_bytes"),
+        ("aliasBytes", "alias_size_in_bytes"),
+        ("generatedCodeBytes", "generated_code_size_in_bytes"),
+    ):
+        v = getattr(stats, attr, None)
+        out[out_key] = float(v) if isinstance(v, (int, float)) else None
+    # peak resident HBM while the program runs: arguments + outputs +
+    # scratch, minus donated (aliased) buffers counted on both sides
+    known = [out[k] for k in ("argumentBytes", "outputBytes", "tempBytes")]
+    if any(v is not None for v in known):
+        peak = sum(v for v in known if v is not None)
+        if out["aliasBytes"] is not None:
+            peak -= out["aliasBytes"]
+        out["peakBytes"] = max(peak, 0.0)
+    else:
+        out["peakBytes"] = None
+    return out
+
+
+def _capture_one(key: str, label: str, fn, spec_args, spec_kwargs,
+                 loop_iters: int = 1) -> dict:
+    rec: dict = {
+        "label": label, "key": key,
+        "flops": None, "bytesAccessed": None, "transcendentals": None,
+        "argumentBytes": None, "outputBytes": None, "tempBytes": None,
+        "aliasBytes": None, "generatedCodeBytes": None, "peakBytes": None,
+        # declared static loop trip count (projections scale flops/bytes
+        # by it — XLA cost analysis counts a loop body once); 1 = none
+        # declared, the projection is a floor
+        "loopIters": max(int(loop_iters), 1),
+        "costKeys": [], "error": None,
+    }
+    t0 = time.monotonic()
+    try:
+        from ccx.common import compilestats
+
+        with compilestats.attributed(f"costmodel:{label}"):
+            compiled = fn.lower(*spec_args, **spec_kwargs).compile()
+        try:
+            fields, keys, err = _normalize_cost(compiled.cost_analysis())
+            rec.update(fields)
+            rec["costKeys"] = keys
+            if err:
+                rec["error"] = f"cost_analysis: {err}"
+        except Exception as e:  # noqa: BLE001 — degradation contract
+            rec["error"] = f"cost_analysis: {e}"
+        try:
+            rec.update(_normalize_memory(compiled.memory_analysis()))
+        except Exception as e:  # noqa: BLE001
+            rec["error"] = (
+                (rec["error"] + "; " if rec["error"] else "")
+                + f"memory_analysis: {e}"
+            )
+    except Exception as e:  # noqa: BLE001 — lower/compile itself failed
+        rec["error"] = f"lower/compile: {e}"
+    rec["captureSeconds"] = round(time.monotonic() - t0, 3)
+    return rec
+
+
+def capture_pending() -> int:
+    """Flush the pending-capture queue: AOT lower+compile each enqueued
+    shape spec and record its cost/memory analyses. Returns the number of
+    programs captured. The optimizer calls this from its ``cost-capture``
+    phase (cold path only — a warm run enqueues nothing); compile cost is
+    charged to ``costmodel:<label>`` attribution and served by the
+    persistent compile cache when armed. Never raises."""
+    with _CAPTURE_LOCK:
+        with _LOCK:
+            pending = dict(_PENDING)
+            _PENDING.clear()
+        n = 0
+        for key, (label, fn, spec_args, spec_kwargs, iters) in pending.items():
+            rec = _capture_one(key, label, fn, spec_args, spec_kwargs, iters)
+            with _LOCK:
+                _RECORDS[key] = rec
+            n += 1
+        return n
+
+
+def pending_count() -> int:
+    with _LOCK:
+        return len(_PENDING)
+
+
+def records() -> dict[str, dict]:
+    """The captured ledger (key -> record), a copy."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _RECORDS.items()}
+
+
+# ----- execution counters ----------------------------------------------------
+
+
+def exec_snapshot() -> dict[str, int]:
+    """Cumulative per-shape-key invocation counts (cheap dict copy — the
+    tracing spans snapshot this at start/end, like compilestats)."""
+    with _LOCK:
+        return dict(_CALLS)
+
+
+def exec_delta(before: dict[str, int]) -> dict[str, int]:
+    """Invocations since ``before`` (keys with a positive delta only)."""
+    now = exec_snapshot()
+    return {
+        k: n - before.get(k, 0) for k, n in now.items() if n > before.get(k, 0)
+    }
+
+
+# ----- roofline --------------------------------------------------------------
+
+
+def device_kind() -> str:
+    """The current backend's device kind string ('cpu', 'TPU v5 lite', …);
+    'unknown' when jax is unusable."""
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def spec_for(kind: str) -> dict | None:
+    """Device-spec row for a device_kind string (None = not in the table:
+    projections for the live device degrade to null, the fixed-table
+    projections below still apply)."""
+    k = kind.lower()
+    for needle, spec_key in _KIND_MATCHES:
+        if needle in k:
+            return {"key": spec_key, **DEVICE_SPECS[spec_key]}
+    return None
+
+
+def device_spec() -> dict:
+    """The CURRENT device's roofline ceilings: table row matched on
+    device_kind, operator overrides applied on top."""
+    kind = device_kind()
+    spec = spec_for(kind) or {"key": None, "peakFlops": None, "hbmBytesPerSec": None}
+    out = {"deviceKind": kind, **spec}
+    with _LOCK:
+        override = dict(_OVERRIDE)
+    if override:
+        out.update(override)
+        out["source"] = "override"
+    else:
+        out["source"] = "table" if spec.get("key") else "unknown"
+    return out
+
+
+def roofline_seconds(flops, bytes_accessed, spec: dict):
+    """max(flops/peak, bytes/bandwidth) — None when neither input or no
+    ceiling is known. Returns (seconds, bound) with bound one of
+    'compute'/'memory'/None."""
+    t_c = (
+        flops / spec["peakFlops"]
+        if flops is not None and spec.get("peakFlops")
+        else None
+    )
+    t_m = (
+        bytes_accessed / spec["hbmBytesPerSec"]
+        if bytes_accessed is not None and spec.get("hbmBytesPerSec")
+        else None
+    )
+    if t_c is None and t_m is None:
+        return None, None
+    if t_m is None:
+        return t_c, "compute"
+    if t_c is None:
+        return t_m, "memory"
+    return (t_m, "memory") if t_m >= t_c else (t_c, "compute")
+
+
+# ----- projections -----------------------------------------------------------
+
+
+def _round(v, nd=6):
+    return None if v is None else round(v, nd)
+
+
+def projection(delta: dict[str, int], specs: dict[str, dict] | None = None) -> dict:
+    """Roll an execution delta (shape key -> calls) up against the ledger:
+    per-program-label totals, roofline seconds per device spec, HBM
+    watermark, and coverage (calls whose program has no captured record
+    yet — the cold-run case — are counted, never guessed at)."""
+    if specs is None:
+        specs = {"device": device_spec()}
+    with _LOCK:
+        recs = {k: _RECORDS.get(k) for k in delta}
+    programs: dict[str, dict] = {}
+    totals = {"calls": 0, "flops": 0.0, "bytesAccessed": 0.0}
+    any_flops = any_bytes = False
+    peak = None
+    uncaptured_calls = 0
+    captured_programs = 0
+    for key, calls in delta.items():
+        rec = recs.get(key)
+        label = key.rsplit("#", 1)[0]
+        slot = programs.setdefault(
+            label,
+            {"calls": 0, "flops": None, "bytesAccessed": None,
+             "hbmPeakBytes": None, "captured": False},
+        )
+        slot["calls"] += calls
+        totals["calls"] += calls
+        if rec is None:
+            uncaptured_calls += calls
+            continue
+        captured_programs += 1
+        slot["captured"] = True
+        # flops/bytes scale by call count AND the declared static loop
+        # trip count (XLA costs a loop body once — _Instrumented.iters);
+        # the HBM watermark does NOT scale with iterations
+        mult = calls * rec.get("loopIters", 1)
+        if rec["flops"] is not None:
+            slot["flops"] = (slot["flops"] or 0.0) + rec["flops"] * mult
+            totals["flops"] += rec["flops"] * mult
+            any_flops = True
+        if rec["bytesAccessed"] is not None:
+            slot["bytesAccessed"] = (
+                (slot["bytesAccessed"] or 0.0) + rec["bytesAccessed"] * mult
+            )
+            totals["bytesAccessed"] += rec["bytesAccessed"] * mult
+            any_bytes = True
+        if rec["peakBytes"] is not None:
+            slot["hbmPeakBytes"] = max(slot["hbmPeakBytes"] or 0.0, rec["peakBytes"])
+            peak = max(peak or 0.0, rec["peakBytes"])
+    if not any_flops:
+        totals["flops"] = None
+    if not any_bytes:
+        totals["bytesAccessed"] = None
+    proj = {}
+    for name, spec in specs.items():
+        secs, bound = roofline_seconds(
+            totals["flops"], totals["bytesAccessed"], spec
+        )
+        proj[name] = {"seconds": _round(secs), "bound": bound}
+    for slot in programs.values():
+        slot["projectedSeconds"] = {
+            name: _round(
+                roofline_seconds(slot["flops"], slot["bytesAccessed"], spec)[0]
+            )
+            for name, spec in specs.items()
+        }
+    return {
+        "totals": {**totals, "hbmPeakBytes": peak},
+        "projected": proj,
+        "programs": programs,
+        "coverage": {
+            "programsExecuted": len(delta),
+            "programsCaptured": captured_programs,
+            "callsUncaptured": uncaptured_calls,
+        },
+    }
+
+
+def projection_compact(delta: dict[str, int]) -> dict | None:
+    """The span-sized rollup a phase span carries (ccx.common.tracing):
+    projected device seconds on the CURRENT device, HBM watermark, call
+    counts. None when the delta is empty (host-only phases)."""
+    if not delta:
+        return None
+    p = projection(delta)
+    dev = p["projected"].get("device", {})
+    out = {
+        "calls": p["totals"]["calls"],
+        # raw counters ride along so downstream consumers (the bench
+        # ledger's --roofline table) can re-project onto OTHER device
+        # specs without the per-program ledger
+        "flops": p["totals"]["flops"],
+        "bytesAccessed": p["totals"]["bytesAccessed"],
+        "projectedSeconds": dev.get("seconds"),
+        "bound": dev.get("bound"),
+        "hbmPeakBytes": p["totals"]["hbmPeakBytes"],
+    }
+    unc = p["coverage"]["callsUncaptured"]
+    if unc:
+        out["callsUncaptured"] = unc
+    return out
+
+
+#: the fixed projection targets every costModel block carries next to the
+#: live device: the T1 chase device (v5e) and the scale-up part (v5p)
+PROJECTION_TARGETS = ("tpu-v5e", "tpu-v5p")
+
+
+def _spec_table() -> dict[str, dict]:
+    specs = {"device": device_spec()}
+    for key in PROJECTION_TARGETS:
+        specs[key] = {"key": key, **DEVICE_SPECS[key]}
+    return specs
+
+
+def cost_model_json(delta: dict[str, int], span_tree: dict | None = None) -> dict:
+    """The ``OptimizerResult.costModel`` block: device spec + roofline
+    projections (live device and the fixed v5e/v5p targets) rolled up per
+    program and per phase. Per-phase rows come from the span tree's phase
+    children (each phase span carries its own exec-delta rollup).
+    VOLATILE in golden wire fixtures — machine-dependent by construction."""
+    specs = _spec_table()
+    p = projection(delta, specs=specs)
+    phases = {}
+    for child in (span_tree or {}).get("children", ()):
+        if child.get("kind") == "phase" and child.get("costModel"):
+            phases[child["name"]] = child["costModel"]
+    return {
+        "device": specs["device"],
+        "totals": p["totals"],
+        "projected": p["projected"],
+        "programs": p["programs"],
+        "coverage": p["coverage"],
+        **({"phases": phases} if phases else {}),
+    }
+
+
+# ----- export ----------------------------------------------------------------
+
+
+def summary() -> dict:
+    """Ledger view for ``GET /observability``: capture state, captured
+    records, live call totals."""
+    with _LOCK:
+        recs = {k: dict(v) for k, v in _RECORDS.items()}
+        calls = dict(_CALLS)
+        pending = len(_PENDING)
+    return {
+        "captureEnabled": capture_enabled(),
+        "device": device_spec(),
+        "programsSeen": len(calls),
+        "programsCaptured": len(recs),
+        "programsPending": pending,
+        "records": recs,
+        "calls": calls,
+    }
+
+
+def export_gauges(registry=None) -> None:
+    """Cost-observatory gauges for /metrics (idempotent, like
+    ``compilestats.export_gauges``): captured/pending program counts and
+    the cumulative projected device seconds of everything executed so far
+    — a projected-seconds gauge far below wall-clock under a flat
+    heartbeat is the 'host-bound, not device-bound' signature."""
+    if registry is None:
+        from ccx.common.metrics import REGISTRY as registry  # noqa: N811
+
+    def _projected_total() -> float:
+        with _LOCK:
+            calls = dict(_CALLS)
+        p = projection(calls)
+        dev = p["projected"].get("device", {})
+        return float(dev.get("seconds") or 0.0)
+
+    registry.gauge(
+        "cost-programs-captured",
+        lambda: float(len(_RECORDS)),
+        help="program shapes with a captured XLA cost/memory record",
+    )
+    registry.gauge(
+        "cost-programs-pending",
+        lambda: float(pending_count()),
+        help="program shapes enqueued for cost capture",
+    )
+    registry.gauge(
+        "cost-projected-device-seconds",
+        _projected_total,
+        help="roofline-projected device seconds of all instrumented "
+        "program executions so far (current device spec)",
+    )

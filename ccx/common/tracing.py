@@ -93,7 +93,7 @@ class Span:
 
     __slots__ = (
         "name", "kind", "path", "attrs", "children", "t_wall",
-        "t0", "wall_s", "compile0", "compile", "done",
+        "t0", "wall_s", "compile0", "compile", "cost0", "cost_delta", "done",
     )
 
     def __init__(self, name: str, kind: str | None, path: str,
@@ -108,6 +108,8 @@ class Span:
         self.wall_s: float | None = None
         self.compile0 = compile0
         self.compile: dict | None = None
+        self.cost0 = _cost_snapshot()
+        self.cost_delta: dict | None = None
         self.done = False
 
     def to_json(self) -> dict:
@@ -120,6 +122,12 @@ class Span:
             out["attrs"] = dict(self.attrs)
         if self.compile:
             out["compile"] = self.compile
+        cost = _cost_compact(self.cost_delta)
+        if cost:
+            # expected device time + HBM watermark of the programs this
+            # span executed (ccx.common.costmodel roofline) — the
+            # quantitative half of the flight-recorder readout
+            out["costModel"] = cost
         if self.children:
             out["children"] = [c.to_json() for c in self.children]
         return out
@@ -132,6 +140,43 @@ def _compile_snapshot() -> dict | None:
         from ccx.common import compilestats
 
         return compilestats.snapshot()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _cost_snapshot() -> dict | None:
+    """Live cost-observatory execution counters (ccx.common.costmodel) —
+    None-tolerant for dependency-light tools, same as compile counters."""
+    try:
+        from ccx.common import costmodel
+
+        return costmodel.exec_snapshot()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _cost_exec_delta(before: dict | None) -> dict | None:
+    if before is None:
+        return None
+    try:
+        from ccx.common import costmodel
+
+        return costmodel.exec_delta(before) or None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _cost_compact(delta: dict | None) -> dict | None:
+    """Span-sized cost rollup: the phase's expected device seconds + HBM
+    watermark. Computed lazily (at to_json/record time) so a cold run's
+    spans pick up records the end-of-run capture flush banked AFTER the
+    span closed."""
+    if not delta:
+        return None
+    try:
+        from ccx.common import costmodel
+
+        return costmodel.projection_compact(delta)
     except Exception:  # noqa: BLE001
         return None
 
@@ -293,6 +338,7 @@ class Tracer:
             _device_sync()
         span.wall_s = time.monotonic() - span.t0
         span.compile = _compile_delta(span.compile0)
+        span.cost_delta = _cost_exec_delta(span.cost0)
         span.done = True
         st = getattr(self._tl, "stack", None)
         root_closed = False
@@ -307,10 +353,15 @@ class Tracer:
             if st and st[-1] is span:
                 st.pop()
             root_closed = not st
+        cost = _cost_compact(span.cost_delta)
         self._record({
             "ev": "end", "span": span.path,
             "wall_s": round(span.wall_s, 4),
             **({"compile": span.compile} if span.compile else {}),
+            # expected device seconds + HBM watermark for the programs the
+            # span ran: a later wedge in the SAME phase reads its expected
+            # cost off this record (summarize() joins them)
+            **({"cost": cost} if cost else {}),
         })
         if root_closed:
             # root closed: bank the tree and deregister this thread's
@@ -589,6 +640,15 @@ class Tracer:
                 out["compileAttribution"] = compilestats.attribution()
             except Exception:  # noqa: BLE001
                 pass
+        try:
+            from ccx.common import costmodel
+
+            # the cost observatory's ledger (captured per-program XLA
+            # cost/memory records + device roofline spec): the flight
+            # deck's quantitative half
+            out["costModel"] = costmodel.summary()
+        except Exception:  # noqa: BLE001
+            pass
         if threads:
             out["threads"] = self._thread_stacks()
         return out
@@ -625,6 +685,11 @@ def summarize(path: str) -> dict:
     started = False
     last_chunk: dict | None = None
     watchdogs = []
+    #: span path -> most recent end record's cost block (any segment): a
+    #: completed run of the same phase earlier in the file — the prewarm
+    #: or cold pass — prices what an open-at-death span was expected to
+    #: cost (device seconds + HBM watermark, ccx.common.costmodel)
+    last_cost: dict[str, dict] = {}
     for r in records:
         ev = r.get("ev")
         if ev == "arm":
@@ -636,6 +701,8 @@ def summarize(path: str) -> dict:
             cur_open[r.get("span", "?")] = r
         elif ev == "end":
             cur_open.pop(r.get("span", "?"), None)
+            if r.get("cost"):
+                last_cost[r.get("span", "?")] = r["cost"]
         elif ev == "chunk":
             last_chunk = r
         elif ev == "watchdog":
@@ -646,6 +713,11 @@ def summarize(path: str) -> dict:
         f"pid={pid} {span}" if multi and pid is not None else span
         for pid, opens in segments for span in opens
     )
+    expected_cost = {
+        span: last_cost[span]
+        for pid, opens in segments for span in opens
+        if span in last_cost
+    }
     return {
         "records": len(records),
         "runs": len(segments),
@@ -653,6 +725,9 @@ def summarize(path: str) -> dict:
         "last": records[-1] if records else None,
         "lastChunk": last_chunk,
         "openSpans": open_spans,
+        # expected device time + HBM watermark for spans open at death,
+        # priced from the same phase's last completed run in this file
+        "expectedCost": expected_cost,
         "watchdogDumps": len(watchdogs),
         "lastWatchdog": watchdogs[-1] if watchdogs else None,
     }

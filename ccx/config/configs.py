@@ -360,6 +360,30 @@ def observability_config_def() -> ConfigDef:
              "rather than dispatch. Default off — syncing forfeits the "
              "measured repair/anneal dispatch overlap; enable for TPU "
              "timing studies only (env override: CCX_TRACE_SYNC=1).")
+    d.define("observability.cost.capture", Type.BOOLEAN, False,
+             Importance.MEDIUM,
+             "Device cost observatory (ccx.common.costmodel): capture "
+             "compiled.cost_analysis()/memory_analysis() for every NEW "
+             "program shape the optimizer runs — per-program XLA FLOPs, "
+             "bytes accessed and argument/output/temp HBM, rolled up as "
+             "the costModel block on every proposal result, the "
+             "/observability ledger, and roofline-projected per phase. "
+             "The capture flush is one extra AOT compile per program "
+             "shape (served by the persistent compile cache when armed), "
+             "paid on the cold path only — warm runs never capture. "
+             "Default off for embedded use; bench.py and the standalone "
+             "sidecar arm it (env override: CCX_COST_CAPTURE=1/0).")
+    d.define("observability.cost.peak.tflops", Type.DOUBLE, 0.0,
+             Importance.LOW,
+             "Roofline ceiling override for the CURRENT device: peak "
+             "TFLOP/s used by the cost model's projections. 0 = use the "
+             "built-in device-spec table (v5e/v5p/v4 published peaks, "
+             "order-of-magnitude CPU host estimate).", at_least(0))
+    d.define("observability.cost.hbm.gbps", Type.DOUBLE, 0.0,
+             Importance.LOW,
+             "Roofline ceiling override for the CURRENT device: HBM "
+             "bandwidth in GB/s used by the cost model's projections. "
+             "0 = use the built-in device-spec table.", at_least(0))
     return d
 
 

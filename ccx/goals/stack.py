@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from ccx.common import costmodel
 from ccx.goals import kernels  # noqa: F401  (populates the registry)
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.model.aggregates import BrokerAggregates, broker_aggregates
@@ -141,11 +142,13 @@ def _evaluate(m, agg, cfg, goal_names) -> StackResult:
     )
 
 
+@costmodel.instrument("stack-eval")
 @functools.partial(jax.jit, static_argnames=("cfg", "goal_names"))
 def _evaluate_no_agg(m, *, cfg, goal_names) -> StackResult:
     return _evaluate(m, broker_aggregates(m), cfg, goal_names)
 
 
+@costmodel.instrument("stack-eval-agg")
 @functools.partial(jax.jit, static_argnames=("cfg", "goal_names"))
 def _evaluate_with_agg(m, agg, *, cfg, goal_names) -> StackResult:
     return _evaluate(m, agg, cfg, goal_names)

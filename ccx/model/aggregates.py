@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from ccx.common import costmodel
 from ccx.common.resources import NUM_RESOURCES, Resource
 from ccx.model.tensor_model import TensorClusterModel
 
@@ -106,4 +107,6 @@ def _broker_aggregates_xla(m: TensorClusterModel) -> BrokerAggregates:
 #: Jitted entry for host-side callers (e.g. hot-partition targeting) — an
 #: eager call dispatches every op separately and recomputes per invocation;
 #: the jitted form compiles once per shape and fuses the segment-sums.
-broker_aggregates_jit = jax.jit(broker_aggregates)
+broker_aggregates_jit = costmodel.instrument("broker-aggregates")(
+    jax.jit(broker_aggregates)
+)

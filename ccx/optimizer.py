@@ -19,6 +19,7 @@ import dataclasses
 import threading
 import time
 
+from ccx.common import costmodel
 from ccx.common.profiling import annotate
 from ccx.common.tracing import TRACER
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
@@ -77,6 +78,12 @@ class OptimizerResult:
     #: flight-recorder view that rides BENCH lines and the sidecar result.
     #: Volatile (timings) — stripped from golden wire fixtures.
     span_tree: dict | None = None
+    #: device cost observatory block (ccx.common.costmodel): captured XLA
+    #: FLOPs/bytes/HBM per program executed by this run, roofline
+    #: projections (live device + v5e/v5p), per-phase rollup. Rides BENCH
+    #: lines and the sidecar result; VOLATILE in golden wire fixtures
+    #: (machine-dependent by construction).
+    cost_model: dict | None = None
     #: input placement, kept so the ClusterModelStats blocks (ref
     #: model/ClusterModelStats.java, SURVEY.md C4) can be derived lazily —
     #: computing them costs an aggregate pass + host transfer, which must not
@@ -145,6 +152,7 @@ class OptimizerResult:
             },
             "moveCounters": self.move_counters,
             **({"spanTree": self.span_tree} if self.span_tree else {}),
+            **({"costModel": self.cost_model} if self.cost_model else {}),
             **(
                 {
                     "clusterModelStats": {
@@ -399,6 +407,7 @@ def optimize(
     and the completed tree rides out as ``OptimizerResult.span_tree`` — so
     even a run that never returns leaves its diagnosis on disk.
     """
+    cost0 = costmodel.exec_snapshot()
     root = TRACER.start(
         "optimize", kind="op",
         P=int(m.P), B=int(m.B), goals=len(goal_names),
@@ -409,7 +418,12 @@ def optimize(
         # the root MUST close on every exit path — a leaked root would nest
         # every later call on this thread under a dead tree
         TRACER.end(root)
-    return dataclasses.replace(res, span_tree=root.to_json())
+    # span_tree is rendered AFTER the run's cost-capture phase flushed the
+    # ledger, so even the cold run's phase spans price their programs; the
+    # costModel block rolls the same ledger up per program and per phase
+    tree = root.to_json()
+    cost_model = costmodel.cost_model_json(costmodel.exec_delta(cost0), tree)
+    return dataclasses.replace(res, span_tree=tree, cost_model=cost_model)
 
 
 def _optimize(
@@ -739,6 +753,17 @@ def _optimize(
             stack_before=stack_before,
             stack_after=stack_after,
         )
+    if costmodel.capture_enabled() and costmodel.pending_count():
+        # the bench prewarm-ledger seam / the sidecar's compile path: AOT
+        # lower+compile every NEW program shape this run executed (verify
+        # included) and bank its cost_analysis/memory_analysis record
+        # (ccx.common.costmodel). Cold path only — a warm run enqueues
+        # nothing and skips the phase entirely, which keeps cost capture
+        # out of warm timings (and the zero-warm-fresh-compile tripwire
+        # green). A pathological compile surfaces HERE with its own phase
+        # breadcrumb, never inside a later timed rung.
+        with _phase("cost-capture", pending=costmodel.pending_count()):
+            costmodel.capture_pending()
     from ccx.common.metrics import REGISTRY
     from ccx.search.state import MOVE_KIND_NAMES
 

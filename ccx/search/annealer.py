@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ccx.common import costmodel
 from ccx.common.resources import Resource
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack, soft_weights
@@ -315,6 +316,7 @@ def hot_partition_list(
     return _pad_fixed(idx, bucket if len(idx) <= bucket else m.P)
 
 
+@costmodel.instrument("hot-list")
 @functools.partial(jax.jit, static_argnames=("goal_names", "cfg"))
 def hot_partition_list_device(
     m: TensorClusterModel,
@@ -1390,6 +1392,7 @@ def _build_step(
     return step, group
 
 
+@costmodel.instrument("chain-init")
 @functools.partial(jax.jit, static_argnames=("goal_names", "cfg", "max_pt"))
 def _init_chains(
     m: TensorClusterModel,
@@ -1432,6 +1435,7 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int):
     return carry
 
 
+@costmodel.instrument("sa-chunk", iters=lambda k: k["chunk"])
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -1494,6 +1498,7 @@ def _run_chunk(
     return states
 
 
+@costmodel.instrument("sa-monolith", iters=lambda k: k["opts"].n_steps)
 @functools.partial(
     jax.jit,
     static_argnames=("goal_names", "cfg", "opts", "p_real", "b_real", "max_pt"),

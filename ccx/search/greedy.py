@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ccx.common import costmodel
 from ccx.common.tracing import TRACER
 from ccx.goals.base import GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack
@@ -578,6 +579,7 @@ def _make_greedy_iter(
     return cond, body
 
 
+@costmodel.instrument("polish-loop")
 @functools.partial(
     jax.jit, static_argnames=("goal_names", "cfg", "pp", "opts", "max_pt")
 )
@@ -610,6 +612,7 @@ def _greedy_loop(
     return state, n_iters, n_moves
 
 
+@costmodel.instrument("polish-chunk", iters=lambda k: k["opts"].chunk_iters)
 @functools.partial(
     jax.jit,
     static_argnames=("goal_names", "cfg", "pp", "opts", "max_pt"),
@@ -1046,6 +1049,7 @@ def _make_swap_iter(
     return cond, body
 
 
+@costmodel.instrument("swap-polish-loop")
 @functools.partial(
     jax.jit, static_argnames=("goal_names", "cfg", "opts", "max_pt")
 )
@@ -1075,6 +1079,9 @@ def _swap_polish_loop(
     return state, n_iters, n_moves
 
 
+@costmodel.instrument(
+    "swap-polish-chunk", iters=lambda k: k["opts"].chunk_iters
+)
 @functools.partial(
     jax.jit,
     static_argnames=("goal_names", "cfg", "opts", "max_pt"),

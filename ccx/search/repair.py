@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ccx.common import costmodel
 from ccx.goals.base import GoalConfig
 from ccx.model.tensor_model import TensorClusterModel, build_model
 from ccx.search.annealer import (
@@ -260,12 +261,13 @@ def _sweep_impl(
 #: host-path entry: one jitted sweep per call (the round-2 design; the
 #: hard_repair loop around it syncs n_moved per sweep). The device path
 #: compiles the same body inside `_repair_loop`'s while_loop instead.
-_sweep = jax.jit(
+_sweep = costmodel.instrument("repair-sweep")(jax.jit(
     _sweep_impl,
     static_argnames=("target_rack", "target_capacity", "cfg", "nk"),
-)
+))
 
 
+@costmodel.instrument("repair-loop")
 @functools.partial(
     jax.jit,
     static_argnames=("target_rack", "target_capacity", "cfg", "nk"),
@@ -832,6 +834,7 @@ def finalize_preferred_leaders(
     return model, stack_after, n
 
 
+@costmodel.instrument("leader-fix")
 @jax.jit
 def _leader_fix(m: TensorClusterModel, assignment, leader_slot):
     """Point leaders at an alive, non-excluded replica where possible."""

@@ -95,6 +95,20 @@ class CruiseControl:
             path=config["observability.flight.recorder.path"] or None,
         )
         compilestats.export_gauges(REGISTRY)
+        # device cost observatory (ccx.common.costmodel): same tri-state
+        # precedence as the tracer knobs — an absent capture key leaves
+        # the env (CCX_COST_CAPTURE) in charge; roofline-ceiling overrides
+        # default to the built-in device-spec table at 0
+        from ccx.common import costmodel
+
+        cap = _explicit("observability.cost.capture")
+        if cap is not None:
+            costmodel.set_capture(bool(cap))
+        costmodel.set_device_override(
+            config["observability.cost.peak.tflops"],
+            config["observability.cost.hbm.gbps"],
+        )
+        costmodel.export_gauges(REGISTRY)
 
     # ----- lifecycle (ref startUp order: monitor -> detector -> servlet) ----
 

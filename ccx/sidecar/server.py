@@ -315,6 +315,11 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
     # live compile counters as gauges on the process registry — whoever
     # renders /metrics in this process sees compile activity mid-RPC
     compilestats.export_gauges()
+    # ... and the cost observatory's gauges (captured program records,
+    # projected device seconds — ccx.common.costmodel) next to them
+    from ccx.common import costmodel
+
+    costmodel.export_gauges()
 
     def unary(fn, rpc_name):
         def handler(request: bytes, context):
@@ -387,6 +392,17 @@ def main(argv=None) -> int:
     from ccx.common.device import ensure_responsive_backend
 
     ensure_responsive_backend()
+    # the resident sidecar IS the compile path the T1 story measures: arm
+    # cost/memory capture so every program it ever compiles banks its
+    # XLA cost record (flushed by the optimizer's cost-capture phase on
+    # the cold path only; CCX_COST_CAPTURE=0 opts out). In-process
+    # embedders (tests, bench) arm it themselves when they want it.
+    import os as _os
+
+    from ccx.common import costmodel
+
+    if _os.environ.get(costmodel.ENV_CAPTURE) != "0":
+        costmodel.set_capture(True)
     server, port = make_grpc_server(address=args.address)
     server.start()
     log.info("optimizer sidecar listening on port %s", port)

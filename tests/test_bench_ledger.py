@@ -1,0 +1,213 @@
+"""Bench-ledger tests (ISSUE 6): the cross-round trend/tripwire tool
+``tools/bench_ledger.py`` — banked-artifact smoke gate (tier-1 fails fast
+when a PR regresses a banked rung or breaks the BENCH schema), synthetic
+regression pass/fail paths, and both backend-string forms."""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+)
+import bench_ledger  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _line(value, *, rung="lean", effort=None, goals=None, backend="cpu",
+          detail=None, verified=True, **extra):
+    line = {
+        "metric": "B5 full-goal-stack rebalance proposal wall-clock (warm)",
+        "value": value, "unit": "s", "vs_baseline": 5.0 / value,
+        "verified": verified, "verification_failures": [],
+        "proposals": 60000, "cold_s": value * 1.1,
+        "backend": backend, "rung": rung,
+        "effort": effort or {"chains": 16, "steps": 500, "moves": 8},
+        "goals": goals or {
+            "TopicReplicaDistributionGoal": {"violations": [45838.0, 0.0]},
+            "NetworkOutboundUsageDistributionGoal": {"violations": [948.0, 17.0]},
+        },
+        **extra,
+    }
+    if detail is not None:
+        line["backend_detail"] = detail
+    return line
+
+
+def _bank(tmp_path, n, line):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+# ----- banked-artifact smoke gate (the tier-1 tripwire itself) ---------------
+
+
+def test_check_passes_on_banked_rounds():
+    """The gate must be green on the repo's own banked artifacts — a PR
+    that regresses a banked rung (or breaks the BENCH schema so nothing
+    parses) turns this red."""
+    rows, partials = bench_ledger.load_rows(str(REPO))
+    assert rows, "no banked BENCH/PARITY artifacts parsed"
+    failures = bench_ledger.check(rows, partials)
+    assert failures == [], failures
+
+
+def test_cli_check_and_table_on_banked_rounds(capsys):
+    assert bench_ledger.main(["--dir", str(REPO), "--check"]) == 0
+    assert bench_ledger.main(["--dir", str(REPO)]) == 0
+    out = capsys.readouterr().out
+    # the trend table shows the banked rounds and the partial ones
+    assert "lean" in out and "partial:" in out
+
+
+# ----- synthetic pass/fail paths ---------------------------------------------
+
+
+def test_wall_regression_fails_check(tmp_path):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank(tmp_path, 2, _line(23.2 * 1.15))  # the synthetic 15% regression
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    failures = bench_ledger.check(rows, partials)
+    assert len(failures) == 1 and "wall" in failures[0], failures
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 1
+
+
+def test_wall_within_threshold_passes(tmp_path):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank(tmp_path, 2, _line(23.2 * 1.05))  # inside the 10% gate
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    assert bench_ledger.check(rows, partials) == []
+
+
+def test_quality_envelope_breach_fails_check(tmp_path):
+    _bank(tmp_path, 1, _line(23.2))
+    worse = _line(22.0, goals={
+        "TopicReplicaDistributionGoal": {"violations": [45838.0, 0.0]},
+        # best banked 17 -> 40 breaches 17*1.1+2
+        "NetworkOutboundUsageDistributionGoal": {"violations": [948.0, 40.0]},
+    })
+    _bank(tmp_path, 2, worse)
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    failures = bench_ledger.check(rows, partials)
+    assert len(failures) == 1
+    assert "NetworkOutboundUsageDistributionGoal" in failures[0]
+
+
+def test_different_effort_is_not_comparable(tmp_path):
+    """Retuned rungs must never false-positive: effort dicts differ ->
+    different group -> no wall comparison (bench.py's own contract)."""
+    _bank(tmp_path, 1, _line(23.2, effort={"chains": 16, "steps": 1000}))
+    _bank(tmp_path, 2, _line(60.0, effort={"chains": 16, "steps": 500}))
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    assert bench_ledger.check(rows, partials) == []
+
+
+def test_unverified_latest_line_fails(tmp_path):
+    _bank(tmp_path, 1, _line(23.2, verified=False))
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    failures = bench_ledger.check(rows, partials)
+    assert failures and "UNVERIFIED" in failures[0]
+
+
+def test_partial_rounds_are_reported_not_failed(tmp_path):
+    _bank(tmp_path, 1, _line(23.2))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"n": 2, "rc": 124, "tail": "wedged", "parsed": None})
+    )
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    assert len(partials) == 1 and "no completed rung" in partials[0]["why"]
+    assert bench_ledger.check(rows, partials) == []
+
+
+def test_empty_dir_fails_check(tmp_path):
+    """A schema break that makes NOTHING parse must fail loudly, not pass
+    vacuously."""
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    assert bench_ledger.check(rows, partials) != []
+
+
+# ----- backend-form tolerance ------------------------------------------------
+
+
+def test_split_backend_old_glued_form():
+    b, d = bench_ledger.split_backend({
+        "backend":
+            "cpu (fallback: cpu (device probe timed out — TPU wedged?))"
+    })
+    assert b == "cpu"
+    assert d == "fallback: cpu (device probe timed out — TPU wedged?)"
+
+
+def test_split_backend_new_structured_form():
+    b, d = bench_ledger.split_backend({
+        "backend": "cpu", "backend_detail": "fallback: cpu (probe rc=1)",
+    })
+    assert (b, d) == ("cpu", "fallback: cpu (probe rc=1)")
+    b, d = bench_ledger.split_backend({"backend": "tpu"})
+    assert (b, d) == ("tpu", None)
+
+
+def test_old_and_new_forms_share_a_group(tmp_path):
+    """A fallback line banked pre-round-10 and its round-10+ twin must
+    land in the same comparability group (same backend after parsing)."""
+    old = _line(
+        23.2,
+        backend="cpu (fallback: cpu (device probe timed out — TPU wedged?))",
+    )
+    new = _line(
+        23.2 * 1.2, detail="fallback: cpu (device probe timed out)",
+    )
+    _bank(tmp_path, 1, old)
+    _bank(tmp_path, 2, new)
+    rows, partials = bench_ledger.load_rows(str(tmp_path))
+    failures = bench_ledger.check(rows, partials)
+    assert len(failures) == 1 and "wall" in failures[0], failures
+
+
+# ----- roofline --------------------------------------------------------------
+
+
+def test_roofline_renders_cost_model(tmp_path):
+    cm = {
+        "device": {"deviceKind": "cpu", "peakFlops": 5e10,
+                   "hbmBytesPerSec": 2e10, "source": "table"},
+        "totals": {"calls": 10, "flops": 1e12, "bytesAccessed": 4e11,
+                   "hbmPeakBytes": 5e8},
+        "projected": {"device": {"seconds": 20.0, "bound": "memory"}},
+        "programs": {},
+        "coverage": {"programsExecuted": 5, "programsCaptured": 5,
+                     "callsUncaptured": 0},
+        "phases": {
+            "anneal": {"calls": 2, "flops": 8e11, "bytesAccessed": 3e11,
+                       "projectedSeconds": 15.0, "hbmPeakBytes": 5e8},
+            "polish": {"calls": 8, "flops": 2e11, "bytesAccessed": 1e11,
+                       "projectedSeconds": 5.0, "hbmPeakBytes": 2e8},
+        },
+    }
+    _bank(tmp_path, 1, _line(23.2, costModel=cm))
+    rows, _ = bench_ledger.load_rows(str(tmp_path))
+    table = bench_ledger.render_roofline(rows)
+    assert "| anneal |" in table and "| polish |" in table
+    assert "v5e" in table and "Coverage: 5/5" in table
+    # v5e projection for the anneal row: memory-bound 3e11/8.19e11 ~ 0.366
+    assert "0.366" in table
+
+
+def test_roofline_without_cost_model_explains(tmp_path):
+    _bank(tmp_path, 1, _line(23.2))
+    rows, _ = bench_ledger.load_rows(str(tmp_path))
+    assert "no banked line carries a costModel" in (
+        bench_ledger.render_roofline(rows)
+    )
+
+
+def test_check_is_wired_into_campaign_script():
+    """tools/tpu_campaign.sh must print the ledger + gate at campaign end
+    (the satellite's wiring contract)."""
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "bench_ledger.py" in sh and "--check" in sh
+    assert "CCX_PROFILE_DIR" in sh

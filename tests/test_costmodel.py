@@ -1,0 +1,310 @@
+"""Device cost observatory tests (ISSUE 6 acceptance criteria): capture
+via the instrumented jit seams, graceful degradation across backend
+cost_analysis key sets (CPU vs TPU), the zero-warm-fresh-compile tripwire
+with capture ARMED, roofline math / device-spec resolution, and the
+costModel block riding OptimizerResult + the phase spans."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ccx.common import compilestats, costmodel
+
+
+@pytest.fixture(autouse=True)
+def _clean_costmodel():
+    """The ledger is process-global (like compilestats): every test leaves
+    it empty with capture back on the env default."""
+    costmodel.reset()
+    costmodel.set_device_override(0, 0)
+    yield
+    costmodel.reset()
+    costmodel.set_capture(None)
+    costmodel.set_device_override(0, 0)
+
+
+# ----- instrumentation seam --------------------------------------------------
+
+
+def test_instrument_counts_per_shape_and_captures():
+    @costmodel.instrument("unit-prog")
+    @jax.jit
+    def f(x):
+        return (x * 2.0).sum()
+
+    costmodel.set_capture(True)
+    a = jnp.ones((8, 8))
+    f(a)
+    f(a)  # same shape: same key, no second pending entry
+    f(jnp.ones((16, 4)))  # new shape: new key
+    snap = costmodel.exec_snapshot()
+    assert sorted(snap.values()) == [1, 2]
+    assert all(k.startswith("unit-prog#") for k in snap)
+    assert costmodel.pending_count() == 2
+    assert costmodel.capture_pending() == 2
+    assert costmodel.pending_count() == 0
+    recs = costmodel.records()
+    assert len(recs) == 2
+    for rec in recs.values():
+        # CPU backend exposes flops + bytes accessed + memory stats
+        assert rec["error"] is None
+        assert rec["flops"] and rec["flops"] > 0
+        assert rec["bytesAccessed"] and rec["bytesAccessed"] > 0
+        assert rec["peakBytes"] and rec["peakBytes"] > 0
+
+
+def test_instrument_capture_off_only_counts():
+    @costmodel.instrument("unit-prog-off")
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    costmodel.set_capture(False)
+    f(jnp.ones((4,)))
+    assert costmodel.exec_snapshot()
+    assert costmodel.pending_count() == 0
+
+
+def test_instrument_passes_attributes_through():
+    @costmodel.instrument("unit-prog-attrs")
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    # jit attributes (the _cache_size probe tests/test_repair.py uses)
+    # must keep working through the wrapper
+    assert callable(f.lower)
+    assert f(jnp.ones((2,))).shape == (2,)
+
+
+# ----- degradation contract --------------------------------------------------
+
+
+def test_normalize_cost_cpu_list_form():
+    fields, keys, err = costmodel._normalize_cost(
+        [{"flops": 127.0, "bytes accessed": 260.0, "utilization0{}": 1.0}]
+    )
+    assert err is None
+    assert fields["flops"] == 127.0
+    assert fields["bytesAccessed"] == 260.0
+    assert fields["transcendentals"] is None
+    assert "utilization0{}" in keys
+
+
+def test_normalize_cost_multi_partition_sums():
+    """A sharded executable's list-form analysis (one dict per partition)
+    must SUM numeric metrics, not keep partition 0 only."""
+    fields, _keys, err = costmodel._normalize_cost(
+        [{"flops": 10.0, "bytes accessed": 5.0},
+         {"flops": 7.0, "bytes accessed": 3.0}]
+    )
+    assert err is None
+    assert fields["flops"] == 17.0
+    assert fields["bytesAccessed"] == 8.0
+
+
+def test_normalize_cost_tpu_dict_and_missing_keys():
+    # TPU-style: a bare dict, possibly missing any given metric — absent
+    # keys become None, never a crash
+    fields, keys, err = costmodel._normalize_cost(
+        {"flops": 5.0, "transcendentals": 2.0}
+    )
+    assert err is None
+    assert fields["flops"] == 5.0
+    assert fields["bytesAccessed"] is None
+    assert fields["transcendentals"] == 2.0
+    # empty / None / garbage containers all degrade to all-None fields
+    for raw in (None, [], {}, "nonsense", 42):
+        fields, _keys, _err = costmodel._normalize_cost(raw)
+        assert fields["flops"] is None and fields["bytesAccessed"] is None
+
+
+def test_normalize_memory_missing_attrs():
+    class _Partial:  # a backend exposing only argument size
+        argument_size_in_bytes = 100
+
+    out = costmodel._normalize_memory(_Partial())
+    assert out["argumentBytes"] == 100.0
+    assert out["outputBytes"] is None and out["tempBytes"] is None
+    assert out["peakBytes"] == 100.0  # known parts only
+    out = costmodel._normalize_memory(object())
+    assert out["peakBytes"] is None
+
+
+def test_capture_records_error_instead_of_raising():
+    class _Compiled:
+        def cost_analysis(self):
+            raise RuntimeError("backend says no")
+
+        def memory_analysis(self):
+            raise RuntimeError("also no")
+
+    class _Lowered:
+        def compile(self):
+            return _Compiled()
+
+    class _Fn:
+        def lower(self, *a, **k):
+            return _Lowered()
+
+    rec = costmodel._capture_one("k#1", "lbl", _Fn(), (), {})
+    assert rec["flops"] is None and rec["peakBytes"] is None
+    assert "backend says no" in rec["error"] and "also no" in rec["error"]
+
+    class _Unlowerable:
+        def lower(self, *a, **k):
+            raise ValueError("donated aval mismatch")
+
+    rec = costmodel._capture_one("k#2", "lbl", _Unlowerable(), (), {})
+    assert rec["error"].startswith("lower/compile:")
+
+
+# ----- roofline / device specs ----------------------------------------------
+
+
+def test_spec_resolution_and_roofline_bounds():
+    assert costmodel.spec_for("TPU v5 lite")["key"] == "tpu-v5e"
+    assert costmodel.spec_for("TPU v5p")["key"] == "tpu-v5p"
+    assert costmodel.spec_for("cpu")["key"] == "cpu"
+    assert costmodel.spec_for("quantum-abacus") is None
+    spec = {"peakFlops": 100.0, "hbmBytesPerSec": 10.0}
+    s, bound = costmodel.roofline_seconds(1000.0, 10.0, spec)
+    assert (s, bound) == (10.0, "compute")
+    s, bound = costmodel.roofline_seconds(10.0, 1000.0, spec)
+    assert (s, bound) == (100.0, "memory")
+    # a missing counter degrades to the other axis; both missing -> None
+    s, bound = costmodel.roofline_seconds(None, 1000.0, spec)
+    assert (s, bound) == (100.0, "memory")
+    assert costmodel.roofline_seconds(None, None, spec) == (None, None)
+
+
+def test_device_override_wins():
+    costmodel.set_device_override(peak_tflops=2.0, hbm_gbps=1.0)
+    spec = costmodel.device_spec()
+    assert spec["peakFlops"] == 2.0e12
+    assert spec["hbmBytesPerSec"] == 1.0e9
+    assert spec["source"] == "override"
+    costmodel.set_device_override(0, 0)
+    assert costmodel.device_spec()["source"] in ("table", "unknown")
+
+
+def test_loop_iters_scale_flops_not_watermark():
+    """XLA costs a scan body once; a declared static trip count must
+    scale flops/bytes in projections — and must NOT scale the HBM
+    watermark (residency does not grow with iterations)."""
+    import jax.lax
+
+    def body(c, _):
+        return c * 1.0001 + 1.0, None
+
+    import functools
+
+    @costmodel.instrument("unit-scan", iters=lambda k: k["length"])
+    @functools.partial(jax.jit, static_argnames=("length",))
+    def f(x, *, length=100):
+        return jax.lax.scan(body, x, None, length=length)[0]
+
+    costmodel.set_capture(True)
+    snap0 = costmodel.exec_snapshot()
+    f(jnp.ones((64,)), length=100)
+    costmodel.capture_pending()
+    (rec,) = costmodel.records().values()
+    assert rec["loopIters"] == 100
+    delta = costmodel.exec_delta(snap0)
+    p = costmodel.projection(delta)
+    prog = p["programs"]["unit-scan"]
+    # scaled: ~100x the single-body cost analysis number
+    assert prog["flops"] == pytest.approx(rec["flops"] * 100)
+    assert p["totals"]["hbmPeakBytes"] == rec["peakBytes"]
+
+
+def test_projection_counts_uncaptured_calls():
+    p = costmodel.projection({"ghost-prog#abc": 3})
+    assert p["coverage"] == {
+        "programsExecuted": 1, "programsCaptured": 0, "callsUncaptured": 3,
+    }
+    assert p["programs"]["ghost-prog"]["captured"] is False
+    assert p["totals"]["flops"] is None
+
+
+# ----- end-to-end: optimize() + the tripwire ---------------------------------
+
+
+def test_capture_never_perturbs_warm_runs_and_costmodel_rides_result():
+    """The zero-warm-fresh-compile tripwire with capture ARMED: the cold
+    run captures (cost-capture phase, AOT compiles allowed), the warm
+    rerun pays ZERO fresh XLA compiles — cost accounting must never
+    invalidate the jit cache — and both results carry a fully-covered
+    costModel block with per-phase projections."""
+    from ccx.goals.base import GoalConfig
+    from ccx.model.fixtures import small_deterministic
+    from ccx.optimizer import OptimizeOptions, optimize
+    from ccx.search.annealer import AnnealOptions
+    from ccx.search.greedy import GreedyOptions
+
+    costmodel.set_capture(True)
+    m = small_deterministic()
+    goals = ("StructuralFeasibility", "ReplicaDistributionGoal")
+    opts = OptimizeOptions(
+        anneal=AnnealOptions(n_chains=2, n_steps=8, chunk_steps=4),
+        polish=GreedyOptions(n_candidates=8, max_iters=4, chunk_iters=2),
+        require_hard_zero=False, run_cold_greedy=False,
+        topic_rebalance_rounds=0,
+    )
+    res_cold = optimize(m, GoalConfig(), goals, opts)  # may compile + capture
+    assert costmodel.pending_count() == 0  # the cost-capture phase flushed
+    before = compilestats.snapshot()
+    res_warm = optimize(m, GoalConfig(), goals, opts)
+    delta = compilestats.delta(before, compilestats.snapshot())
+    assert delta["backend_compiles"] == 0, delta
+    for res in (res_cold, res_warm):
+        cm = res.cost_model
+        assert cm["coverage"]["callsUncaptured"] == 0, cm["coverage"]
+        assert cm["coverage"]["programsCaptured"] == (
+            cm["coverage"]["programsExecuted"]
+        )
+        assert cm["totals"]["flops"] > 0
+        assert cm["totals"]["hbmPeakBytes"] > 0
+        # fixed projection targets ride every block next to the live device
+        assert set(cm["projected"]) >= {"device", "tpu-v5e", "tpu-v5p"}
+        # the anneal phase rolled up its programs' cost
+        anneal = cm["phases"]["anneal"]
+        assert anneal["calls"] >= 1 and anneal["hbmPeakBytes"] > 0
+        assert res.to_json(include_proposals=False)["costModel"] is cm
+    # the warm run executed only already-captured programs
+    assert res_warm.cost_model["coverage"]["programsCaptured"] > 0
+    # the span tree's phase spans carry the same rollup (flight-recorder
+    # readout: expected device seconds + HBM watermark per phase)
+    anneal_span = next(
+        c for c in res_warm.span_tree["children"] if c["name"] == "anneal"
+    )
+    assert anneal_span["costModel"]["hbmPeakBytes"] > 0
+    # cold run had a cost-capture phase; warm run must NOT (nothing pending)
+    cold_phases = [c["name"] for c in res_cold.span_tree["children"]]
+    warm_phases = [c["name"] for c in res_warm.span_tree["children"]]
+    assert "cost-capture" in cold_phases or costmodel.records()
+    assert "cost-capture" not in warm_phases
+
+
+def test_summarize_joins_expected_cost_for_open_spans(tmp_path):
+    """A wedged window's recording prices its open span from the same
+    phase's last completed run earlier in the JSONL."""
+    import json
+
+    from ccx.common import tracing
+
+    path = tmp_path / "wedge.jsonl"
+    lines = [
+        {"ev": "arm", "pid": 1},
+        {"ev": "start", "span": "optimize/anneal"},
+        {"ev": "end", "span": "optimize/anneal", "wall_s": 1.0,
+         "cost": {"projectedSeconds": 0.5, "hbmPeakBytes": 1e9}},
+        {"ev": "start", "span": "optimize/anneal"},
+        {"ev": "chunk", "span": "optimize/anneal", "chunk": 12},
+        # killed here
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    s = tracing.summarize(str(path))
+    assert s["openSpans"] == ["optimize/anneal"]
+    assert s["expectedCost"]["optimize/anneal"]["projectedSeconds"] == 0.5
+    assert s["lastChunk"]["chunk"] == 12

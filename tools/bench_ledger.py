@@ -1,0 +1,418 @@
+#!/usr/bin/env python
+"""Cross-round bench ledger — trend table, regression tripwires, roofline.
+
+Five-plus rounds of ``BENCH_r*.json`` (the driver's wrapper around the
+last ``bench.py`` output line) and the ``PARITY_B5*.json`` quality
+artifacts sit on disk with no trend view and no gate: a PR that quietly
+regressed a banked rung would only be caught by a human re-reading JSON.
+This tool is the ledger and the tripwire:
+
+* default: print the per-round trend table — wall/cold, backend (+
+  fallback detail), verification, proposals, the headline quality cells
+  (TRD / NwOut / LeaderReplica / LeaderBytesIn / ReplicaDist
+  violations-after), warm-sample dispersion when ``--samples`` banked a
+  raw ``walls`` list, and the cost-model projection next to the measured
+  wall when a line carries a ``costModel`` block.
+* ``--check``: fail (exit 1) on a wall regression >10% or a
+  quality-envelope breach in the LATEST banked round vs the best earlier
+  round of the SAME (rung, backend, effort) group — rung lines are only
+  comparable at identical effort (bench.py's own contract), so retuned
+  rungs never false-positive — or on an unverified latest line. Partial
+  rounds (``parsed: null`` — a wedged window) are reported, not failed:
+  the gate protects banked numbers, it does not re-litigate dead windows.
+  Wired into tier-1 (tests/test_bench_ledger.py) so a PR that regresses
+  a banked rung or breaks the BENCH schema fails fast.
+* ``--roofline``: render the newest ``costModel`` block as the per-phase
+  budget table (calls, FLOPs, bytes, HBM watermark, roofline-projected
+  seconds on the measuring device and on v5e/v5p) — the generated
+  replacement for the hand-summed budget table docs/perf-notes.md used
+  to maintain.
+
+Backend forms: pre-round-10 lines glued the fallback reason into the
+backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
+round 10+ lines carry structured ``backend`` + ``backend_detail``. Both
+parse here.
+
+Dependency-light on purpose (json/argparse/glob only — no jax) so the
+tier-1 smoke test and a dying TPU window can both run it instantly;
+``--roofline`` imports ``ccx.common.costmodel`` for the device-spec
+table only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: --check thresholds: wall regression gate vs the best comparable banked
+#: round, and the per-goal quality envelope (relative + absolute slack —
+#: small violation counts jitter by a few moves run to run)
+WALL_REGRESSION = 0.10
+QUALITY_REGRESSION = 0.10
+QUALITY_SLACK = 2.0
+
+#: the headline quality cells the trend table shows (violations-after)
+QUALITY_CELLS = (
+    ("TRD", "TopicReplicaDistributionGoal"),
+    ("NwOut", "NetworkOutboundUsageDistributionGoal"),
+    ("LR", "LeaderReplicaDistributionGoal"),
+    ("LBI", "LeaderBytesInDistributionGoal"),
+    ("RD", "ReplicaDistributionGoal"),
+)
+
+
+def split_backend(line: dict) -> tuple[str, str | None]:
+    """(backend, detail) from either wire form: structured
+    ``backend``+``backend_detail`` (round 10+) or the old glued
+    ``"cpu (fallback: ...)"`` string."""
+    b = str(line.get("backend", "?"))
+    detail = line.get("backend_detail")
+    m = re.match(r"^(\S+)\s+\(fallback:\s*(.*)\)$", b)
+    if detail is None and m:
+        return m.group(1), "fallback: " + m.group(2)
+    return b, detail
+
+
+def _round_of(path: str, wrapper: dict) -> int:
+    n = wrapper.get("n")
+    if isinstance(n, int):
+        return n
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def load_rows(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every BENCH_r*.json + PARITY_B5*.json under
+    ``root``. A row is one completed rung line; a partial is a round whose
+    wrapper banked no parseable line (wedged window)."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": os.path.basename(path),
+                             "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or line.get("value") is None:
+            partials.append({
+                "file": os.path.basename(path), "round": rnd,
+                "why": f"no completed rung (rc={wrapper.get('rc')})",
+            })
+            continue
+        rows.append(_row_from_line(line, rnd, os.path.basename(path)))
+    for path in sorted(glob.glob(os.path.join(root, "PARITY_B5*.json"))):
+        try:
+            p = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        name = os.path.basename(path)
+        rows.append({
+            "source": name, "round": None,
+            "rung": "parity-lean" if "LEAN" in name else "parity-full",
+            "backend": str(p.get("backend", "?")),
+            "backend_detail": None,
+            "wall": p.get("wall_seconds"),
+            "cold": None,
+            "verified": bool(p.get("verified")),
+            "proposals": None,
+            "effort": p.get("effort") or {},
+            "goals_after": _goals_after(p.get("goals") or {}),
+            "samples": None,
+            "cost_model": None,
+        })
+    return rows, partials
+
+
+def _goals_after(goals: dict) -> dict[str, float]:
+    out = {}
+    for goal, cell in goals.items():
+        v = cell.get("violations")
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            out[goal] = float(v[1])
+    return out
+
+
+def _row_from_line(line: dict, rnd: int, source: str) -> dict:
+    backend, detail = split_backend(line)
+    return {
+        "source": source,
+        "round": rnd,
+        "rung": line.get("rung") or "?",
+        "backend": backend,
+        "backend_detail": detail,
+        "wall": line.get("value"),
+        "cold": line.get("cold_s"),
+        "verified": bool(line.get("verified")),
+        "failures": line.get("verification_failures") or [],
+        "proposals": line.get("proposals"),
+        "effort": line.get("effort") or {},
+        "goals_after": _goals_after(line.get("goals") or {}),
+        "samples": line.get("samples"),
+        "cost_model": line.get("costModel"),
+    }
+
+
+def group_key(row: dict) -> str:
+    """Comparability key: rung lines are only same-workload at identical
+    (rung, backend, effort) — bench.py's own cross-round contract."""
+    return json.dumps(
+        [row["rung"], row["backend"], row["effort"]], sort_keys=True
+    )
+
+
+# ----- trend table -----------------------------------------------------------
+
+
+def _fmt(v, nd=1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _dispersion(samples: dict | None) -> str:
+    if not samples:
+        return "-"
+    walls = samples.get("walls")
+    if walls:
+        lo, hi, med = min(walls), max(walls), samples.get("median")
+        spread = (hi - lo) / med * 100 if med else 0.0
+        return f"n={len(walls)} ±{spread / 2:.1f}%"
+    return f"n={samples.get('n', '?')}"
+
+
+def _model_vs_wall(row: dict) -> str:
+    cm = row.get("cost_model")
+    if not cm:
+        return "-"
+    dev = (cm.get("projected") or {}).get("device") or {}
+    s = dev.get("seconds")
+    if s is None or not row.get("wall"):
+        return "-"
+    return f"{s:.2f}s ({s / row['wall'] * 100:.0f}%)"
+
+
+def render_table(rows: list[dict], partials: list[dict]) -> str:
+    out = []
+    headers = ["round", "rung", "backend", "wall s", "cold s", "ok",
+               "proposals", "samples"]
+    headers += [k for k, _ in QUALITY_CELLS]
+    headers += ["model/wall"]
+    body = []
+    for r in sorted(rows, key=lambda r: (r["round"] is None, r["round"] or 0,
+                                         r["rung"])):
+        backend = r["backend"] + ("*" if r["backend_detail"] else "")
+        cells = [
+            _fmt(r["round"], 0), r["rung"], backend,
+            _fmt(r["wall"], 1), _fmt(r["cold"], 1),
+            "yes" if r["verified"] else "NO",
+            _fmt(r["proposals"], 0), _dispersion(r["samples"]),
+        ]
+        for _, goal in QUALITY_CELLS:
+            cells.append(_fmt(r["goals_after"].get(goal), 0))
+        cells.append(_model_vs_wall(r))
+        body.append(cells)
+    widths = [max(len(h), *(len(row[i]) for row in body)) if body else len(h)
+              for i, h in enumerate(headers)]
+    out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for row in body:
+        out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    if partials:
+        out.append("")
+        for p in partials:
+            out.append(f"partial: {p['file']} — {p['why']}")
+    out.append("")
+    out.append("backend* = fallback applied (see backend_detail); "
+               "model/wall = roofline-projected device seconds vs wall")
+    return "\n".join(out)
+
+
+# ----- --check tripwires -----------------------------------------------------
+
+
+def check(rows: list[dict], partials: list[dict]) -> list[str]:
+    """The regression gate: list of failures (empty = green). Compares the
+    LATEST banked round's lines against the best earlier round in each
+    (rung, backend, effort) group."""
+    failures: list[str] = []
+    banked = [r for r in rows if r["round"] is not None]
+    if not banked:
+        return ["no completed BENCH rounds found (schema change?)"]
+    latest_round = max(r["round"] for r in banked)
+    latest = [r for r in banked if r["round"] == latest_round]
+    for r in latest:
+        if not r["verified"]:
+            failures.append(
+                f"round {r['round']} {r['rung']}: UNVERIFIED line banked "
+                f"(failures: {r.get('failures')})"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in banked:
+        groups.setdefault(group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [r for r in rs if r["round"] < latest_round and r["verified"]]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(prior, key=lambda p: p["wall"])
+        if r["wall"] is not None and best["wall"]:
+            limit = best["wall"] * (1 + WALL_REGRESSION)
+            if r["wall"] > limit:
+                failures.append(
+                    f"round {r['round']} {r['rung']}: wall {r['wall']:.1f}s "
+                    f"regressed >{WALL_REGRESSION:.0%} vs best banked "
+                    f"round {best['round']} ({best['wall']:.1f}s, "
+                    f"limit {limit:.1f}s)"
+                )
+        # quality envelope: per goal, the best (lowest) violations-after
+        # among prior comparable rounds bounds the latest round
+        for goal in r["goals_after"]:
+            prior_vals = [
+                p["goals_after"][goal] for p in prior
+                if goal in p["goals_after"]
+            ]
+            if not prior_vals:
+                continue
+            floor = min(prior_vals)
+            limit = floor * (1 + QUALITY_REGRESSION) + QUALITY_SLACK
+            if r["goals_after"][goal] > limit:
+                failures.append(
+                    f"round {r['round']} {r['rung']}: {goal} "
+                    f"violations-after {r['goals_after'][goal]:.0f} breaches "
+                    f"the quality envelope (best banked {floor:.0f}, "
+                    f"limit {limit:.1f})"
+                )
+    return failures
+
+
+# ----- --roofline ------------------------------------------------------------
+
+
+def render_roofline(rows: list[dict]) -> str:
+    """The generated budget table: per-phase roofline projections from the
+    newest banked costModel block (docs/perf-notes.md consumes this as
+    markdown)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:  # standalone runs start with tools/ as path[0]
+        sys.path.insert(0, repo)
+    # the --roofline path already depends on ccx for the spec table, so
+    # the projection math is the ONE implementation in costmodel (no
+    # local twin to drift)
+    from ccx.common.costmodel import DEVICE_SPECS, roofline_seconds
+
+    def _project(flops, bytes_accessed, spec):
+        return roofline_seconds(flops, bytes_accessed, spec)[0]
+
+    with_cm = [r for r in rows if r.get("cost_model")]
+    if not with_cm:
+        return ("no banked line carries a costModel block yet — run "
+                "`python bench.py` at HEAD (cost capture is on by default)")
+    r = max(with_cm, key=lambda r: (r["round"] is not None, r["round"] or 0))
+    cm = r["cost_model"]
+    dev = cm.get("device") or {}
+    specs = [("v5e", DEVICE_SPECS["tpu-v5e"]), ("v5p", DEVICE_SPECS["tpu-v5p"])]
+    out = [
+        f"Roofline budget table — round {r['round']} `{r['rung']}` rung, "
+        f"measured on {dev.get('deviceKind', '?')} "
+        f"(wall {_fmt(r['wall'], 1)} s warm). Projected seconds = "
+        "max(FLOPs/peak, bytes/bandwidth) per phase; '-' = phase ran no "
+        "captured program (host-side or uncaptured).",
+        "",
+        "| phase | calls | GFLOP | GB accessed | HBM peak MB | "
+        "proj dev s | proj v5e s | proj v5p s |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    phases = cm.get("phases") or {}
+    for name, p in phases.items():
+        flops, by = p.get("flops"), p.get("bytesAccessed")
+        cells = [
+            name, _fmt(p.get("calls"), 0),
+            _fmt(None if flops is None else flops / 1e9, 2),
+            _fmt(None if by is None else by / 1e9, 2),
+            _fmt(
+                None if p.get("hbmPeakBytes") is None
+                else p["hbmPeakBytes"] / 1e6, 1,
+            ),
+            _fmt(p.get("projectedSeconds"), 3),
+            _fmt(_project(flops, by, specs[0][1]), 3),
+            _fmt(_project(flops, by, specs[1][1]), 3),
+        ]
+        out.append("| " + " | ".join(cells) + " |")
+    t = cm.get("totals") or {}
+    out.append("| **total** | {} | {} | {} | {} | {} | {} | {} |".format(
+        _fmt(t.get("calls"), 0),
+        _fmt(None if t.get("flops") is None else t["flops"] / 1e9, 2),
+        _fmt(
+            None if t.get("bytesAccessed") is None
+            else t["bytesAccessed"] / 1e9, 2,
+        ),
+        _fmt(
+            None if t.get("hbmPeakBytes") is None
+            else t["hbmPeakBytes"] / 1e6, 1,
+        ),
+        _fmt(((cm.get("projected") or {}).get("device") or {}).get("seconds"), 3),
+        _fmt(_project(t.get("flops"), t.get("bytesAccessed"), specs[0][1]), 3),
+        _fmt(_project(t.get("flops"), t.get("bytesAccessed"), specs[1][1]), 3),
+    ))
+    cov = cm.get("coverage") or {}
+    out.append("")
+    out.append(
+        f"Coverage: {cov.get('programsCaptured', '?')}/"
+        f"{cov.get('programsExecuted', '?')} programs captured, "
+        f"{cov.get('callsUncaptured', 0)} uncaptured calls. Projections "
+        "are roofline LOWER bounds (dispatch, host phases and kernel "
+        "inefficiency are not modeled); the wall/projection gap is the "
+        "host-bound share."
+    )
+    return "\n".join(out)
+
+
+# ----- entry -----------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    ap.add_argument("--check", action="store_true",
+                    help="regression tripwires; exit 1 on any failure")
+    ap.add_argument("--roofline", action="store_true",
+                    help="render the newest costModel block as the "
+                         "per-phase budget table")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable row dump")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.dir)
+    rows, partials = load_rows(root)
+    if args.json:
+        print(json.dumps({"rows": rows, "partials": partials}, indent=1))
+        return 0
+    if args.roofline:
+        print(render_roofline(rows))
+        return 0
+    if args.check:
+        failures = check(rows, partials)
+        for f in failures:
+            print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
+        if failures:
+            return 1
+        n = len([r for r in rows if r["round"] is not None])
+        print(f"bench ledger green: {n} banked line(s), "
+              f"{len(partials)} partial round(s), no regression vs the "
+              f"best banked rounds")
+        return 0
+    print(render_table(rows, partials))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,8 +41,11 @@ _BENCH_KNOBS = ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
 #: (phaseSeconds is per-phase wall clock — round 6: its unnoticed arrival
 #: in to_json had silently broken the replay test until regeneration here;
 #: spanTree is the r9 observability block — per-phase walls, chunk
-#: progress and compile attribution, all timing-volatile by construction)
-VOLATILE = ("wallSeconds", "phaseSeconds", "spanTree")
+#: progress and compile attribution, all timing-volatile by construction;
+#: costModel is the r10 cost-observatory block — XLA cost/memory records
+#: and roofline projections, machine- and backend-dependent by
+#: construction)
+VOLATILE = ("wallSeconds", "phaseSeconds", "spanTree", "costModel")
 
 REQUEST_NAMES = ("ping_request.bin", "put_full_request.bin",
                  "put_delta_request.bin", "propose_request.bin")

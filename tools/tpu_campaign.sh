@@ -27,6 +27,14 @@ L="${1:-tpu_campaign.log}"
 # compile the round-4 window died in.
 export CCX_FLIGHT_RECORDER="${CCX_FLIGHT_RECORDER:-tpu_flight_$(date -u +%Y%m%dT%H%M%SZ).jsonl}"
 export CCX_WATCHDOG_SECONDS="${CCX_WATCHDOG_SECONDS:-300}"
+# XProf device trace of the bench TARGET rung's warm run (bench.py arms
+# jax.profiler on that one rung only — the T1 chase — so the trace stays
+# small); the trace path is echoed into the flight-recorder JSONL as
+# xprof-start/xprof-stop records, so a recording cross-references the
+# device timeline covering the same wall window. Cost capture
+# (ccx.common.costmodel) is on by default in bench.py — every prewarmed
+# program banks its XLA cost/memory record onto the BENCH line.
+export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
 {
   echo "=== TPU campaign start $(date -u +%FT%TZ) ==="
   echo "flight recorder: $CCX_FLIGHT_RECORDER (watchdog ${CCX_WATCHDOG_SECONDS}s)"
@@ -113,5 +121,13 @@ export CCX_WATCHDOG_SECONDS="${CCX_WATCHDOG_SECONDS:-300}"
   # when a wedge cut the campaign short and this block never ran — the
   # JSONL itself is the artifact; this summary is a convenience)
   timeout -k 10 60 python -m ccx.common.tracing "$CCX_FLIGHT_RECORDER"
+  echo "--- bench ledger (trend + regression gate + roofline) ---"
+  # the cross-round view of what this campaign just banked next to every
+  # earlier round, the >10%-wall / quality-envelope tripwires, and the
+  # cost-model budget table for the freshest costModel-carrying line
+  timeout -k 10 60 python tools/bench_ledger.py
+  timeout -k 10 60 python tools/bench_ledger.py --check
+  echo "ledger check rc=$?"
+  timeout -k 10 60 python tools/bench_ledger.py --roofline
   echo "=== TPU campaign end $(date -u +%FT%TZ) ==="
 } >> "$L" 2>&1
