@@ -162,12 +162,23 @@ def _leaf_sig(x) -> object:
 def _spec_of(x):
     """Capture-spec leaf: ShapeDtypeStruct skeleton for array-likes (no
     buffer retained), the value itself otherwise (static kwargs, python
-    scalars — ``jit.lower`` accepts both)."""
+    scalars — ``jit.lower`` accepts both). Mesh-sharded leaves keep their
+    NamedSharding on the skeleton: the AOT capture compile must lower the
+    SAME SPMD program the run executed (and hit the same persistent-cache
+    entry), not a single-device twin of it."""
     shape = getattr(x, "shape", None)
     dtype = getattr(x, "dtype", None)
     if shape is not None and dtype is not None:
         import jax
 
+        sharding = getattr(x, "sharding", None)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            try:
+                return jax.ShapeDtypeStruct(
+                    tuple(shape), dtype, sharding=sharding
+                )
+            except TypeError:  # older jax: no sharding kwarg
+                pass
         return jax.ShapeDtypeStruct(tuple(shape), dtype)
     return x
 
@@ -260,12 +271,17 @@ def _normalize_cost(raw) -> tuple[dict, list[str], str | None]:
     disagree on the container (CPU: list of per-partition dicts; TPU: one
     dict or None) and on the key set — absent metrics become None, never
     a crash."""
-    fields = {"flops": None, "bytesAccessed": None, "transcendentals": None}
+    fields = {
+        "flops": None, "bytesAccessed": None, "transcendentals": None,
+        "partitions": 1,
+    }
     if isinstance(raw, (list, tuple)):
         # multi-partition executables return one dict per partition — sum
         # numeric metrics across partitions (keeping only partition 0
         # would silently under-report a sharded program by the partition
-        # count while still claiming capture)
+        # count while still claiming capture). The partition count rides
+        # the record so projections can divide back down: the chips run
+        # CONCURRENTLY, so per-chip roofline = global FLOPs / mesh size.
         dicts = [d for d in raw if isinstance(d, dict)]
         if len(dicts) > 1:
             merged: dict = {}
@@ -274,6 +290,7 @@ def _normalize_cost(raw) -> tuple[dict, list[str], str | None]:
                     if isinstance(v, (int, float)):
                         merged[k] = merged.get(k, 0.0) + float(v)
             raw = merged
+            fields["partitions"] = len(dicts)
         else:
             raw = dicts[0] if dicts else (raw[0] if raw else None)
     if not isinstance(raw, dict):
@@ -320,6 +337,7 @@ def _capture_one(key: str, label: str, fn, spec_args, spec_kwargs,
     rec: dict = {
         "label": label, "key": key,
         "flops": None, "bytesAccessed": None, "transcendentals": None,
+        "partitions": 1,
         "argumentBytes": None, "outputBytes": None, "tempBytes": None,
         "aliasBytes": None, "generatedCodeBytes": None, "peakBytes": None,
         # declared static loop trip count (projections scale flops/bytes
@@ -486,6 +504,13 @@ def projection(delta: dict[str, int], specs: dict[str, dict] | None = None) -> d
         recs = {k: _RECORDS.get(k) for k in delta}
     programs: dict[str, dict] = {}
     totals = {"calls": 0, "flops": 0.0, "bytesAccessed": 0.0}
+    # per-chip roofline inputs: a mesh-sharded program's captured
+    # FLOPs/bytes are GLOBAL sums over its partitions (``_normalize_cost``
+    # merges the per-partition dicts), but the chips execute concurrently,
+    # so projected wall time divides by the partition count — per-chip
+    # roofline = global FLOPs / mesh size. Totals stay global (honest
+    # work accounting); only the time projections use the per-chip view.
+    chip = {"flops": 0.0, "bytesAccessed": 0.0}
     any_flops = any_bytes = False
     peak = None
     uncaptured_calls = 0
@@ -496,7 +521,7 @@ def projection(delta: dict[str, int], specs: dict[str, dict] | None = None) -> d
         slot = programs.setdefault(
             label,
             {"calls": 0, "flops": None, "bytesAccessed": None,
-             "hbmPeakBytes": None, "captured": False},
+             "hbmPeakBytes": None, "captured": False, "partitions": 1},
         )
         slot["calls"] += calls
         totals["calls"] += calls
@@ -505,6 +530,8 @@ def projection(delta: dict[str, int], specs: dict[str, dict] | None = None) -> d
             continue
         captured_programs += 1
         slot["captured"] = True
+        parts = max(int(rec.get("partitions") or 1), 1)
+        slot["partitions"] = max(slot["partitions"], parts)
         # flops/bytes scale by call count AND the declared static loop
         # trip count (XLA costs a loop body once — _Instrumented.iters);
         # the HBM watermark does NOT scale with iterations
@@ -512,31 +539,41 @@ def projection(delta: dict[str, int], specs: dict[str, dict] | None = None) -> d
         if rec["flops"] is not None:
             slot["flops"] = (slot["flops"] or 0.0) + rec["flops"] * mult
             totals["flops"] += rec["flops"] * mult
+            chip["flops"] += rec["flops"] * mult / parts
             any_flops = True
         if rec["bytesAccessed"] is not None:
             slot["bytesAccessed"] = (
                 (slot["bytesAccessed"] or 0.0) + rec["bytesAccessed"] * mult
             )
             totals["bytesAccessed"] += rec["bytesAccessed"] * mult
+            chip["bytesAccessed"] += rec["bytesAccessed"] * mult / parts
             any_bytes = True
         if rec["peakBytes"] is not None:
             slot["hbmPeakBytes"] = max(slot["hbmPeakBytes"] or 0.0, rec["peakBytes"])
             peak = max(peak or 0.0, rec["peakBytes"])
     if not any_flops:
         totals["flops"] = None
+        chip["flops"] = None
     if not any_bytes:
         totals["bytesAccessed"] = None
+        chip["bytesAccessed"] = None
     proj = {}
     for name, spec in specs.items():
         secs, bound = roofline_seconds(
-            totals["flops"], totals["bytesAccessed"], spec
+            chip["flops"], chip["bytesAccessed"], spec
         )
         proj[name] = {"seconds": _round(secs), "bound": bound}
     for slot in programs.values():
+        sparts = max(slot.pop("partitions", 1), 1)
+        sf = None if slot["flops"] is None else slot["flops"] / sparts
+        sb = (
+            None if slot["bytesAccessed"] is None
+            else slot["bytesAccessed"] / sparts
+        )
+        if sparts > 1:
+            slot["partitions"] = sparts
         slot["projectedSeconds"] = {
-            name: _round(
-                roofline_seconds(slot["flops"], slot["bytesAccessed"], spec)[0]
-            )
+            name: _round(roofline_seconds(sf, sb, spec)[0])
             for name, spec in specs.items()
         }
     return {

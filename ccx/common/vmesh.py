@@ -1,0 +1,61 @@
+"""Virtual host-mesh setup — ONE implementation of the XLA_FLAGS dance.
+
+Forcing the CPU platform with N virtual XLA devices (so the full
+pjit/shard_map path runs without TPU hardware) used to be copy-pasted in
+three places (tests/conftest.py, tools/probe_sharded.py,
+__graft_entry__.py) and was about to grow a fourth (bench.py --scaling).
+Every copy had the same two subtleties, now encoded once:
+
+* the environment preloads jax via sitecustomize (axon TPU platform), so
+  ``JAX_PLATFORMS`` alone is too late — ``jax.config`` must be updated
+  too, which works because backend *initialization* is lazy;
+* ``XLA_FLAGS`` is read once at backend init: the flag must be appended
+  before any jax array/device call, and never twice (a duplicated
+  ``--xla_force_host_platform_device_count`` makes XLA error out).
+
+Call :func:`force_host_devices` before the first backend use; it is
+best-effort and silently keeps a pre-existing device-count flag. Callers
+that depend on the count (``bench.py --scaling``) use
+:func:`ensure_host_devices`, which additionally initializes the backend
+and raises when it came up with fewer devices than asked.
+"""
+
+from __future__ import annotations
+
+import os
+
+FLAG = "xla_force_host_platform_device_count"
+
+
+def force_host_devices(n: int = 8) -> None:
+    """Force the CPU platform with ``n`` virtual devices (idempotent).
+
+    Must run before the first jax backend initialization — import order
+    does not matter (jax may already be imported), backend-touching calls
+    do.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if FLAG not in flags:
+        os.environ["XLA_FLAGS"] = (flags + f" --{FLAG}={n}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_host_devices(n: int = 8) -> int:
+    """``force_host_devices`` + verify: returns the actual device count,
+    raising when the backend came up with fewer devices than asked (the
+    flag arrived after backend init)."""
+    force_host_devices(n)
+    import jax
+
+    have = len(jax.devices())
+    if have < n:
+        raise RuntimeError(
+            f"requested {n} virtual host devices but the backend "
+            f"initialized with {have} — force_host_devices() must run "
+            "before any jax backend use"
+        )
+    return have

@@ -189,9 +189,33 @@ def analyzer_config_def() -> ConfigDef:
              "compiled program serves every optimizer.num.steps budget "
              "(TPU compiles at scale are minutes per distinct step count); "
              "0 = single scan keyed on the full step count. Results are "
-             "bit-exact either way. Applies to the single-device path only "
-             "(mesh-sharded runs keep their own program cache).",
+             "bit-exact either way. Covers EVERY drive path — "
+             "single-device, chains-mesh data parallelism and the "
+             "partition-axis-sharded engine (optimizer.mesh.*) all run "
+             "the same chunk contract with per-chunk heartbeats.",
              at_least(0))
+    d.define("optimizer.mesh.enabled", Type.BOOLEAN, False, Importance.MEDIUM,
+             "Run the SA search sharded over a jax device mesh "
+             "(ccx.parallel.sharding): chains ride the mesh as data "
+             "parallelism and optimizer.mesh.parts > 1 additionally shards "
+             "the model's partition axis inside the search — the B6-scale "
+             "(10k brokers / 1M partitions) axis. The mesh path is "
+             "chunk-driven like the single-chip anneal (bounded compile, "
+             "per-chunk flight-recorder heartbeats, cost capture); the "
+             "winning placement is re-homed to the default device so every "
+             "later pipeline phase runs the single-chip programs. Ignored "
+             "with a log note when fewer than two devices are visible.")
+    d.define("optimizer.mesh.devices", Type.INT, 0, Importance.LOW,
+             "Devices for the optimizer mesh; 0 = all visible devices.",
+             at_least(0))
+    d.define("optimizer.mesh.parts", Type.INT, 1, Importance.LOW,
+             "Partition-axis factor of the optimizer mesh (chains = "
+             "devices / parts). 1 = chains-only data parallelism; raise "
+             "for clusters whose per-device model shard (100k+ "
+             "partitions) matters more than extra chains. A factor that "
+             "does not divide the device count (or the padded partition "
+             "axis) falls back to chains-only with a log note.",
+             at_least(1))
     d.define("optimizer.polish.candidates", Type.INT, 256, Importance.LOW,
              "Greedy polish candidate moves per iteration.", at_least(1))
     d.define("optimizer.polish.max.iters", Type.INT, 400, Importance.LOW,

@@ -203,4 +203,14 @@ def bench_spec(name: str) -> RandomClusterSpec:
             n_brokers=1_000, n_racks=20, n_topics=500, n_partitions=100_000,
             skew=0.3, seed=5,
         )
+    if name == "B6":  # 10k brokers / 1M partitions — the multi-chip rung
+        # (ROADMAP "Multi-chip sharded optimizer → B6 scale"): one order
+        # of magnitude past B5, the regime the JVM analyzer cannot touch.
+        # Padded shapes (P 1,048,576 / B 16,384 — power-of-two buckets)
+        # are STABLE across seeds and divide every mesh parts factor up
+        # to 64, so the sharded chunk programs never reshape.
+        return RandomClusterSpec(
+            n_brokers=10_000, n_racks=40, n_topics=2_000,
+            n_partitions=1_000_000, skew=0.3, seed=6,
+        )
     raise KeyError(name)

@@ -84,6 +84,11 @@ class OptimizerResult:
     #: lines and the sidecar result; VOLATILE in golden wire fixtures
     #: (machine-dependent by construction).
     cost_model: dict | None = None
+    #: mesh block (present only on mesh-sharded runs): mesh shape, device
+    #: count and the live sharded-program cache occupancy
+    #: (ccx.parallel.sharding.program_cache_stats). VOLATILE in golden
+    #: wire fixtures, like spanTree/costModel.
+    mesh: dict | None = None
     #: input placement, kept so the ClusterModelStats blocks (ref
     #: model/ClusterModelStats.java, SURVEY.md C4) can be derived lazily —
     #: computing them costs an aggregate pass + host transfer, which must not
@@ -153,6 +158,7 @@ class OptimizerResult:
             "moveCounters": self.move_counters,
             **({"spanTree": self.span_tree} if self.span_tree else {}),
             **({"costModel": self.cost_model} if self.cost_model else {}),
+            **({"mesh": self.mesh} if self.mesh else {}),
             **(
                 {
                     "clusterModelStats": {
@@ -303,6 +309,24 @@ class OptimizeOptions:
     #: disables it for leadership-/disk-only fast paths and exposes
     #: ``optimizer.portfolio.cold.greedy`` for latency-sensitive callers.
     run_cold_greedy: bool = True
+    #: run the SA phase sharded over a device mesh (config
+    #: ``optimizer.mesh.enabled``): chains ride the mesh as data
+    #: parallelism and, with ``mesh_parts > 1``, the model's partition
+    #: axis is sharded inside the search (ccx.parallel.sharding — the B6
+    #: axis). The mesh path is CHUNK-DRIVEN like the single-chip anneal
+    #: (bounded compile, per-chunk heartbeats, cost capture); after the
+    #: anneal the winning placement is re-homed to the default device so
+    #: every downstream phase shares the single-chip compiled programs.
+    #: Ignored (with a log note) when fewer than two devices are visible.
+    mesh_enabled: bool = False
+    #: devices for the mesh; 0 = all visible (config
+    #: ``optimizer.mesh.devices``)
+    mesh_devices: int = 0
+    #: partition-axis factor of the mesh — chains = devices // parts
+    #: (config ``optimizer.mesh.parts``). 1 = chains-only data
+    #: parallelism; raise for clusters whose model shards (100k+
+    #: partitions) dominate chain parallelism.
+    mesh_parts: int = 1
 
 
 def prewarm_options(opts: OptimizeOptions) -> OptimizeOptions:
@@ -353,6 +377,39 @@ def prewarm_options(opts: OptimizeOptions) -> OptimizeOptions:
         topic_rebalance_polish_iters=None,
         leader_pass_max_iters=1 if opts.leader_pass_max_iters else None,
     )
+
+
+def _make_run_mesh(opts: OptimizeOptions):
+    """Build the run mesh from ``opts.mesh_*`` (None = run single-device).
+
+    Degrades with a log note instead of aborting: fewer than two visible
+    devices, or a parts factor that does not divide the device count,
+    must never kill a proposal — the single-chip path is always correct.
+    """
+    import logging
+
+    import jax
+
+    from ccx.parallel.sharding import make_mesh
+
+    log = logging.getLogger(__name__)
+    devices = jax.devices()
+    if opts.mesh_devices > 0:
+        devices = devices[: opts.mesh_devices]
+    if len(devices) < 2:
+        log.warning(
+            "optimizer.mesh.enabled but only %d device(s) visible; "
+            "running single-device", len(devices),
+        )
+        return None
+    parts = max(int(opts.mesh_parts), 1)
+    if len(devices) % parts:
+        log.warning(
+            "optimizer.mesh.parts=%d does not divide %d devices; "
+            "falling back to chains-only (parts=1)", parts, len(devices),
+        )
+        parts = 1
+    return make_mesh(devices, parts=parts)
 
 
 #: goals a leadership-only move can improve — stacks scoring none of these
@@ -463,6 +520,7 @@ def _optimize(
 
     stack_before = evaluate_stack(m, cfg, goal_names)
     inter = allows_inter_broker(goal_names)
+    mesh = _make_run_mesh(opts) if opts.mesh_enabled else None
     overlap = (
         opts.overlap_repair
         and inter
@@ -506,12 +564,18 @@ def _optimize(
         chains=opts.anneal.n_chains,
         steps=opts.anneal.n_steps,
         chunkSteps=opts.anneal.chunk_steps,
+        **(
+            {"mesh": dict(zip(mesh.axis_names, mesh.devices.shape))}
+            if mesh is not None
+            else {}
+        ),
     ):
         if overlap:
             chunk = opts.anneal.chunk_steps
             sa1 = anneal(
                 m, cfg, goal_names,
                 dataclasses.replace(opts.anneal, n_steps=chunk),
+                mesh=mesh,
             )
             _tally(sa1)
             t_join = time.monotonic()
@@ -541,6 +605,7 @@ def _optimize(
                     n_steps=opts.anneal.n_steps - chunk,
                     seed=opts.anneal.seed + 1,
                 ),
+                mesh=mesh,
             )
             sa = dataclasses.replace(sa, n_accepted=sa.n_accepted + n_sa1)
         elif n_repair_lazy is not None and inter:
@@ -550,15 +615,27 @@ def _optimize(
             evac = hot_partition_list_device(
                 repaired, goal_names=goal_names, cfg=cfg
             )
-            sa = anneal(repaired, cfg, goal_names, opts.anneal, evac=evac)
+            sa = anneal(
+                repaired, cfg, goal_names, opts.anneal, mesh=mesh, evac=evac
+            )
         else:
-            sa = anneal(repaired, cfg, goal_names, opts.anneal)
+            sa = anneal(repaired, cfg, goal_names, opts.anneal, mesh=mesh)
     _tally(sa)
     if n_repair_lazy is not None:
         # the anneal consumed the repaired arrays, so this sync is free
         n_repair = int(n_repair_lazy)
     model = sa.model
     stack_after = sa.stack_after
+    if mesh is not None:
+        # re-home the winning placement to the default device: every
+        # downstream phase (polish, shed, swap-polish, leader pass, diff,
+        # verify) then shares the SINGLE-CHIP compiled programs — the mesh
+        # accelerates the SA search, the pipeline's protections and
+        # program caches stay exactly as on one chip
+        import jax as _jax
+
+        d0 = _jax.devices()[0]
+        model = _jax.tree.map(lambda a: _jax.device_put(a, d0), model)
     n_polish = n_repair
     with _phase("polish", iters=opts.polish.max_iters, run=opts.run_polish):
         if opts.run_polish:
@@ -774,6 +851,15 @@ def _optimize(
         }
         REGISTRY.counter(f"proposal-moves-{name}-proposed").inc(kind_prop[i])
         REGISTRY.counter(f"proposal-moves-{name}-accepted").inc(kind_acc[i])
+    mesh_info = None
+    if mesh is not None:
+        from ccx.parallel.sharding import program_cache_stats
+
+        mesh_info = {
+            "meshShape": dict(zip(mesh.axis_names, mesh.devices.shape)),
+            "devices": int(mesh.size),
+            "shardedPrograms": program_cache_stats(),
+        }
     return OptimizerResult(
         proposals=proposals,
         stack_before=stack_before,
@@ -785,6 +871,7 @@ def _optimize(
         n_polish_moves=n_polish,
         phase_seconds=phases,
         move_counters=move_counters,
+        mesh=mesh_info,
         input_model=m,
     )
 

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ccx.common import costmodel
 from ccx.goals import partition_terms as pt
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult
@@ -35,6 +36,26 @@ from ccx.model.tensor_model import TensorClusterModel
 
 CHAINS_AXIS = "chains"
 PARTS_AXIS = "parts"
+
+
+def _shard_map(body, mesh, in_specs, out_specs, check: bool = True):
+    """``shard_map`` across jax versions: newer jax exposes
+    ``jax.shard_map`` with a ``check_vma`` knob; 0.4.x ships it under
+    ``jax.experimental.shard_map`` with ``check_rep``. Both knobs gate the
+    same class of replication/varying-axes validation that the SA scan
+    carry trips (axis-invariant init values mixed with axis-varying
+    updates), so ``check=False`` maps onto whichever exists."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )
 
 
 def make_mesh(
@@ -146,8 +167,24 @@ def _cache_put(cache: "OrderedDict", key, fn) -> None:
 
 #: (mesh, goal_names, cfg, struct) -> jitted sharded stack evaluator
 _EVAL_CACHE: "OrderedDict" = OrderedDict()
-#: sharded_anneal static config -> jitted run program
+#: sharded_anneal static config -> jitted program. Tagged keys share one
+#: LRU: ("init", ...) chain-init, ("chunk", ...) the traced-budget chunk
+#: program (n_steps/t1/ramp retunes hit the SAME entry), ("run", ...) the
+#: monolithic one-shot scan.
 _RUN_CACHE: "OrderedDict" = OrderedDict()
+
+
+def program_cache_stats() -> dict:
+    """Live sharded-program cache occupancy — the ``shardedPrograms``
+    block surfaced on ``AnalyzerState.observability`` and BENCH lines so
+    an operator can see how many compiled mesh programs are resident (and
+    whether a retune minted a new one, which it never should for
+    chunk-driven budget changes)."""
+    return {
+        "run": len(_RUN_CACHE),
+        "eval": len(_EVAL_CACHE),
+        "max": _CACHE_MAX,
+    }
 
 
 def sharded_stack_eval(
@@ -229,8 +266,10 @@ def sharded_stack_eval(
             cost.append(c)
         return jnp.stack(vio), jnp.stack(cost)
 
-    fn = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=(specs,), out_specs=(P(), P()))
+    fn = costmodel.instrument("sharded-stack-eval")(
+        jax.jit(
+            _shard_map(body, mesh, in_specs=(specs,), out_specs=(P(), P()))
+        )
     )
     _cache_put(_EVAL_CACHE, cache_key, fn)
     violations, costs = fn(m)
@@ -275,6 +314,7 @@ def sharded_anneal(
     goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
     opts=None,
     mesh: Mesh | None = None,
+    evac=None,
 ):
     """Batched SA with the model's partition axis sharded inside the search
     (SURVEY.md section 5.7, the long-context analogue): model tensors stay
@@ -283,15 +323,37 @@ def sharded_anneal(
 
     Per proposal, the shard owning the drawn partition gathers its
     PartitionView locally and one ``psum`` over ICI broadcasts it (O(R)
-    scalars — the only per-step collective); every shard then scores and
-    accepts identically (replicated RNG), and only the owner writes the
-    placement row. Aggregates/accumulators are replicated per chain and
-    updated identically everywhere, so no resynchronization is ever needed.
+    scalars — the only per-step collective; batched steps amortize it to
+    ONE stacked gather+psum per step); every shard then scores and accepts
+    identically (replicated RNG), and only the owner writes the placement
+    row. Aggregates/accumulators are replicated per chain and updated
+    identically everywhere, so no resynchronization is ever needed.
+
+    With ``opts.chunk_steps > 0`` the run is CHUNK-DRIVEN (the production
+    path — ``anneal(mesh=...)`` and ``optimize()`` land here): one
+    compiled shard_map chunk program per static shape, with the step
+    budget, cooling schedule and swap ramp entering as traced data —
+    retunes never recompile — driven by ``annealer.drive_chunks``, so a
+    mesh run emits the same per-chunk flight-recorder heartbeats, obeys
+    the stall watchdog and banks ``costmodel`` capture exactly like the
+    single-chip chunk engine. SA chunks return no early-exit scalar: zero
+    host syncs, the chunks queue on the device streams and the chunk
+    boundary costs only the heartbeat. ``chunk_steps == 0`` keeps the
+    one-shot monolithic scan (compile keyed on the step count — the
+    parity reference).
+
+    ``opts.n_chains`` is rounded up to the next multiple of the mesh's
+    chain ranks when it does not divide (logged, never an abort);
+    ``evac`` optionally supplies a precomputed hot-partition list
+    ``(indices, count)`` like ``anneal``.
 
     Semantics match ``ccx.search.anneal`` (same RNG stream, same acceptance
     rule); results can differ only by float reduction order in the initial
     psummed aggregates.
     """
+    import dataclasses as _dc
+
+    from ccx.common.tracing import TRACER
     from ccx.goals.stack import evaluate_stack, soft_weights
     from ccx.search.annealer import (
         CAPACITY_GOALS as CAPACITY_GOALS_,
@@ -304,8 +366,10 @@ def sharded_anneal(
         _swap_ramp_of,
         allows_inter_broker,
         best_chain_index,
+        drive_chunks,
         hot_partition_list,
         lead_swap_share,
+        round_up_chains,
     )
     from ccx.search.state import (
         PartitionView,
@@ -329,17 +393,17 @@ def sharded_anneal(
     n_chain_ranks = mesh.shape[CHAINS_AXIS]
     if m.P % n_parts:
         raise ValueError(f"padded P={m.P} not divisible by parts={n_parts}")
-    if opts.n_chains % n_chain_ranks:
-        raise ValueError(
-            f"n_chains={opts.n_chains} not divisible by chains axis "
-            f"{n_chain_ranks}"
-        )
+    n_chains = round_up_chains(opts.n_chains, n_chain_ranks, "sharded_anneal")
+    if n_chains != opts.n_chains:
+        opts = _dc.replace(opts, n_chains=n_chains)
 
     stack_before = evaluate_stack(m, cfg, goal_names)
     p_real = int(np.asarray(m.partition_valid).sum())
     bv = np.asarray(m.broker_valid)
     b_real = int(np.max(np.where(bv, np.arange(m.B), -1))) + 1
-    evac_np, n_evac_i = hot_partition_list(m, goal_names, cfg)
+    evac_np, n_evac_i = (
+        evac if evac is not None else hot_partition_list(m, goal_names, cfg)
+    )
 
     hard_mask = tuple(GOAL_REGISTRY[n].hard for n in goal_names)
     allow_inter = allows_inter_broker(goal_names)
@@ -384,24 +448,6 @@ def sharded_anneal(
         else None
     )
 
-    # Reuse the compiled program across calls (see _struct_key: a fresh jit
-    # closure per call would retrace + recompile every time — ~26 s/call at
-    # 256 brokers / 16k partitions). Keyed on every static the closure
-    # captures; array shapes are covered by _struct_key + jit's own
-    # shape-based retrace.
-    cache_key = (
-        mesh, goal_names, cfg, pp, b_real,
-        opts.n_steps, opts.t0, opts.t1, opts.moves_per_step, opts.batched,
-        opts.p_swap_end,
-        needs_topic, _struct_key(m),
-    )
-    cached_run = _cache_get(_RUN_CACHE, cache_key)
-    if cached_run is not None:
-        states = cached_run(m_sharded, keys, evac, n_evac, group_rep)
-        return _finish_sharded_anneal(
-            m_sharded, states, cfg, goal_names, opts, stack_before
-        )
-
     mspecs = model_pspecs(m)
     state_specs = SearchState(
         assignment=P(CHAINS_AXIS, PARTS_AXIS, None),
@@ -435,179 +481,323 @@ def sharded_anneal(
         n_acc_kind=P(CHAINS_AXIS, None),
     )
 
+    group_specs = (
+        TopicGroup(members=P(), member_slot=P()) if needs_topic else None
+    )
+
     import functools as _ft
 
-    @_ft.partial(jax.jit, static_argnames=())
-    def run(m_s, keys_s, evac_s, n_evac_s, group_arg):
-        def body(m_local, keys_local, evac_l, n_evac_l, group_l):
-            P_local = m_local.assignment.shape[0]
-            offset = jax.lax.axis_index(PARTS_AXIS) * P_local
+    # ---- shard-local building blocks ------------------------------------
+    # Shared by the monolithic scan and the chunked program bodies. These
+    # are per-call closures; the compiled programs built from them are
+    # cached at module level keyed on EVERY static they capture (see the
+    # cache keys below), so a later call with an identical key safely
+    # reuses the first call's closures.
 
-            # ---- init: partial sums + psum -> replicated bookkeeping ------
-            agg = _psum_tree(broker_aggregates(m_local), PARTS_AXIS)
-            part_sums = jax.lax.psum(
-                pt.partition_sums(
-                    m_local,
-                    m_local.assignment,
-                    m_local.leader_slot,
-                    m_local.replica_disk,
-                    m_local.partition_valid,
-                ),
-                PARTS_AXIS,
-            )
-            mtl_sum = jnp.sum(
-                tt_.mtl_row(
-                    m_local, cfg, m_local.topic_min_leaders, agg.topic_leader_count
-                )
-            )
-            pen, _ = tt_.trd_row_pen(m_local, cfg, agg.topic_replica_count)
-            trd_sum = jnp.sum(pen)
-            topic_totals = tt_.trd_row_total(m_local, agg.topic_replica_count)
-            trd_norm = tt_.trd_normalizer(m_local, topic_totals)
-            cost_vec = make_cost_vector_fn(m_local, goal_names, cfg)(
-                agg, part_sums, mtl_sum, trd_sum, trd_norm
-            )
-            # search never carries the [T, B] matrices (ccx.search.state
-            # module docstring) — loud dummies, same as init_search_state
-            agg = agg.replace(
-                topic_replica_count=jnp.zeros((1, 1), jnp.int32),
-                topic_leader_count=jnp.zeros((1, 1), jnp.int32),
-            )
-            # grouped placement mirror, replicated: each member partition is
-            # owned by exactly one shard, which contributes row+1 (others 0);
-            # the psum minus 1 reconstructs the row (-1 for pad entries)
-            ga = gl = None
-            if group_l is not None:
-                mp = group_l.members
-                li = mp - offset
-                mine = (mp >= 0) & (li >= 0) & (li < P_local)
-                lic = jnp.clip(li, 0, P_local - 1)
-                ga = (
-                    jax.lax.psum(
-                        jnp.where(
-                            mine[..., None],
-                            m_local.assignment[lic] + 1,
-                            0,
-                        ),
-                        PARTS_AXIS,
-                    )
-                    - 1
-                )
-                gl = (
-                    jax.lax.psum(
-                        jnp.where(mine, m_local.leader_slot[lic] + 1, 0),
-                        PARTS_AXIS,
-                    )
-                    - 1
-                )
-            state0 = SearchState(
-                assignment=m_local.assignment,
-                leader_slot=m_local.leader_slot,
-                replica_disk=m_local.replica_disk,
-                agg=agg,
-                part_sums=part_sums,
-                topic_totals=topic_totals,
-                mtl_sum=mtl_sum,
-                trd_sum=trd_sum,
-                cost_vec=cost_vec,
-                key=keys_local[0],
-                n_accepted=jnp.asarray(0, jnp.int32),
-                hard_mask=hard_mask,
-                grouped_assign=ga,
-                grouped_leader=gl,
-                n_prop_kind=jnp.zeros(3, jnp.int32),
-                n_acc_kind=jnp.zeros(3, jnp.int32),
-            )
-            states = jax.vmap(lambda k: state0.replace(key=k))(keys_local)
-
-            # ---- sharding hooks ------------------------------------------
-            def gather(ss, _m, ps):
-                # stacked owner-gather + psum: ps is int32[k] of GLOBAL ids
-                li = jnp.clip(ps - offset, 0, P_local - 1)
-                owned = (ps >= offset) & (ps < offset + P_local)
-                view_local = PartitionView(
-                    pvalid=m_local.partition_valid[li] & owned,
-                    immovable=m_local.partition_immovable[li] & owned,
-                    topic=m_local.partition_topic[li],
-                    lead_load=m_local.leader_load[:, li].T,
-                    foll_load=m_local.follower_load[:, li].T,
-                    assign=ss.assignment[li],
-                    leader=ss.leader_slot[li],
-                    disk=ss.replica_disk[li],
-                )
-                return _psum_tree(_mask_view(view_local, owned), PARTS_AXIS)
-
-            def locate(p):
-                owned = (p >= offset) & (p < offset + P_local)
-                return jnp.clip(p - offset, 0, P_local - 1), owned
-
-
-            hard_arr = jnp.asarray(hard_mask)
-            weights = soft_weights(hard_mask)
-            n = max(opts.n_steps, 1)
-            decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
-            # same small-cluster + p_swap gate as annealer._run_chains
-            # (p_swap == 0 stacks keep the sequential inner_single_only
-            # fast path — one use per carried buffer)
-            batched = (
-                opts.batched
-                and opts.moves_per_step > 1
-                and (pp.p_swap > 0.0 or schedule_on)
-                and b_real >= 4 * m_local.R * opts.moves_per_step
-            )
-            step = _ft.partial(
-                _anneal_step_batched if batched else _anneal_step,
-                m=m_local,
-                pp=pp,
-                hard_arr=hard_arr,
-                weights=weights,
-                moves_per_step=max(opts.moves_per_step, 1),
-                scorer=make_move_scorer(m_local, goal_names, cfg),
-                swap_scorer=make_swap_scorer(m_local, goal_names, cfg),
-                gather=gather,
-                locate=locate,
-                group=group_l,
-                swap_ramp=_swap_ramp_of(opts, n),
-                swap_schedule_on=schedule_on,
-                cfg=cfg,
-                **(
-                    {
-                        "vector_fn": make_cost_vector_fn(
-                            m_local, goal_names, cfg
-                        )
-                    }
-                    if batched
-                    else {}
-                ),
-            )
-
-            def scan_body(ss, t):
-                temp = opts.t0 * decay**t
-                ss = jax.vmap(step, in_axes=(0, None, None, None, None))(
-                    ss, temp, t, evac_l, n_evac_l
-                )
-                return ss, None
-
-            states, _ = jax.lax.scan(scan_body, states, jnp.arange(n))
-            return states
-
-        group_specs = (
-            TopicGroup(members=P(), member_slot=P())
-            if group_arg is not None
-            else None
+    def _init_states(m_local, keys_local, group_l):
+        """Init section: partial sums + psum -> replicated bookkeeping,
+        grouped-placement mirror reconstruction, vmapped chain states."""
+        P_local = m_local.assignment.shape[0]
+        offset = jax.lax.axis_index(PARTS_AXIS) * P_local
+        agg = _psum_tree(broker_aggregates(m_local), PARTS_AXIS)
+        part_sums = jax.lax.psum(
+            pt.partition_sums(
+                m_local,
+                m_local.assignment,
+                m_local.leader_slot,
+                m_local.replica_disk,
+                m_local.partition_valid,
+            ),
+            PARTS_AXIS,
         )
-        return jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(mspecs, P(CHAINS_AXIS, None), P(), P(), group_specs),
-            out_specs=state_specs,
-            # the scan carry mixes axis-invariant init values with
-            # axis-varying updates; skip the varying-manual-axes check
-            check_vma=False,
-        )(m_s, keys_s, evac_s, n_evac_s, group_arg)
+        mtl_sum = jnp.sum(
+            tt_.mtl_row(
+                m_local, cfg, m_local.topic_min_leaders, agg.topic_leader_count
+            )
+        )
+        pen, _ = tt_.trd_row_pen(m_local, cfg, agg.topic_replica_count)
+        trd_sum = jnp.sum(pen)
+        topic_totals = tt_.trd_row_total(m_local, agg.topic_replica_count)
+        trd_norm = tt_.trd_normalizer(m_local, topic_totals)
+        cost_vec = make_cost_vector_fn(m_local, goal_names, cfg)(
+            agg, part_sums, mtl_sum, trd_sum, trd_norm
+        )
+        # search never carries the [T, B] matrices (ccx.search.state
+        # module docstring) — loud dummies, same as init_search_state
+        agg = agg.replace(
+            topic_replica_count=jnp.zeros((1, 1), jnp.int32),
+            topic_leader_count=jnp.zeros((1, 1), jnp.int32),
+        )
+        # grouped placement mirror, replicated: each member partition is
+        # owned by exactly one shard, which contributes row+1 (others 0);
+        # the psum minus 1 reconstructs the row (-1 for pad entries)
+        ga = gl = None
+        if group_l is not None:
+            mp = group_l.members
+            li = mp - offset
+            mine = (mp >= 0) & (li >= 0) & (li < P_local)
+            lic = jnp.clip(li, 0, P_local - 1)
+            ga = (
+                jax.lax.psum(
+                    jnp.where(
+                        mine[..., None],
+                        m_local.assignment[lic] + 1,
+                        0,
+                    ),
+                    PARTS_AXIS,
+                )
+                - 1
+            )
+            gl = (
+                jax.lax.psum(
+                    jnp.where(mine, m_local.leader_slot[lic] + 1, 0),
+                    PARTS_AXIS,
+                )
+                - 1
+            )
+        state0 = SearchState(
+            assignment=m_local.assignment,
+            leader_slot=m_local.leader_slot,
+            replica_disk=m_local.replica_disk,
+            agg=agg,
+            part_sums=part_sums,
+            topic_totals=topic_totals,
+            mtl_sum=mtl_sum,
+            trd_sum=trd_sum,
+            cost_vec=cost_vec,
+            key=keys_local[0],
+            n_accepted=jnp.asarray(0, jnp.int32),
+            hard_mask=hard_mask,
+            grouped_assign=ga,
+            grouped_leader=gl,
+            n_prop_kind=jnp.zeros(3, jnp.int32),
+            n_acc_kind=jnp.zeros(3, jnp.int32),
+        )
+        return jax.vmap(lambda k: state0.replace(key=k))(keys_local)
 
-    _cache_put(_RUN_CACHE, cache_key, run)
-    states = run(m_sharded, keys, evac, n_evac, group_rep)
+    def _make_step(m_local, group_l, swap_ramp):
+        """The shard-local step partial: owner-gather/locate sharding hooks
+        around the SAME _anneal_step bodies the single-chip engine runs.
+        ``swap_ramp`` may be a python float (monolith — folded statically)
+        or a traced scalar (chunk program — schedule retunes reuse it)."""
+        P_local = m_local.assignment.shape[0]
+        offset = jax.lax.axis_index(PARTS_AXIS) * P_local
+
+        def gather(ss, _m, ps):
+            # stacked owner-gather + psum: ps is int32[k] of GLOBAL ids
+            li = jnp.clip(ps - offset, 0, P_local - 1)
+            owned = (ps >= offset) & (ps < offset + P_local)
+            view_local = PartitionView(
+                pvalid=m_local.partition_valid[li] & owned,
+                immovable=m_local.partition_immovable[li] & owned,
+                topic=m_local.partition_topic[li],
+                lead_load=m_local.leader_load[:, li].T,
+                foll_load=m_local.follower_load[:, li].T,
+                assign=ss.assignment[li],
+                leader=ss.leader_slot[li],
+                disk=ss.replica_disk[li],
+            )
+            return _psum_tree(_mask_view(view_local, owned), PARTS_AXIS)
+
+        def locate(p):
+            owned = (p >= offset) & (p < offset + P_local)
+            return jnp.clip(p - offset, 0, P_local - 1), owned
+
+        # same small-cluster + p_swap gate as annealer._run_chains
+        # (p_swap == 0 stacks keep the sequential inner_single_only
+        # fast path — one use per carried buffer)
+        batched = (
+            opts.batched
+            and opts.moves_per_step > 1
+            and (pp.p_swap > 0.0 or schedule_on)
+            and b_real >= 4 * m_local.R * opts.moves_per_step
+        )
+        return _ft.partial(
+            _anneal_step_batched if batched else _anneal_step,
+            m=m_local,
+            pp=pp,
+            hard_arr=jnp.asarray(hard_mask),
+            weights=soft_weights(hard_mask),
+            moves_per_step=max(opts.moves_per_step, 1),
+            scorer=make_move_scorer(m_local, goal_names, cfg),
+            swap_scorer=make_swap_scorer(m_local, goal_names, cfg),
+            gather=gather,
+            locate=locate,
+            group=group_l,
+            swap_ramp=swap_ramp,
+            swap_schedule_on=schedule_on,
+            cfg=cfg,
+            **(
+                {"vector_fn": make_cost_vector_fn(m_local, goal_names, cfg)}
+                if batched
+                else {}
+            ),
+        )
+
+    n = max(opts.n_steps, 1)
+    decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
+
+    # shape-keyed engine span (the greedy descent idiom): drive_chunks
+    # heartbeats attach the live chunk index here, so a flight recording
+    # of a wedged mesh run names the sharded program and how deep it got
+    with TRACER.span(
+        "sharded-anneal",
+        chains=opts.n_chains, steps=opts.n_steps,
+        chunkSteps=opts.chunk_steps,
+        meshChains=n_chain_ranks, meshParts=n_parts,
+    ):
+        if opts.chunk_steps > 0:
+            # ---- chunk-driven path (the production mesh path) ------------
+            # One compiled shard_map chunk program per static shape; the
+            # step budget (n_total), cooling schedule (t_offset, decay) and
+            # swap ramp enter as TRACED scalars — n_steps/t1/p_swap_end
+            # retunes never recompile (t >= n_total steps are inert, the
+            # single-chip _run_chunk contract). Driven by drive_chunks: one
+            # heartbeat per chunk, no device sync (SA returns done=None).
+            chunk = int(opts.chunk_steps)
+            init_key = (
+                "init", mesh, goal_names, cfg, needs_topic, _struct_key(m),
+            )
+            init_fn = _cache_get(_RUN_CACHE, init_key)
+            if init_fn is None:
+
+                def _init_run(m_s, keys_s, group_arg):
+                    # init mixes axis-invariant model stats with
+                    # axis-varying keys; skip the varying-axes check
+                    return _shard_map(
+                        _init_states,
+                        mesh,
+                        in_specs=(mspecs, P(CHAINS_AXIS, None), group_specs),
+                        out_specs=state_specs,
+                        check=False,
+                    )(m_s, keys_s, group_arg)
+
+                init_fn = costmodel.instrument("sharded-chain-init")(
+                    jax.jit(_init_run)
+                )
+                _cache_put(_RUN_CACHE, init_key, init_fn)
+
+            chunk_key = (
+                "chunk", mesh, goal_names, cfg, pp, b_real,
+                opts.t0, opts.moves_per_step, opts.batched, schedule_on,
+                needs_topic, chunk, _struct_key(m),
+            )
+            chunk_fn = _cache_get(_RUN_CACHE, chunk_key)
+            if chunk_fn is None:
+
+                def _chunk_run(states, m_s, evac_s, n_evac_s, group_arg,
+                               t_offset, decay_t, ramp_t, n_total):
+                    def body(ss, m_local, evac_l, n_evac_l, group_l,
+                             t_off, dec, ramp, n_tot):
+                        step = _make_step(m_local, group_l, ramp)
+
+                        def scan_body(s, t):
+                            def active(si):
+                                temp = opts.t0 * dec**t
+                                return jax.vmap(
+                                    step, in_axes=(0, None, None, None, None)
+                                )(si, temp, t, evac_l, n_evac_l)
+
+                            return (
+                                jax.lax.cond(
+                                    t < n_tot, active, lambda si: si, s
+                                ),
+                                None,
+                            )
+
+                        ss, _ = jax.lax.scan(
+                            scan_body, ss, t_off + jnp.arange(chunk)
+                        )
+                        return ss
+
+                    # the scan carry mixes axis-invariant init values
+                    # with axis-varying updates; skip the check
+                    return _shard_map(
+                        body,
+                        mesh,
+                        in_specs=(
+                            state_specs, mspecs, P(), P(), group_specs,
+                            P(), P(), P(), P(),
+                        ),
+                        out_specs=state_specs,
+                        check=False,
+                    )(states, m_s, evac_s, n_evac_s, group_arg,
+                      t_offset, decay_t, ramp_t, n_total)
+
+                chunk_fn = costmodel.instrument(
+                    "sharded-sa-chunk", iters=lambda k, c=chunk: c
+                )(jax.jit(_chunk_run, donate_argnums=(0,)))
+                _cache_put(_RUN_CACHE, chunk_key, chunk_fn)
+
+            rep = NamedSharding(mesh, P())
+            decay_j = jax.device_put(jnp.float32(decay), rep)
+            ramp_j = jax.device_put(
+                jnp.float32(_swap_ramp_of(opts, n)), rep
+            )
+            n_j = jax.device_put(jnp.asarray(n, jnp.int32), rep)
+            states = init_fn(m_sharded, keys, group_rep)
+
+            def run_one(ss, off):
+                off_j = jax.device_put(jnp.asarray(off, jnp.int32), rep)
+                return chunk_fn(
+                    ss, m_sharded, evac, n_evac, group_rep,
+                    off_j, decay_j, ramp_j, n_j,
+                ), None
+
+            states = drive_chunks(run_one, states, total=n, chunk=chunk)
+        else:
+            # ---- monolithic one-shot scan (parity reference) -------------
+            # Reuse the compiled program across calls (see _struct_key: a
+            # fresh jit closure per call would retrace + recompile every
+            # time — ~26 s/call at 256 brokers / 16k partitions). Keyed on
+            # every static the closure captures; shapes are covered by
+            # _struct_key + jit's own shape-based retrace.
+            cache_key = (
+                "run", mesh, goal_names, cfg, pp, b_real,
+                opts.n_steps, opts.t0, opts.t1, opts.moves_per_step,
+                opts.batched, opts.p_swap_end,
+                needs_topic, _struct_key(m),
+            )
+            run = _cache_get(_RUN_CACHE, cache_key)
+            if run is None:
+
+                def _run(m_s, keys_s, evac_s, n_evac_s, group_arg):
+                    def body(m_local, keys_local, evac_l, n_evac_l, group_l):
+                        states = _init_states(m_local, keys_local, group_l)
+                        step = _make_step(
+                            m_local, group_l, _swap_ramp_of(opts, n)
+                        )
+
+                        def scan_body(ss, t):
+                            temp = opts.t0 * decay**t
+                            ss = jax.vmap(
+                                step, in_axes=(0, None, None, None, None)
+                            )(ss, temp, t, evac_l, n_evac_l)
+                            return ss, None
+
+                        states, _ = jax.lax.scan(
+                            scan_body, states, jnp.arange(n)
+                        )
+                        return states
+
+                    # the scan carry mixes axis-invariant init values
+                    # with axis-varying updates; skip the check
+                    return _shard_map(
+                        body,
+                        mesh,
+                        in_specs=(
+                            mspecs, P(CHAINS_AXIS, None), P(), P(),
+                            group_specs,
+                        ),
+                        out_specs=state_specs,
+                        check=False,
+                    )(m_s, keys_s, evac_s, n_evac_s, group_arg)
+
+                run = costmodel.instrument(
+                    "sharded-sa-monolith", iters=lambda k, it=n: it
+                )(jax.jit(_run))
+                _cache_put(_RUN_CACHE, cache_key, run)
+            states = run(m_sharded, keys, evac, n_evac, group_rep)
     return _finish_sharded_anneal(
         m_sharded, states, cfg, goal_names, opts, stack_before
     )

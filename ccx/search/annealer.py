@@ -116,9 +116,10 @@ class AnnealOptions:
     #: the effort ladder/retunes stop paying them per rung. 0 (default):
     #: single scan of n_steps (compile keyed on it). Results are bit-exact
     #: either way (same step body, same f32 temperature schedule).
-    #: Chunking applies only to the single-device path: ``anneal(mesh=...)``
-    #: falls back to the one-shot scan (the sharded runner in ccx.parallel
-    #: keeps its own program cache keyed on static config).
+    #: Chunking covers EVERY drive path: single-device, chains-mesh data
+    #: parallelism, and the partition-axis-sharded engine in ccx.parallel
+    #: (whose chunk program cache is keyed on static config, budgets
+    #: traced) — a mesh run keeps bounded compile + per-chunk heartbeats.
     chunk_steps: int = 0
     seed: int = 0
 
@@ -1543,6 +1544,25 @@ def best_chain_index(cost_vecs: np.ndarray) -> int:
     return int(order[0])
 
 
+def round_up_chains(n_chains: int, ranks: int, where: str) -> int:
+    """Next multiple of ``ranks`` >= ``n_chains``, with a logged note.
+
+    A campaign retune (or an odd device count) used to abort with a hard
+    ``ValueError`` when the chain count did not divide the mesh; rounding
+    up instead costs a few extra chains (more search, same wall — chains
+    are the embarrassingly-parallel axis) and never kills a window."""
+    if ranks <= 1 or n_chains % ranks == 0:
+        return max(n_chains, ranks)
+    rounded = ((n_chains + ranks - 1) // ranks) * ranks
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "%s: n_chains=%d not divisible by mesh chain ranks %d; "
+        "rounding up to %d", where, n_chains, ranks, rounded,
+    )
+    return rounded
+
+
 def anneal(
     m: TensorClusterModel,
     cfg: GoalConfig = GoalConfig(),
@@ -1560,11 +1580,17 @@ def anneal(
     re-evaluated from scratch (incremental float drift cannot leak into
     reported results).
 
-    With ``mesh`` (a jax.sharding.Mesh), chains are sharded across every mesh
-    device — pure data parallelism over the batch axis (ccx.parallel); the
-    model and evacuation list are replicated. ``opts.n_chains`` must divide
-    evenly by the mesh size. Partition-axis sharding of the model inside the
-    search lives in ccx.parallel (sharded stack evaluation; sharded search).
+    With ``mesh`` (a jax.sharding.Mesh), the run is sharded across every
+    mesh device. A mesh whose ``parts`` axis is >1 (and divides the padded
+    P) dispatches to the partition-axis-sharded engine
+    (``ccx.parallel.sharding.sharded_anneal`` — model tensors stay sharded
+    for the whole run); otherwise chains ride the mesh as pure data
+    parallelism with the model and evacuation list replicated. Either way
+    the CHUNKED driver applies when ``opts.chunk_steps > 0`` — a mesh run
+    gets the same bounded compile, per-chunk heartbeats and flight-recorder
+    evidence as a single-chip run (pre-round-11 mesh runs silently fell
+    back to the one-shot scan). ``opts.n_chains`` is rounded UP to the next
+    mesh multiple when it does not divide (logged, never an abort).
 
     ``evac`` optionally supplies a precomputed hot-partition list as
     ``(indices int32[P], count)`` — device arrays are fine. The optimizer's
@@ -1572,6 +1598,28 @@ def anneal(
     so this function never has to materialize the (possibly still
     in-flight) placement to host; None computes the host list as before.
     """
+    if mesh is not None:
+        # partition-axis mesh: hand the whole run to the sharded engine
+        # (ccx.parallel) — it shares this function's RNG stream/acceptance
+        # rule and, with chunk_steps > 0, the chunked drive contract. A
+        # parts axis that does not divide the padded P falls through to
+        # chains-only data parallelism with a note (never an abort).
+        parts = dict(zip(mesh.axis_names, mesh.devices.shape)).get("parts", 1)
+        if parts > 1:
+            if int(m.P) % parts == 0:
+                from ccx.parallel.sharding import sharded_anneal
+
+                return sharded_anneal(
+                    m, cfg, goal_names, opts, mesh, evac=evac
+                )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "anneal: padded P=%d not divisible by mesh parts=%d; "
+                "running chains-only data parallelism over the %d devices",
+                int(m.P), parts, mesh.size,
+            )
+
     stack_before = evaluate_stack(m, cfg, goal_names)
     p_real = int(np.asarray(m.partition_valid).sum())
     bv = np.asarray(m.broker_valid)
@@ -1580,28 +1628,34 @@ def anneal(
         evac if evac is not None else hot_partition_list(m, goal_names, cfg)
     )
 
-    keys = jax.random.split(jax.random.PRNGKey(opts.seed), opts.n_chains)
+    n_chains = opts.n_chains
+    if mesh is not None:
+        n_chains = round_up_chains(n_chains, mesh.size, "anneal")
+    keys = jax.random.split(jax.random.PRNGKey(opts.seed), n_chains)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
-        if opts.n_chains % mesh.size:
-            raise ValueError(
-                f"n_chains={opts.n_chains} not divisible by mesh size {mesh.size}"
-            )
         keys = jax.device_put(
             keys, NamedSharding(mesh, PartitionSpec(mesh.axis_names))
         )
         m = jax.tree.map(
             lambda a: jax.device_put(a, NamedSharding(mesh, PartitionSpec())), m
         )
+        # the evac list may arrive committed to a single device (the
+        # pipelined hot_partition_list_device path) — replicate it on the
+        # mesh or the mixed-committment jit call errors out
+        rep = NamedSharding(mesh, PartitionSpec())
+        evac = jax.device_put(jnp.asarray(evac), rep)
+        n_evac = jax.device_put(jnp.asarray(n_evac, jnp.int32), rep)
     max_pt = max_partitions_per_topic(m)
-    if mesh is None and opts.chunk_steps > 0:
+    if opts.chunk_steps > 0:
         # Chunked path: one compiled chunk program serves every step budget
         # (see _run_chunk). The chunk length is ALWAYS chunk_steps — a
         # budget that does not divide it runs its remainder as a
         # zeroed-budget tail (t >= n inert) inside the same program, so
-        # arbitrary retunes never pay a second compile. With a mesh this
-        # gate falls through to the one-shot scan.
+        # arbitrary retunes never pay a second compile. A chains-mesh run
+        # takes the SAME gate (jit caches per sharding): bounded compile,
+        # drive_chunks heartbeats and cost capture all survive the mesh.
         n = max(opts.n_steps, 1)
         decay = (opts.t1 / opts.t0) ** (1.0 / max(n - 1, 1))
         # the schedule's MAGNITUDE is traced data (swap_ramp below); only
@@ -1651,7 +1705,7 @@ def anneal(
         stack_before=stack_before,
         stack_after=stack_after,
         n_accepted=int(np.asarray(pick.n_accepted)),
-        n_chains=opts.n_chains,
+        n_chains=n_chains,
         n_steps=opts.n_steps,
         best_chain=best,
         n_prop_kind=tuple(int(x) for x in np.asarray(pick.n_prop_kind)),

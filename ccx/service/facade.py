@@ -225,6 +225,11 @@ class CruiseControl:
             ),
             repair_backend=self.config["optimizer.repair.backend"],
             overlap_repair=self.config["optimizer.repair.overlap"],
+            # mesh-sharded SA (REST-overridable like every optimizer.*
+            # key): the facade runs sharded without bespoke entry points
+            mesh_enabled=self.config["optimizer.mesh.enabled"],
+            mesh_devices=self.config["optimizer.mesh.devices"],
+            mesh_parts=self.config["optimizer.mesh.parts"],
             # swap-polish moves replicas between brokers: never on the
             # leadership-only (demote) or intra-broker (disk) fast paths
             swap_polish_iters=(
@@ -657,7 +662,15 @@ class CruiseControl:
                     # readable, so this must not leak what security.py
                     # gates at USER on /observability (recorder file path,
                     # live span/thread stacks)
-                    "observability": TRACER.observability_summary(),
+                    "observability": {
+                        **TRACER.observability_summary(),
+                        # mesh-sharded optimizer state: the configured
+                        # mesh shape and the live sharded-program cache
+                        # occupancy — an operator confirms from REST that
+                        # a mesh run is armed and that budget retunes are
+                        # not minting new compiled programs
+                        "mesh": self._mesh_state(),
+                    },
                 }
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self.anomaly_detector.state()
@@ -811,6 +824,39 @@ class CruiseControl:
         return self.load_monitor.train(start_ms, end_ms)
 
     # ----- internals --------------------------------------------------------
+
+    def _mesh_state(self) -> dict:
+        """AnalyzerState.observability.mesh: configured mesh shape + live
+        sharded-program cache stats (never raises — a broken backend must
+        not take the STATE endpoint down with it)."""
+        from ccx.parallel.sharding import program_cache_stats
+
+        out: dict = {
+            "enabled": bool(self.config["optimizer.mesh.enabled"]),
+            "parts": self.config["optimizer.mesh.parts"],
+            "shardedPrograms": program_cache_stats(),
+        }
+        if out["enabled"]:
+            # mirror optimizer._make_run_mesh exactly (clamp to visible
+            # devices, <2-device fallback, non-dividing parts -> 1), so
+            # REST reports the mesh optimize() will actually build — not
+            # a config fiction
+            try:
+                import jax
+
+                n = len(jax.devices())
+                if self.config["optimizer.mesh.devices"] > 0:
+                    n = min(n, self.config["optimizer.mesh.devices"])
+                if n < 2:
+                    out["meshShape"] = None  # runs single-device
+                else:
+                    parts = max(self.config["optimizer.mesh.parts"], 1)
+                    if n % parts:
+                        parts = 1
+                    out["meshShape"] = {"chains": n // parts, "parts": parts}
+            except Exception:  # noqa: BLE001 — state must stay readable
+                out["meshShape"] = None
+        return out
 
     def _broker_health_metrics(self) -> dict[int, dict[str, float]]:
         """Latest broker-window metrics for the concurrency adjuster (C26)."""

@@ -10,19 +10,12 @@ platform, so env vars alone are too late — jax.config must be updated before
 the first backend initialization (which is lazy, so this works).
 """
 
-import os
+from ccx.common.vmesh import force_host_devices
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+force_host_devices(8)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -205,6 +205,111 @@ def test_roofline_without_cost_model_explains(tmp_path):
     )
 
 
+# ----- multichip scaling curves ----------------------------------------------
+
+
+def _scaling_line(best=10.0, worst=12.0, *, config="B6", verified=True,
+                  effort=None):
+    return {
+        "metric": f"{config} mesh-sharded chunked anneal wall",
+        "value": best, "unit": "s", "vs_baseline": 1.0,
+        "backend": "cpu", "config": config, "scaling": True,
+        "shape": {"P": 1048576, "B": 16384},
+        "effort": effort or {"chains": 8, "steps": 50, "moves": 8,
+                             "chunk_steps": 25, "samples": 1},
+        "verified": verified,
+        "curve": [
+            {"devices": 1, "layouts": {"1x1": worst}},
+            {"devices": 2, "layouts": {"2x1": (best + worst) / 2,
+                                       "1x2": worst * 0.95}},
+            {"devices": 8, "layouts": {"8x1": best, "1x8": best * 1.1}},
+        ],
+        "speedup_vs_1dev": {"2": 1.1, "8": round(worst / best, 3)},
+    }
+
+
+def _bank_mc(tmp_path, n, line):
+    (tmp_path / f"MULTICHIP_r{n:02d}.json").write_text(json.dumps(line))
+
+
+def test_multichip_scaling_rows_parse(tmp_path):
+    _bank_mc(tmp_path, 6, _scaling_line())
+    rows, legacy = bench_ledger.load_multichip(str(tmp_path))
+    assert len(rows) == 1 and legacy == []
+    r = rows[0]
+    assert r["config"] == "B6" and r["round"] == 6
+    assert r["best"] == 10.0 and r["worst"] == 12.0
+    assert "8dev:8x1" in r["layouts"]
+
+
+def test_multichip_legacy_dryrun_is_reported_not_gated(tmp_path):
+    # the rounds-1..5 driver wrapper form: no walls, never gated
+    (tmp_path / "MULTICHIP_r01.json").write_text(
+        json.dumps({"n_devices": 8, "rc": 124, "ok": False, "tail": ""})
+    )
+    _bank_mc(tmp_path, 6, _scaling_line())
+    rows, legacy = bench_ledger.load_multichip(str(tmp_path))
+    assert len(rows) == 1 and len(legacy) == 1
+    assert "legacy dryrun" in legacy[0]["why"]
+    assert bench_ledger.check_multichip(rows) == []
+
+
+def test_multichip_worst_layout_regression_fails(tmp_path):
+    _bank_mc(tmp_path, 6, _scaling_line(10.0, 12.0))
+    # worst-layout wall 12.0 -> 13.8 (+15%) breaches the 10% gate
+    _bank_mc(tmp_path, 7, _scaling_line(10.0, 12.0 * 1.15))
+    rows, _ = bench_ledger.load_multichip(str(tmp_path))
+    failures = bench_ledger.check_multichip(rows)
+    assert len(failures) == 1 and "worst-layout" in failures[0], failures
+
+
+def test_multichip_within_threshold_passes(tmp_path):
+    _bank_mc(tmp_path, 6, _scaling_line(10.0, 12.0))
+    _bank_mc(tmp_path, 7, _scaling_line(10.0, 12.0 * 1.05))
+    rows, _ = bench_ledger.load_multichip(str(tmp_path))
+    assert bench_ledger.check_multichip(rows) == []
+
+
+def test_multichip_unverified_latest_fails(tmp_path):
+    _bank_mc(tmp_path, 6, _scaling_line(verified=False))
+    rows, _ = bench_ledger.load_multichip(str(tmp_path))
+    failures = bench_ledger.check_multichip(rows)
+    assert failures and "UNVERIFIED" in failures[0]
+
+
+def test_multichip_different_effort_not_comparable(tmp_path):
+    _bank_mc(tmp_path, 6, _scaling_line(10.0, 12.0))
+    _bank_mc(tmp_path, 7, _scaling_line(
+        10.0, 20.0, effort={"chains": 16, "steps": 100, "moves": 8,
+                            "chunk_steps": 25, "samples": 1},
+    ))
+    rows, _ = bench_ledger.load_multichip(str(tmp_path))
+    assert bench_ledger.check_multichip(rows) == []
+
+
+def test_multichip_gate_green_on_banked_artifacts():
+    """The repo's own MULTICHIP artifacts must pass the gate (legacy
+    rounds are skipped; any banked scaling curve must be verified and
+    unregressed)."""
+    rows, _legacy = bench_ledger.load_multichip(str(REPO))
+    assert bench_ledger.check_multichip(rows) == []
+
+
+def test_multichip_rides_cli_table_and_check(tmp_path):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_mc(tmp_path, 6, _scaling_line())
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 0
+    assert bench_ledger.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_multichip_cli_table_output(tmp_path, capsys):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_mc(tmp_path, 6, _scaling_line())
+    bench_ledger.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "multichip scaling" in out and "8dev:8x1" in out
+
+
 def test_check_is_wired_into_campaign_script():
     """tools/tpu_campaign.sh must print the ledger + gate at campaign end
     (the satellite's wiring contract)."""

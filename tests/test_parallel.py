@@ -1,5 +1,7 @@
 """Mesh-sharding tests on the virtual 8-device CPU mesh (conftest)."""
 
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
@@ -159,6 +161,156 @@ def test_sharded_stack_eval_kafka_assigner(model):
         np.asarray(sharded.violations), np.asarray(local.violations),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_sharded_anneal_chunked_matches_monolith(model):
+    """The chunk-driven sharded engine (ISSUE 7 tentpole) is bit-exact
+    with the monolithic sharded scan AND the unsharded annealer: the
+    budget/schedule enter the chunk program as traced data, so the same
+    step bodies run in the same order."""
+    mesh = make_mesh(jax.devices(), parts=4)
+    opts = AnnealOptions(n_chains=4, n_steps=150, seed=3)
+    ru = anneal(model, GoalConfig(), DEFAULT_GOAL_ORDER, opts)
+    rc = sharded_anneal(
+        model, GoalConfig(), DEFAULT_GOAL_ORDER,
+        dataclasses.replace(opts, chunk_steps=50), mesh,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rc.model.assignment), np.asarray(ru.model.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rc.model.leader_slot), np.asarray(ru.model.leader_slot)
+    )
+    # result stays sharded over parts (never replicated)
+    spec = rc.model.assignment.sharding.spec
+    assert spec and spec[0] == "parts", spec
+
+
+def test_sharded_anneal_chunked_retune_no_recompile(model):
+    """Budget/schedule retunes reuse the SAME compiled sharded chunk
+    program (budgets are traced data — the whole point of chunk-driving
+    the mesh path): a different n_steps on a warm cache pays zero fresh
+    XLA compiles."""
+    from ccx.common import compilestats
+
+    mesh = make_mesh(jax.devices(), parts=4)
+    base = AnnealOptions(n_chains=4, n_steps=100, seed=3, chunk_steps=50)
+    sharded_anneal(model, GoalConfig(), DEFAULT_GOAL_ORDER, base, mesh)
+    cs0 = compilestats.snapshot()
+    sharded_anneal(
+        model, GoalConfig(), DEFAULT_GOAL_ORDER,
+        dataclasses.replace(base, n_steps=150, t1=2e-4), mesh,
+    )
+    d = compilestats.delta(cs0, compilestats.snapshot())
+    assert d["backend_compiles"] == 0, d
+
+
+def test_sharded_chunk_zero_warm_fresh_compiles_with_capture(model):
+    """The ISSUE 7 tripwire: a warm re-call of the sharded chunk program
+    with cost capture ARMED pays zero fresh XLA compiles — capture
+    (AOT lower+compile of the SAME sharded program, costmodel._spec_of
+    preserves the NamedSharding) happens once on the cold path only."""
+    from ccx.common import compilestats, costmodel
+
+    mesh = make_mesh(jax.devices(), parts=4)
+    opts = AnnealOptions(n_chains=4, n_steps=100, seed=5, chunk_steps=50)
+    # earlier tests in this module already executed this program shape;
+    # reset the (process-global) observatory so the cold-path enqueue is
+    # observable here
+    costmodel.reset()
+    costmodel.set_capture(True)
+    try:
+        sharded_anneal(model, GoalConfig(), DEFAULT_GOAL_ORDER, opts, mesh)
+        costmodel.capture_pending()  # the optimizer's cost-capture phase
+        recs = costmodel.records()
+        assert any("sharded-sa-chunk" in k for k in recs), list(recs)
+        cs0 = compilestats.snapshot()
+        sharded_anneal(model, GoalConfig(), DEFAULT_GOAL_ORDER, opts, mesh)
+        assert costmodel.pending_count() == 0
+        d = compilestats.delta(cs0, compilestats.snapshot())
+        assert d["backend_compiles"] == 0, d
+    finally:
+        costmodel.set_capture(None)
+
+
+def test_sharded_chunk_heartbeats(model):
+    """A chunk-driven mesh run emits per-chunk heartbeats under the
+    sharded-anneal span — the flight-recorder evidence that silently
+    disappeared when the old mesh gate fell through to the one-shot
+    scan."""
+    from ccx.common.tracing import TRACER
+
+    recs = []
+    tap = recs.append
+    TRACER.add_listener(tap)
+    try:
+        mesh = make_mesh(jax.devices(), parts=4)
+        sharded_anneal(
+            model, GoalConfig(), DEFAULT_GOAL_ORDER,
+            AnnealOptions(n_chains=4, n_steps=150, seed=3, chunk_steps=50),
+            mesh,
+        )
+    finally:
+        TRACER.remove_listener(tap)
+    beats = [
+        r for r in recs
+        if r.get("ev") == "chunk" and "sharded-anneal" in r.get("span", "")
+    ]
+    assert len(beats) == 3, [r.get("ev") for r in recs]  # 150 / 50 chunks
+    spans = [r for r in recs if r.get("ev") == "end"
+             and r.get("span", "").endswith("sharded-anneal")]
+    assert spans, "sharded-anneal span must close"
+
+
+def test_anneal_mesh_rounds_chains_up(model):
+    """n_chains that does not divide the mesh is rounded UP with a note
+    instead of aborting (campaign retunes / odd device counts must never
+    kill a window)."""
+    mesh = make_mesh(jax.devices(), parts=4)  # 2 chain ranks
+    r = sharded_anneal(
+        model, GoalConfig(), DEFAULT_GOAL_ORDER,
+        AnnealOptions(n_chains=5, n_steps=40, seed=3, chunk_steps=20), mesh,
+    )
+    assert r.n_chains == 6
+    # the chains-only data-parallel gate rounds by the full mesh size
+    # (pure math — running it would only pay another compile)
+    from ccx.search.annealer import round_up_chains
+
+    assert round_up_chains(5, 8, "test") == 8
+    assert round_up_chains(8, 8, "test") == 8
+    assert round_up_chains(9, 4, "test") == 12
+    assert round_up_chains(2, 1, "test") == 2
+
+
+def test_mesh_vs_single_chip_quality_parity_downscaled_b5():
+    """ISSUE 7 acceptance: mesh-vs-single-chip quality parity at
+    1/10-scale B5 (the tests/test_quality_b5_shape.py shape), chunked
+    mesh path vs chunked single-device path, same seed policy. The
+    sharded engine shares the unsharded RNG stream and acceptance rule,
+    so the full cost vector must agree within float-reduction tolerance
+    — far inside the pinned lean envelope."""
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=100, n_racks=10, n_topics=50, n_partitions=10_000, seed=7,
+    ))
+    opts = AnnealOptions(
+        n_chains=4, n_steps=100, moves_per_step=8, seed=42, chunk_steps=50,
+    )
+    ru = anneal(m, GoalConfig(), DEFAULT_GOAL_ORDER, opts)
+    rs = anneal(
+        m, GoalConfig(), DEFAULT_GOAL_ORDER, opts,
+        mesh=make_mesh(jax.devices(), parts=4),
+    )
+    assert float(rs.stack_after.soft_scalar) < float(
+        rs.stack_before.soft_scalar
+    )
+    np.testing.assert_allclose(
+        np.asarray(rs.stack_after.costs),
+        np.asarray(ru.stack_after.costs),
+        rtol=1e-4, atol=1e-4,
+    )
+    from ccx.verify import verify_model_consistency
+
+    assert not verify_model_consistency(rs.model)
 
 
 def test_graft_entry_dryrun():

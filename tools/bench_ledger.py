@@ -27,6 +27,13 @@ This tool is the ledger and the tripwire:
   seconds on the measuring device and on v5e/v5p) — the generated
   replacement for the hand-summed budget table docs/perf-notes.md used
   to maintain.
+* multichip: ``MULTICHIP_r*.json`` scaling curves (``bench.py --scaling``
+  — per-layout walls of the chunk-driven sharded anneal at fixed work)
+  get their own trend section, and ``--check`` gates them too: a
+  worst-layout wall regression >10% vs the best banked comparable
+  (config, backend, effort) round fails, as does an unverified curve.
+  Rounds 1-5 carry the old driver dryrun-probe wrapper (no walls) — they
+  are listed as legacy, reported but never gated.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -165,6 +172,137 @@ def group_key(row: dict) -> str:
     return json.dumps(
         [row["rung"], row["backend"], row["effort"]], sort_keys=True
     )
+
+
+# ----- multichip (MULTICHIP_r*.json) -----------------------------------------
+
+
+def load_multichip(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, legacy) from every ``MULTICHIP_r*.json`` under ``root``.
+
+    Round 6+ files carry the ``bench.py --scaling`` schema (per-layout
+    walls of the chunk-driven sharded anneal at fixed work); those become
+    gateable rows. Rounds 1-5 are the driver's dryrun-probe wrappers
+    (``{"n_devices", "rc", "ok"}`` — no walls); they are listed as legacy
+    entries, reported but never gated."""
+    rows: list[dict] = []
+    legacy: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            d = json.load(open(path))
+        except (OSError, ValueError) as e:
+            legacy.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, d)
+        if d.get("scaling") and isinstance(d.get("curve"), list):
+            layouts: dict[str, float] = {}
+            for c in d["curve"]:
+                for lab, w in (c.get("layouts") or {}).items():
+                    if isinstance(w, (int, float)):
+                        layouts[f"{c.get('devices')}dev:{lab}"] = float(w)
+            walls = list(layouts.values())
+            rows.append({
+                "source": name,
+                "round": rnd,
+                "config": d.get("config", "?"),
+                "backend": str(d.get("backend", "?")),
+                "effort": d.get("effort") or {},
+                "verified": bool(d.get("verified")),
+                "layouts": layouts,
+                "best": min(walls) if walls else None,
+                "worst": max(walls) if walls else None,
+                "speedup": d.get("speedup_vs_1dev") or {},
+            })
+        else:
+            ok = d.get("ok")
+            why = "legacy dryrun probe"
+            if not ok:
+                why += f" (ok={ok}, rc={d.get('rc')})"
+            legacy.append({"file": name, "round": rnd, "why": why})
+    return rows, legacy
+
+
+def multichip_group_key(row: dict) -> str:
+    """Scaling rows are only comparable at identical (config, backend,
+    effort) — same contract as the BENCH rung groups."""
+    return json.dumps(
+        [row["config"], row["backend"], row["effort"]], sort_keys=True
+    )
+
+
+def check_multichip(mrows: list[dict]) -> list[str]:
+    """The scaling-curve gate: in the LATEST banked scaling round, a
+    worst-layout wall regression >10% vs the best banked comparable round
+    fails, and an unverified curve fails. No scaling rows banked yet =
+    nothing to gate (the BENCH gate still covers the round)."""
+    failures: list[str] = []
+    if not mrows:
+        return failures
+    latest_round = max(r["round"] for r in mrows)
+    for r in (r for r in mrows if r["round"] == latest_round):
+        if not r["verified"]:
+            failures.append(
+                f"multichip round {r['round']} {r['config']}: UNVERIFIED "
+                "scaling curve banked"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in mrows:
+        groups.setdefault(multichip_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"] and r["worst"]
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(p["worst"] for p in prior)
+        if r["worst"] is not None and best:
+            limit = best * (1 + WALL_REGRESSION)
+            if r["worst"] > limit:
+                failures.append(
+                    f"multichip round {r['round']} {r['config']}: "
+                    f"worst-layout wall {r['worst']:.1f}s regressed "
+                    f">{WALL_REGRESSION:.0%} vs best banked round "
+                    f"({best:.1f}s, limit {limit:.1f}s)"
+                )
+    return failures
+
+
+def render_multichip(mrows: list[dict], legacy: list[dict]) -> str:
+    """The multichip section of the trend table: per scaling round the
+    best/worst layout walls, the 1→N speedups and the layout detail."""
+    if not mrows and not legacy:
+        return ""
+    out = ["", "multichip scaling (MULTICHIP_r*.json):"]
+    headers = ["round", "config", "backend", "best s", "worst s",
+               "speedup", "ok", "layouts"]
+    body = []
+    for r in sorted(mrows, key=lambda r: r["round"]):
+        sp = " ".join(
+            f"{k}dev={v}" for k, v in sorted(r["speedup"].items())
+        ) or "-"
+        lay = " ".join(
+            f"{k}={v}" for k, v in sorted(r["layouts"].items())
+        ) or "-"
+        body.append([
+            _fmt(r["round"], 0), r["config"], r["backend"],
+            _fmt(r["best"], 1), _fmt(r["worst"], 1), sp,
+            "yes" if r["verified"] else "NO", lay,
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for e in legacy:
+        out.append(f"legacy: {e['file']} — {e['why']}")
+    return "\n".join(out)
 
 
 # ----- trend table -----------------------------------------------------------
@@ -393,24 +531,30 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     root = os.path.abspath(args.dir)
     rows, partials = load_rows(root)
+    mrows, mlegacy = load_multichip(root)
     if args.json:
-        print(json.dumps({"rows": rows, "partials": partials}, indent=1))
+        print(json.dumps({
+            "rows": rows, "partials": partials,
+            "multichip": mrows, "multichipLegacy": mlegacy,
+        }, indent=1))
         return 0
     if args.roofline:
         print(render_roofline(rows))
         return 0
     if args.check:
-        failures = check(rows, partials)
+        failures = check(rows, partials) + check_multichip(mrows)
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
         if failures:
             return 1
         n = len([r for r in rows if r["round"] is not None])
         print(f"bench ledger green: {n} banked line(s), "
-              f"{len(partials)} partial round(s), no regression vs the "
-              f"best banked rounds")
+              f"{len(partials)} partial round(s), {len(mrows)} scaling "
+              f"curve(s), no regression vs the best banked rounds")
         return 0
-    print(render_table(rows, partials))
+    out = render_table(rows, partials)
+    mc = render_multichip(mrows, mlegacy)
+    print(out + (("\n" + mc) if mc else ""))
     return 0
 
 

@@ -44,8 +44,10 @@ _BENCH_KNOBS = ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
 #: progress and compile attribution, all timing-volatile by construction;
 #: costModel is the r10 cost-observatory block — XLA cost/memory records
 #: and roofline projections, machine- and backend-dependent by
-#: construction)
-VOLATILE = ("wallSeconds", "phaseSeconds", "spanTree", "costModel")
+#: construction; mesh is the r11 mesh-sharded-run block — mesh shape and
+#: live sharded-program cache occupancy, absent on single-device runs and
+#: machine-dependent when present)
+VOLATILE = ("wallSeconds", "phaseSeconds", "spanTree", "costModel", "mesh")
 
 REQUEST_NAMES = ("ping_request.bin", "put_full_request.bin",
                  "put_delta_request.bin", "propose_request.bin")

@@ -21,22 +21,24 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+from ccx.common.vmesh import force_host_devices  # noqa: E402
+
+force_host_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 from ccx.goals.base import GoalConfig  # noqa: E402
 from ccx.goals.stack import DEFAULT_GOAL_ORDER  # noqa: E402
 from ccx.model.fixtures import RandomClusterSpec, random_cluster  # noqa: E402
 from ccx.parallel.sharding import make_mesh, sharded_anneal  # noqa: E402
 from ccx.search.annealer import AnnealOptions, anneal  # noqa: E402
+
+#: chunk length for every probe run: the probe must exercise the SAME
+#: chunk-driven sharded program the production mesh path runs (per-chunk
+#: heartbeats + bounded compile; the n_steps deltas below reuse ONE
+#: compiled chunk program per mesh layout). PROBE_CHUNK=0 restores the
+#: monolithic scans.
+CHUNK = int(os.environ.get("PROBE_CHUNK", "25"))
 
 
 def timed(fn, *a, **k):
@@ -63,7 +65,7 @@ def scaling(m, cfg):
         for steps in (10, 50):
             opts = AnnealOptions(
                 n_chains=8, n_steps=steps, moves_per_step=8, seed=3,
-                batched=True,
+                batched=True, chunk_steps=CHUNK,
             )
             t = timed(sharded_anneal, m, cfg, DEFAULT_GOAL_ORDER, opts, mesh)
             res[steps] = t
@@ -76,7 +78,8 @@ def scaling(m, cfg):
     res = {}
     for steps in (10, 50):
         opts = AnnealOptions(
-            n_chains=8, n_steps=steps, moves_per_step=8, seed=3, batched=True
+            n_chains=8, n_steps=steps, moves_per_step=8, seed=3,
+            batched=True, chunk_steps=CHUNK,
         )
         res[steps] = timed(anneal, m, cfg, DEFAULT_GOAL_ORDER, opts)
     s_u = (res[50] - res[10]) / 40
@@ -116,7 +119,7 @@ def main():
         for steps in (steps_lo, steps_hi):
             opts = AnnealOptions(
                 n_chains=4, n_steps=steps, moves_per_step=moves, seed=3,
-                batched=batched,
+                batched=batched, chunk_steps=CHUNK,
             )
             t_u = timed(anneal, m, cfg, DEFAULT_GOAL_ORDER, opts)
             t_s = timed(sharded_anneal, m, cfg, DEFAULT_GOAL_ORDER, opts, mesh)

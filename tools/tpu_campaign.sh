@@ -54,6 +54,15 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
     echo "device probe FAILED or non-TPU backend — aborting campaign"
     exit 1
   fi
+  echo "--- sharded-anneal probe (virtual CPU mesh; before any timed rung) ---"
+  # the mesh-sharded chunk programs (ccx.parallel.sharding) ride the same
+  # flight recorder + watchdog as everything else; prove their compile and
+  # batched-vs-sequential structure on the virtual mesh FIRST, so a
+  # pathological sharded compile surfaces with a [sharded-probe]
+  # breadcrumb before any timed rung (and never eats the TPU window —
+  # the probe pins itself to the CPU backend)
+  timeout -k 60 1800 python tools/probe_sharded.py
+  echo "sharded-probe rc=$?"
   echo "--- chunked-polish compile probe at B1+B5 (before any timed rung) ---"
   # the descent-engine chunk programs are what the round-4 window died
   # compiling (>17 min greedy while_loop): prove their compile on
@@ -104,6 +113,14 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   echo "--- sharded-anneal step slope on the device set ---"
   CCX_BENCH_MESH=1 CCX_BENCH_CPU_FIRST=0 timeout -k 60 1800 python bench.py
   echo "mesh rc=$?"
+  echo "--- B6 scaling rung (1->2->4->8 virtual CPU mesh; MULTICHIP artifact) ---"
+  # the chunk-driven mesh path at B6 scale (10k brokers / 1M partitions):
+  # per-layout (chains x parts) walls, quality-verified — the JSON line
+  # is the MULTICHIP_r*.json artifact the bench ledger trends and gates.
+  # CPU-only virtual mesh by definition (the tunnel exposes one chip), so
+  # it never competes for the TPU window; recorder + watchdog stay armed.
+  CCX_BENCH_SCALING=1 timeout -k 60 3600 python bench.py
+  echo "scaling rc=$?"
   echo "--- remaining BASELINE configs on hardware (B1-B4, lean effort) ---"
   # pin all four effort knobs to the lean values: bench collapses to ONE
   # honestly-labeled "custom" rung per config instead of climbing
