@@ -764,6 +764,265 @@ def run_scaling(name: str, samples: int = 1) -> None:
     print(_state["final_json"], flush=True)
 
 
+def _fleet_options() -> dict:
+    """The fleet rung's per-job engine options (wire schema keys): a
+    B3-sized job tuned so one Propose is a few seconds warm with SEVERAL
+    chunk boundaries per phase — the preemption points the scheduler
+    interleaves at. Fixed (not env-tunable) so FLEET_r*.json rounds stay
+    comparable; every job shares one compiled program set (all B3-seed
+    clusters pad to the same (B, P) bucket)."""
+    return {
+        "chains": 8, "steps": 400, "moves_per_step": 4, "seed": 42,
+        "chunk_steps": 100,
+        "polish_candidates": 128, "polish_max_iters": 120,
+        "polish_patience": 8, "polish_chunk_iters": 30,
+        "run_cold_greedy": False, "topic_rebalance_rounds": 0,
+        "swap_polish_iters": 60, "swap_polish_post_iters": 0,
+        "swap_polish_candidates": 64, "swap_polish_chunk_iters": 30,
+        "leader_pass_max_iters": 60,
+    }
+
+
+def run_fleet(name: str, n_jobs: int) -> None:
+    """``--fleet`` / CCX_BENCH_FLEET: continuous batching of concurrent
+    Propose jobs (ISSUE 8; ROADMAP "Fleet serving").
+
+    Drives ``n_jobs`` concurrent B3-sized Propose streams through a real
+    localhost gRPC sidecar (snapshot-up / columnar-proposals-down, one
+    session per cluster id) and prints ONE JSON line — the FLEET_r*.json
+    artifact ``tools/bench_ledger.py`` trends and gates. Four measured
+    phases:
+
+    1. prewarm — cluster 0 pays every compile; the other 15 clusters are
+       different seeds of the SAME (B, P) pad bucket, so they reuse the
+       compiled SA-chunk/polish-chunk set (zero fresh compiles after the
+       prewarm is the tripwire, serialized AND concurrent);
+    2. serialized baseline — the pre-scheduler convoy: one job at a time,
+       same warm server/session path;
+    3. concurrent — all ``n_jobs`` streams at once, interleaved by the
+       multi-job chunk scheduler; p50/p99 latency, aggregate throughput
+       and chunk occupancy (fraction of the window with chunk work in
+       flight) come from this phase;
+    4. preemption probe — one urgent (priority 10) job submitted while a
+       second concurrent wave is in flight; its latency vs the wave's p50
+       shows the run-queue jump end-to-end.
+
+    Host ceiling caveat: on an N-core CPU host with no separate device,
+    serialized already uses ~1 core, so concurrent speedup is bounded by
+    ~N (2-core container: <= 2x); the 3x+ regime needs a real accelerator
+    (host phases overlap device chunks) or more host cores. The line
+    carries ``host_cores`` so the ledger compares like with like.
+    """
+    import dataclasses
+    import statistics
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from ccx.common import compilestats, costmodel
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.model.snapshot import to_msgpack
+    from ccx.search.scheduler import FLEET
+    from ccx.sidecar.client import SidecarClient
+    from ccx.sidecar.server import OptimizerSidecar, make_grpc_server
+
+    if os.environ.get("CCX_COST_CAPTURE") != "0":
+        costmodel.set_capture(True)
+    # CCX_FLEET_MAX_CONCURRENT: device-residency cap of the run queue.
+    # Default = host core count: residency ≈ compute parallelism, so the
+    # active set dispatches at full speed while queued jobs wait at
+    # admission (measured on the 2-core host: cap 2 → 1.46x aggregate
+    # throughput + p50 halved vs unlimited 16-way interleave at 1.16x —
+    # GIL contention, not the device, is what unlimited residency buys).
+    # 0 forces unlimited; recorded on the line's effort dict.
+    env_conc = os.environ.get("CCX_FLEET_MAX_CONCURRENT")
+    max_conc = (
+        int(env_conc) if env_conc is not None else (os.cpu_count() or 2)
+    )
+    FLEET.max_concurrent = max(max_conc, 0)
+    # CCX_FLEET_DISPATCH_WIDTH: simultaneous dispatch grants (0 = auto —
+    # host core count, floor 2; see ChunkScheduler.dispatch_width)
+    from ccx.search import scheduler as _sched
+
+    _sched.configure(
+        dispatch_width=int(os.environ.get("CCX_FLEET_DISPATCH_WIDTH", "0"))
+    )
+    options = _fleet_options()
+    # goals stay empty on the wire — the server resolves the default stack
+
+    enter_phase(f"fleet:{name}:models")
+    spec = bench_spec(name)
+    models = [
+        random_cluster(dataclasses.replace(spec, seed=spec.seed + 100 + i))
+        for i in range(n_jobs)
+    ]
+    # the prewarm ledger's shape buckets: (padded P, padded B, bucketed
+    # max-partitions-per-topic) keys the compiled program set — clusters
+    # in one bucket share every SA-chunk/polish-chunk program. Random
+    # same-size clusters usually land in ONE bucket; a seed straddling a
+    # power-of-two boundary adds a second, which the prewarm below pays
+    # for up front so the measured phases stay at zero fresh compiles.
+    from ccx.search.state import max_partitions_per_topic
+
+    buckets: dict[tuple, list[int]] = {}
+    for i, m in enumerate(models):
+        key = (int(m.P), int(m.B), max_partitions_per_topic(m))
+        buckets.setdefault(key, []).append(i)
+    log(
+        f"[fleet] {n_jobs} {name} clusters in {len(buckets)} shape "
+        f"bucket(s): "
+        + " ".join(f"{k}x{len(v)}" for k, v in sorted(buckets.items()))
+    )
+
+    sidecar = OptimizerSidecar()
+    server, port = make_grpc_server(
+        sidecar, address="127.0.0.1:0", max_workers=n_jobs + 8
+    )
+    server.start()
+    client = SidecarClient(f"127.0.0.1:{port}")
+    log(f"[fleet] sidecar on port {port} ({jax.default_backend()})")
+
+    enter_phase(f"fleet:{name}:put-snapshots")
+    for i, m in enumerate(models):
+        client.put_snapshot(
+            None, session=f"fleet-{i}", generation=1,
+            packed=to_msgpack(m), cluster_id=f"fleet-{i}",
+        )
+
+    def propose(i: int, priority: int = 0) -> dict:
+        t0 = time.monotonic()
+        res = client.propose(
+            session=f"fleet-{i}", columnar=True,
+            cluster_id=f"fleet-{i}", priority=priority, **options,
+        )
+        return {
+            "wall": time.monotonic() - t0,
+            "verified": bool(res["verified"]),
+            "proposals": int(res["numProposals"]),
+        }
+
+    enter_phase(f"fleet:{name}:prewarm")
+    t0 = time.monotonic()
+    for members in buckets.values():
+        # one representative per shape bucket pays that bucket's compiles
+        propose(members[0])
+    cold_s = time.monotonic() - t0
+    propose(0)  # warm anchor
+    log(f"[fleet] prewarm {len(buckets)} bucket(s) cold={cold_s:.1f}s")
+
+    # --- serialized baseline: the pre-scheduler convoy ---------------------
+    enter_phase(f"fleet:{name}:serialized")
+    cs0 = compilestats.snapshot()
+    t0 = time.monotonic()
+    serial = [propose(i) for i in range(n_jobs)]
+    serialized_s = time.monotonic() - t0
+    cs1 = compilestats.snapshot()
+    serial_compiles = compilestats.delta(cs0, cs1)
+    log(
+        f"[fleet] serialized {n_jobs} jobs: {serialized_s:.1f}s "
+        f"({serialized_s / n_jobs:.2f}s/job) compiles={serial_compiles}"
+    )
+
+    # --- concurrent: the continuous-batching phase -------------------------
+    enter_phase(f"fleet:{name}:concurrent")
+    FLEET.reset_stats()
+    t0 = time.monotonic()
+    with ThreadPoolExecutor(n_jobs) as ex:
+        conc = list(ex.map(propose, range(n_jobs)))
+    concurrent_s = time.monotonic() - t0
+    sched = FLEET.stats()
+    cs2 = compilestats.snapshot()
+    conc_compiles = compilestats.delta(cs1, cs2)
+    walls = sorted(r["wall"] for r in conc)
+    p50 = statistics.median(walls)
+    p99 = walls[min(int(round(0.99 * (len(walls) - 1))), len(walls) - 1)]
+    log(
+        f"[fleet] concurrent {n_jobs} jobs: {concurrent_s:.1f}s "
+        f"p50={p50:.2f}s p99={p99:.2f}s occupancy={sched['occupancy']} "
+        f"depth={sched['meanDepth']} compiles={conc_compiles}"
+    )
+
+    # --- preemption probe: urgent job vs a busy queue ----------------------
+    enter_phase(f"fleet:{name}:preempt")
+    urgent_box: dict = {}
+    with ThreadPoolExecutor(n_jobs + 1) as ex:
+        wave = [ex.submit(propose, i) for i in range(n_jobs)]
+        time.sleep(max(p50 * 0.5, 0.2))  # mid-wave
+        t0 = time.monotonic()
+        urgent_box = propose(0, priority=10)
+        urgent_box["submitted_mid_wave_s"] = round(time.monotonic() - t0, 3)
+        wave_walls = [f.result()["wall"] for f in wave]
+    log(
+        f"[fleet] urgent mid-wave: {urgent_box['wall']:.2f}s vs wave "
+        f"p50 {statistics.median(wave_walls):.2f}s"
+    )
+
+    zero_warm = (
+        serial_compiles.get("backend_compiles", 0) == 0
+        and conc_compiles.get("backend_compiles", 0) == 0
+    )
+    all_verified = all(r["verified"] for r in serial + conc)
+    speedup = serialized_s / max(concurrent_s, 1e-9)
+    out = {
+        "metric": (
+            f"{name} fleet serving: {n_jobs} concurrent Propose streams "
+            "through the sidecar (p99 latency)"
+        ),
+        "value": round(p99, 3),
+        "unit": "s",
+        # headline ratio: serialized convoy wall over concurrent wall at
+        # identical work — aggregate-throughput multiple of the scheduler
+        "vs_baseline": round(speedup, 3),
+        "fleet": True,
+        "config": name,
+        "n_jobs": n_jobs,
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count(),
+        "verified": bool(all_verified and zero_warm),
+        "latency": {
+            "p50_s": round(p50, 3),
+            "p99_s": round(p99, 3),
+            "mean_s": round(statistics.mean(walls), 3),
+            "walls": [round(w, 3) for w in walls],
+        },
+        "throughput_per_min": round(n_jobs / concurrent_s * 60.0, 2),
+        "serialized_throughput_per_min": round(
+            n_jobs / serialized_s * 60.0, 2
+        ),
+        "serialized_s": round(serialized_s, 2),
+        "concurrent_s": round(concurrent_s, 2),
+        "speedup": round(speedup, 3),
+        "occupancy": sched["occupancy"],
+        "mean_depth": sched["meanDepth"],
+        "chunks_granted": sched["chunksGranted"],
+        "urgent": {
+            "wall_s": round(urgent_box["wall"], 3),
+            "wave_p50_s": round(statistics.median(wave_walls), 3),
+            "verified": urgent_box["verified"],
+        },
+        "cold_s": round(cold_s, 2),
+        "compile_cache": {
+            "serialized": serial_compiles, "concurrent": conc_compiles,
+        },
+        "zero_warm_fresh_compiles": zero_warm,
+        # device-resident snapshot registry (N cluster models live under
+        # the HBM budget, LRU-evicted; hits = Proposes that skipped the
+        # model build + host->device transfer entirely)
+        "registry": sidecar.registry.stats(),
+        "shape_buckets": len(buckets),
+        "effort": {**options, "n_jobs": n_jobs, "max_concurrent": max_conc,
+                   "dispatch_width": FLEET.dispatch_width},
+        "proposals_per_job": int(
+            statistics.median(r["proposals"] for r in conc)
+        ),
+    }
+    client.close()
+    server.stop(0)
+    _state["done"] = True
+    _state["final_json"] = json.dumps(out)
+    print(_state["final_json"], flush=True)
+
+
 def run_mesh_bench(name: str) -> None:
     """CCX_BENCH_MESH=1: partition-axis-sharded anneal step slope at the
     config's shape over every visible device (SURVEY.md §5.7 — the
@@ -843,8 +1102,37 @@ def main() -> None:
     )
     ap.add_argument("--scaling", action="store_true",
                     default=os.environ.get("CCX_BENCH_SCALING") == "1")
+    ap.add_argument("--fleet", action="store_true",
+                    default=os.environ.get("CCX_BENCH_FLEET") not in
+                    (None, "", "0"))
+    ap.add_argument(
+        "--fleet-jobs", type=int,
+        default=int(os.environ.get("CCX_BENCH_FLEET_JOBS", "16")),
+    )
     cli, _unknown = ap.parse_known_args()
     samples = max(cli.samples, 1)
+
+    if cli.fleet:
+        # fleet serving mode (FLEET_r*.json artifact): concurrent Propose
+        # streams through the sidecar, interleaved by the multi-job chunk
+        # scheduler. Persistent compile cache like the main ladder.
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(
+                "JAX_COMPILATION_CACHE_DIR",
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+                ),
+            ),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        name = os.environ.get("CCX_BENCH", "B3")
+        _state["name"] = name
+        run_fleet(name, n_jobs=max(cli.fleet_jobs, 2))
+        return
 
     if cli.scaling:
         # multi-chip scaling mode (MULTICHIP_r*.json artifact): CPU-only

@@ -33,6 +33,16 @@ public final class Wire {
   /** Field name carrying the version. */
   public static final String FIELD_WIRE = "wire";
 
+  // Fleet-serving envelope fields (round 12, additive: absent fields keep
+  // pre-fleet semantics — the session id doubles as the cluster id and
+  // priority is 0).
+  /** PutSnapshot/Propose field naming the Kafka cluster (fleet job id). */
+  public static final String FIELD_CLUSTER_ID = "cluster_id";
+  /** Propose field: integer scheduler priority (higher preempts). */
+  public static final String FIELD_PRIORITY = "priority";
+  /** Heartbeat-frame field naming the job a streamed chunk belongs to. */
+  public static final String FIELD_JOB = "job";
+
   // Structured error codes (error-frame "code" / INVALID_ARGUMENT prefix).
   public static final String ERR_UNSUPPORTED_VERSION = "unsupported-wire-version";
   public static final String ERR_MALFORMED = "malformed-request";

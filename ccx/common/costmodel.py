@@ -68,11 +68,18 @@ ENV_CAPTURE = "CCX_COST_CAPTURE"
 #: Sources: published v5e/v5p/v4 chip specs. The CPU row is an honest
 #: order-of-magnitude host estimate (few-GHz core × SIMD width, DDR
 #: stream bandwidth) — marked ``estimate`` and overridable.
+#: ``hbmBytes`` is the per-chip memory CAPACITY (HBM; host RAM estimate on
+#: the CPU row) — the ceiling the fleet snapshot registry budgets device
+#: residency against (capacity minus the captured working-set watermark).
 DEVICE_SPECS = {
-    "cpu": {"peakFlops": 5.0e10, "hbmBytesPerSec": 2.0e10, "estimate": True},
-    "tpu-v5e": {"peakFlops": 1.97e14, "hbmBytesPerSec": 8.19e11},
-    "tpu-v5p": {"peakFlops": 4.59e14, "hbmBytesPerSec": 2.765e12},
-    "tpu-v4": {"peakFlops": 2.75e14, "hbmBytesPerSec": 1.228e12},
+    "cpu": {"peakFlops": 5.0e10, "hbmBytesPerSec": 2.0e10,
+            "hbmBytes": 8.0e9, "estimate": True},
+    "tpu-v5e": {"peakFlops": 1.97e14, "hbmBytesPerSec": 8.19e11,
+                "hbmBytes": 1.6e10},
+    "tpu-v5p": {"peakFlops": 4.59e14, "hbmBytesPerSec": 2.765e12,
+                "hbmBytes": 9.5e10},
+    "tpu-v4": {"peakFlops": 2.75e14, "hbmBytesPerSec": 1.228e12,
+               "hbmBytes": 3.2e10},
 }
 
 #: device_kind substring -> spec key (first match wins, order matters:
@@ -461,6 +468,57 @@ def device_spec() -> dict:
     else:
         out["source"] = "table" if spec.get("key") else "unknown"
     return out
+
+
+def hbm_watermark_bytes() -> float:
+    """The captured working-set watermark: max ``peakBytes`` over every
+    program record in the ledger — what the engine programs themselves
+    need live in HBM at peak. The fleet snapshot registry prices its
+    device-residency budget as capacity minus THIS (a snapshot kept
+    resident must never evict the working set the next chunk needs).
+    0.0 when nothing is captured yet (cold process)."""
+    with _LOCK:
+        recs = list(_RECORDS.values())
+    peaks = [
+        r["peakBytes"] for r in recs
+        if isinstance(r.get("peakBytes"), (int, float))
+    ]
+    return float(max(peaks)) if peaks else 0.0
+
+
+#: config-layer override of the fleet snapshot budget (facade wires
+#: ``optimizer.fleet.snapshot.hbm.mb`` here; 0/None = no override)
+_FLEET_HBM_MB: float | None = None
+
+
+def set_fleet_hbm_budget(mb: float | None) -> None:
+    """Config hook (``optimizer.fleet.snapshot.hbm.mb``): 0/None restores
+    the auto budget."""
+    global _FLEET_HBM_MB
+    _FLEET_HBM_MB = float(mb) if mb else None
+
+
+def fleet_snapshot_budget_bytes(explicit_mb: float | None = None) -> int:
+    """HBM budget for device-resident fleet snapshots
+    (ccx.sidecar.server.SnapshotRegistry): an explicit operator setting
+    (constructor arg, ``optimizer.fleet.snapshot.hbm.mb`` via the config
+    hook, or CCX_FLEET_HBM_MB) wins; else half of (device HBM capacity −
+    captured watermark) — half, because the optimizer also holds
+    transient copies (donated carries, diff buffers) the watermark
+    undercounts on a cold ledger. Floor of 64 MB so a pathological
+    watermark can never disable the registry outright."""
+    import os
+
+    if explicit_mb is None:
+        explicit_mb = _FLEET_HBM_MB
+    if explicit_mb is None:
+        env = os.environ.get("CCX_FLEET_HBM_MB")
+        explicit_mb = float(env) if env else None
+    if explicit_mb is not None and explicit_mb > 0:
+        return int(explicit_mb * 1e6)
+    cap = device_spec().get("hbmBytes") or DEVICE_SPECS["cpu"]["hbmBytes"]
+    budget = (float(cap) - hbm_watermark_bytes()) / 2.0
+    return int(max(budget, 64e6))
 
 
 def roofline_seconds(flops, bytes_accessed, spec: dict):

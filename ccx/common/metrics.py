@@ -151,10 +151,25 @@ class MetricsRegistry:
             self._gauges[name] = fn
 
     def histogram(self, name: str, buckets: tuple[float, ...] | None = None,
-                  help: str | None = None) -> Histogram:
+                  help: str | None = None,
+                  labels: dict[str, str] | None = None) -> Histogram:
+        """``labels`` (e.g. ``{"job": cluster_id}`` — fleet serving's
+        per-job span histograms) keys a separate series of the SAME metric
+        family: one ``# TYPE`` declaration, one ``_bucket``/``_sum``/
+        ``_count`` series per label set, labels merged with ``le`` on the
+        bucket lines. Label VALUES are arbitrary strings (cluster ids come
+        off the wire) — the composite key holds them JSON-encoded so
+        ``,``/``=``/``"`` can neither corrupt the key nor the exposition."""
+        import json as _json
+
+        key = name
+        if labels:
+            key = name + "|" + _json.dumps(
+                sorted((str(k), str(v)) for k, v in labels.items())
+            )
         with self._lock:
             self._set_help(name, help)
-            return self._histograms.setdefault(name, Histogram(buckets))
+            return self._histograms.setdefault(key, Histogram(buckets))
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (0.0.4) of everything registered:
@@ -201,14 +216,42 @@ class MetricsRegistry:
             n = f"{self.prefix}_{sanitize(name)}"
             head(name, n, "gauge", f"{name} gauge")
             out.append(f"{n} {v}")
-        for name, h in sorted(histograms.items()):
+        # histograms: labeled series ('name|[["k","v"],...]' — JSON-packed
+        # label pairs) share one family — HELP/TYPE emitted once per
+        # family, labels merged with le on the bucket lines (the strict
+        # exposition parser forbids duplicate TYPE declarations). Label
+        # values escape \ " and newline per the exposition format.
+        import json as _json
+
+        def esc_label(v: str) -> str:
+            return (
+                v.replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
+        declared: set[str] = set()
+        for key, h in sorted(
+            histograms.items(), key=lambda kv: (kv[0].partition("|")[0], kv[0])
+        ):
+            name, _, labelstr = key.partition("|")
             n = f"{self.prefix}_{sanitize(name)}"
             snap = h.snapshot()
-            head(name, n, "histogram", f"{name} histogram")
+            if n not in declared:
+                declared.add(n)
+                head(name, n, "histogram", f"{name} histogram")
+            extra = ""
+            if labelstr:
+                extra = "".join(
+                    f',{sanitize(k)}="{esc_label(v)}"'
+                    for k, v in _json.loads(labelstr)
+                )
+                series = "{" + extra[1:] + "}"
+            else:
+                series = ""
             for le, cum in snap["buckets"].items():
-                out.append(f'{n}_bucket{{le="{_fmt_le(le)}"}} {cum}')
-            out.append(f"{n}_sum {snap['sum']:.6f}")
-            out.append(f"{n}_count {snap['count']}")
+                out.append(f'{n}_bucket{{le="{_fmt_le(le)}"{extra}}} {cum}')
+            out.append(f"{n}_sum{series} {snap['sum']:.6f}")
+            out.append(f"{n}_count{series} {snap['count']}")
         return "\n".join(out) + "\n"
 
     def snapshot(self) -> dict:

@@ -306,6 +306,22 @@ class Tracer:
             )
             self._watchdog_thread.start()
 
+    # ----- per-job labels (fleet serving) -----------------------------------
+
+    def set_job(self, job_id: str | None) -> str | None:
+        """Set this thread's job label (the fleet scheduler's cluster id —
+        ccx.search.scheduler): every span record, chunk heartbeat and span
+        histogram the thread emits while set carries ``job=<cluster-id>``,
+        so an interleaved multi-job trace is attributable per job instead
+        of landing on one anonymous phase span. Returns the previous label
+        (restore it when the job ends)."""
+        prev = getattr(self._tl, "job", None)
+        self._tl.job = job_id
+        return prev
+
+    def job(self) -> str | None:
+        return getattr(self._tl, "job", None)
+
     # ----- spans ------------------------------------------------------------
 
     def _stack(self) -> list[Span]:
@@ -320,6 +336,11 @@ class Tracer:
         self._maybe_env()
         st = self._stack()
         path = (st[-1].path + "/" + name) if st else name
+        job = self.job()
+        if job is not None and "job" not in attrs:
+            # per-job attribution (fleet serving): the span tree and every
+            # recorder line under it name which cluster's job this is
+            attrs = {"job": job, **attrs}
         s = Span(name, kind, path, attrs, _compile_snapshot())
         if st:
             st[-1].children.append(s)
@@ -379,12 +400,16 @@ class Tracer:
             self._thread_last.pop(tid, None)
         if span.kind:
             # bucketed per-phase / per-RPC / per-verb latency — the
-            # Prometheus face of the span stream
+            # Prometheus face of the span stream. Spans closed under a
+            # fleet job get a ``job=<cluster-id>`` label series so an
+            # interleaved trace's histograms attribute per cluster.
             from ccx.common.metrics import REGISTRY
 
+            job = self.job()
             REGISTRY.histogram(
                 f"{span.kind}-{span.name}-seconds",
                 help=f"ccx {span.kind} '{span.name}' wall seconds (span close)",
+                labels={"job": job} if job is not None else None,
             ).observe(span.wall_s)
 
     @contextlib.contextmanager
@@ -453,6 +478,9 @@ class Tracer:
             # within one poll interval must not leave its (recyclable)
             # ident marked already-dumped forever
             self._stalled_dumped.discard(tid)
+        job = self.job()
+        if job is not None and "job" not in rec:
+            rec = {"job": job, **rec}
         rec = {"t": round(time.time(), 3), "tid": threading.get_ident(), **rec}
         for fn in list(self._listeners):
             try:

@@ -330,6 +330,44 @@ def analyzer_config_def() -> ConfigDef:
              "topic cells; the guard keeps a converged shed's TRD=0 from "
              "being traded back for usage cells — same rationale as "
              "optimizer.topic.rebalance.guarded).")
+    d.define("optimizer.fleet.max.concurrent", Type.INT, 0, Importance.LOW,
+             "Device-residency cap of the multi-job chunk scheduler "
+             "(ccx.search.scheduler): at most this many concurrent "
+             "optimization jobs interleave chunks on the device while the "
+             "rest queue in (priority, arrival) order. 0 = unlimited. "
+             "Bound it when N concurrent jobs' donated carries would "
+             "pressure HBM past the snapshot registry's budget.",
+             at_least(0))
+    d.define("optimizer.fleet.dispatch.width", Type.INT, 0, Importance.LOW,
+             "Simultaneous chunk-dispatch grants of the fleet scheduler. "
+             "0 = auto (host core count, floor 2). Width 1 is strict "
+             "round-robin alternation; the wider default matters on the "
+             "CPU backend, where a dispatch largely IS the execution — "
+             "on an accelerator the grant covers only the async enqueue. "
+             "Grant ORDER stays priority-first/round-robin at any width.",
+             at_least(0))
+    d.define("optimizer.fleet.cluster.id", Type.STRING, "default",
+             Importance.LOW,
+             "This facade's cluster id on the fleet scheduler: the job "
+             "label its verbs register under (spans, heartbeats and "
+             "Prometheus histograms carry job=<cluster-id>), and the "
+             "per-cluster mutual-exclusion key of the proposal path (two "
+             "proposals for the same cluster serialize; different "
+             "clusters never convoy).")
+    d.define("optimizer.fleet.priority.urgent", Type.INT, 10,
+             Importance.LOW,
+             "Scheduler priority of urgent (self-healing) verbs — "
+             "fix-offline-replicas, self-healing rebalances. Higher "
+             "preempts queued lower-priority jobs at the next chunk "
+             "boundary; normal dryrun verbs run at priority 0.",
+             at_least(0))
+    d.define("optimizer.fleet.snapshot.hbm.mb", Type.INT, 0, Importance.LOW,
+             "HBM budget (MB) for the sidecar's device-resident snapshot "
+             "registry (N cluster models kept live, LRU-evicted). 0 = "
+             "auto: half of (device HBM capacity - the cost observatory's "
+             "captured working-set watermark), floor 64 MB "
+             "(ccx.common.costmodel.fleet_snapshot_budget_bytes).",
+             at_least(0))
     d.define("optimizer.repair.backend", Type.STRING, "device",
              Importance.LOW,
              "hard_repair loop driver: 'device' runs the whole sweep loop "

@@ -447,6 +447,7 @@ def optimize(
     goal_names: tuple[str, ...] = DEFAULT_GOAL_ORDER,
     opts: OptimizeOptions = OptimizeOptions(),
     progress_cb=None,
+    job: tuple[str, int] | str | None = None,
 ) -> OptimizerResult:
     """Full-stack proposal computation (reference call stack 3.2, L3a part).
 
@@ -463,7 +464,23 @@ def optimize(
     child span, chunk heartbeats stream to the flight recorder when armed,
     and the completed tree rides out as ``OptimizerResult.span_tree`` — so
     even a run that never returns leaves its diagnosis on disk.
+
+    ``job`` is the fleet entry point (ccx.search.scheduler): a cluster id
+    (or ``(cluster_id, priority)``) registers this call on the multi-job
+    chunk scheduler for its whole duration — every chunk drive inside
+    interleaves with other registered jobs at chunk boundaries, and all
+    spans/heartbeats/histograms carry ``job=<cluster-id>``. None (the
+    default) runs unscheduled; with no other job registered the scheduled
+    path is bit-exact vs unscheduled (grants only order dispatches).
     """
+    if job is not None:
+        from ccx.search.scheduler import FLEET
+
+        cluster_id, priority = (
+            job if isinstance(job, tuple) else (job, 0)
+        )
+        with FLEET.job(str(cluster_id), int(priority)):
+            return optimize(m, cfg, goal_names, opts, progress_cb)
     cost0 = costmodel.exec_snapshot()
     root = TRACER.start(
         "optimize", kind="op",

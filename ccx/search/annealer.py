@@ -24,6 +24,7 @@ where it matters (``actionAcceptance`` veto, SURVEY.md §7.4):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 
@@ -1423,16 +1424,34 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int):
     is armed — in the JSONL, so a SIGKILLed run's last record names
     exactly how deep into which phase it died, and the stall watchdog
     re-arms on live progress. Host-side only (no device sync is added):
-    unarmed, the heartbeat is two attribute writes."""
+    unarmed, the heartbeat is two attribute writes.
+
+    When the calling thread runs under a fleet job
+    (``ccx.search.scheduler.FLEET.job(...)`` — the optimizer's job-handle
+    entry point), every chunk DISPATCH must win a grant from the multi-job
+    run queue: N concurrent jobs interleave their chunks round-robin
+    (priority-ordered) on the device stream instead of convoying, and the
+    chunk boundary becomes the preemption point an urgent job jumps in at.
+    Only the dispatch is gated — the early-exit sync runs outside the
+    grant so another job dispatches while this chunk executes. With no
+    ambient job (tests, tools, single-tenant paths) the loop is exactly
+    the ungated round-11 driver."""
     from ccx.common.tracing import TRACER
+    from ccx.search.scheduler import FLEET
 
     step = max(int(chunk), 1)
     n = max(int(total), 0)
-    for i, off in enumerate(range(0, n, step)):
-        carry, done = run_one(carry, off)
-        TRACER.heartbeat(i, offset=off, total=n)
-        if done is not None and bool(done):
-            break
+    job = FLEET.current()
+    with (FLEET.drive(job) if job is not None else contextlib.nullcontext()):
+        for i, off in enumerate(range(0, n, step)):
+            if job is not None:
+                with FLEET.chunk(job):
+                    carry, done = run_one(carry, off)
+            else:
+                carry, done = run_one(carry, off)
+            TRACER.heartbeat(i, offset=off, total=n)
+            if done is not None and bool(done):
+                break
     return carry
 
 

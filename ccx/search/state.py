@@ -314,14 +314,25 @@ def broker_pressure(
 
 
 def max_partitions_per_topic(m: TensorClusterModel) -> int:
-    """Host-side static bound for ``topic_member_index`` (jit static arg)."""
+    """Host-side static bound for ``topic_member_index`` (jit static arg).
+
+    Bucketed UP to the next power of two (floor 8): the bound is a
+    capacity — topics with fewer members are -1-padded, so a larger cap is
+    bit-inert — but it keys every compiled search program. Exact counts
+    made same-shape clusters compile per SNAPSHOT (fleet serving's 16
+    concurrent B3-sized jobs each paid a fresh SA/polish program set
+    because their random topic skews differed by a few partitions);
+    bucketing pins the program to the shape family, so a fleet of
+    same-bucket clusters shares ONE compiled set and a drifting snapshot
+    only recompiles when its densest topic crosses a power of two."""
     import numpy as np
 
     topic = np.asarray(m.partition_topic)
     valid = np.asarray(m.partition_valid)
     if not valid.any():
         return 1
-    return max(int(np.bincount(topic[valid], minlength=m.num_topics).max()), 1)
+    exact = max(int(np.bincount(topic[valid], minlength=m.num_topics).max()), 1)
+    return max(1 << (exact - 1).bit_length(), 8)
 
 
 def topic_member_index(m: TensorClusterModel, max_pt: int) -> jnp.ndarray:

@@ -105,8 +105,13 @@ class UserTaskManager:
         self.completed_retention_ms = completed_retention_ms
         self.max_cached_completed = max_cached_completed
         self.clock = clock or (lambda: int(_time.time() * 1000))
+        # +2 headroom over the admission cap: urgent (self-healing)
+        # submissions bypass the cap and must get a worker immediately
+        # instead of queueing in the pool behind the very dryruns they
+        # outrank (the thread-pool twin of the fleet scheduler's
+        # priority bypass)
         self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max_active_tasks, thread_name_prefix="user-task"
+            max_workers=max_active_tasks + 2, thread_name_prefix="user-task"
         )
         self._tasks: dict[str, UserTaskInfo] = {}
         self._lock = threading.Lock()
@@ -121,14 +126,18 @@ class UserTaskManager:
         )
 
     def submit(self, endpoint: str, fn, request_url: str = "",
-               client_id: str = "") -> UserTaskInfo:
-        """Run ``fn(progress)`` async; raises if at the active-task cap."""
+               client_id: str = "", urgent: bool = False) -> UserTaskInfo:
+        """Run ``fn(progress)`` async; raises if at the active-task cap.
+        ``urgent`` (self-healing verbs — fix_offline_replicas) bypasses
+        the cap: an offline-replica fix must never be 503'd because
+        dryruns saturated the task table (the executor keeps headroom so
+        it also starts immediately)."""
         with self._lock:
             self._expire()
             active = sum(
                 1 for t in self._tasks.values() if t.state == TaskState.ACTIVE
             )
-            if active >= self.max_active_tasks:
+            if active >= self.max_active_tasks and not urgent:
                 raise RuntimeError(
                     f"There are already {active} active user tasks "
                     f"(max.active.user.tasks={self.max_active_tasks})"

@@ -67,6 +67,20 @@ class CruiseControl:
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_ms = -1
         self._proposal_lock = threading.Lock()
+        # fleet serving (ccx.search.scheduler): per-CLUSTER proposal
+        # mutual exclusion replaces the old coarse convoy — two proposals
+        # for the same cluster still serialize (duplicate work, and the
+        # executor must never see two racing plans for one cluster), but
+        # concurrent Propose calls for different clusters interleave
+        # chunks on the device instead of queueing behind one lock
+        self._cluster_locks: dict[str, threading.Lock] = {}
+        self._cluster_locks_guard = threading.Lock()
+        from ccx.search import scheduler as _fleet
+
+        _fleet.configure(
+            max_concurrent=config["optimizer.fleet.max.concurrent"],
+            dispatch_width=config["optimizer.fleet.dispatch.width"],
+        )
         self._precompute_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._start_ms = self.clock()
@@ -107,6 +121,12 @@ class CruiseControl:
         costmodel.set_device_override(
             config["observability.cost.peak.tflops"],
             config["observability.cost.hbm.gbps"],
+        )
+        # fleet snapshot-registry budget (0 = auto from device capacity
+        # minus the captured watermark) — consumed by any in-process
+        # sidecar registry; the standalone sidecar takes the env/flag twin
+        costmodel.set_fleet_hbm_budget(
+            config["optimizer.fleet.snapshot.hbm.mb"]
         )
         costmodel.export_gauges(REGISTRY)
 
@@ -249,16 +269,42 @@ class CruiseControl:
             ],
         )
 
+    def _cluster_lock(self, cluster_id: str | None = None) -> threading.Lock:
+        """The per-cluster proposal mutex (fleet serving): proposals for
+        ONE cluster serialize; different clusters never convoy."""
+        cid = cluster_id or self.config["optimizer.fleet.cluster.id"]
+        with self._cluster_locks_guard:
+            return self._cluster_locks.setdefault(cid, threading.Lock())
+
     def _run_optimizer(self, model, goal_names, opts: OptimizeOptions,
-                       progress=None, verb: str = "proposal") -> OptimizerResult:
+                       progress=None, verb: str = "proposal",
+                       urgent: bool = False,
+                       cluster_id: str | None = None) -> OptimizerResult:
         backend = self.config["goal.optimizer.backend"]
         if progress:
             progress.step(f"Optimizing ({backend} backend, {len(goal_names)} goals)")
+        cid = cluster_id or self.config["optimizer.fleet.cluster.id"]
+        priority = (
+            self.config["optimizer.fleet.priority.urgent"] if urgent else 0
+        )
+        from ccx.search.scheduler import FLEET
+
+        # per-cluster mutual exclusion + fleet job registration: the verb
+        # runs as one job on the multi-job chunk scheduler, and all its
+        # spans/heartbeats carry job=<cluster-id>. Preemption semantics:
+        # an urgent self-healing verb preempts OTHER clusters' in-flight
+        # jobs at their next chunk boundary (and jumps the cross-cluster
+        # run queue); verbs for the SAME cluster serialize on the cluster
+        # lock BY DESIGN — the executor must never see two racing plans
+        # for one cluster, so intra-cluster urgency means "next in line",
+        # not mid-run cancellation.
         # verb span: the facade layer of the span pipeline (verb →
         # optimizer phases → chunk heartbeats → sidecar RPCs) — per-verb
         # Prometheus histogram + the flight-recorder breadcrumb naming
         # which operation a dead process was serving
-        with REGISTRY.timer("proposal-computation").time(), \
+        with self._cluster_lock(cid), \
+                FLEET.job(cid, priority), \
+                REGISTRY.timer("proposal-computation").time(), \
                 TRACER.span(verb, kind="verb", backend=backend,
                             goals=len(goal_names)), \
                 profiling.trace(self.config["optimizer.profile.dir"]):
@@ -391,6 +437,7 @@ class CruiseControl:
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing),
             self._optimize_options(), progress, verb="rebalance",
+            urgent=self_healing,
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress,
                             replication_throttle)
@@ -415,6 +462,7 @@ class CruiseControl:
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing),
             self._optimize_options(), progress, verb="add-brokers",
+            urgent=self_healing,
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress,
                             replication_throttle)
@@ -434,6 +482,7 @@ class CruiseControl:
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing),
             self._optimize_options(), progress, verb="remove-brokers",
+            urgent=self_healing,
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress,
                             replication_throttle)
@@ -461,9 +510,12 @@ class CruiseControl:
         """Move replicas off dead brokers/disks (ref fixOfflineReplicas;
         the disk-failure self-healing fix)."""
         model, metadata, gen = self._model(progress=progress)
+        # the flagship urgent verb: replicas are offline NOW — it jumps
+        # every queued dryrun at the next chunk boundary
         res = self._run_optimizer(
             model, self._resolve_goals(goals, self_healing=True),
             self._optimize_options(), progress, verb="fix-offline-replicas",
+            urgent=True,
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress)
 
@@ -657,6 +709,13 @@ class CruiseControl:
                             "optimizer.swap.polish.chunk.iters"
                         ],
                     },
+                    # fleet serving state (ccx.search.scheduler): the
+                    # multi-job chunk scheduler's live run queue + window
+                    # stats — an operator confirms from REST that
+                    # concurrent proposals interleave (meanDepth > 1)
+                    # instead of convoying, and which cluster ids are
+                    # active at what priority
+                    "fleet": self._fleet_state(),
                     # flight-recorder / watchdog / span state (ccx.common.
                     # tracing), VIEWER-safe summary: STATE is viewer-
                     # readable, so this must not leak what security.py
@@ -824,6 +883,22 @@ class CruiseControl:
         return self.load_monitor.train(start_ms, end_ms)
 
     # ----- internals --------------------------------------------------------
+
+    def _fleet_state(self) -> dict:
+        """AnalyzerState.fleet: scheduler config + live run-queue stats
+        (never raises — STATE must stay readable under any backend)."""
+        try:
+            from ccx.search.scheduler import FLEET
+
+            return {
+                "clusterId": self.config["optimizer.fleet.cluster.id"],
+                "urgentPriority": self.config[
+                    "optimizer.fleet.priority.urgent"
+                ],
+                "scheduler": FLEET.stats(),
+            }
+        except Exception:  # noqa: BLE001 — state must stay readable
+            return {"clusterId": self.config["optimizer.fleet.cluster.id"]}
 
     def _mesh_state(self) -> dict:
         """AnalyzerState.observability.mesh: configured mesh shape + live

@@ -141,6 +141,10 @@ class CruiseControlApp:
         info = self.user_tasks.submit(
             endpoint.value.upper(), fn,
             request_url=f"{URL_PREFIX}/{endpoint.value}", client_id=client,
+            # self-healing fixes bypass the active-task cap (and run at
+            # urgent fleet priority below): a saturated dryrun table must
+            # never 503 an offline-replica repair
+            urgent=endpoint is EndPoint.FIX_OFFLINE_REPLICAS,
         )
         try:
             info.future.result(timeout=self.max_block_ms / 1000.0)

@@ -43,25 +43,32 @@ class SidecarClient:
 
     def put_snapshot(self, model, session: str, generation: int,
                      is_delta: bool = False, base_generation: int | None = None,
-                     packed: bytes | None = None) -> dict:
+                     packed: bytes | None = None,
+                     cluster_id: str | None = None) -> dict:
         req = wire.put_snapshot_request(
             session=session, generation=generation,
             packed=packed if packed is not None else _pack_model(model),
             is_delta=is_delta, base_generation=base_generation,
+            cluster_id=cluster_id,
         )
         return wire.decode_response(self._put(req))
 
     def propose(self, model=None, session: str | None = None,
                 goals: tuple[str, ...] = (), on_progress=None,
-                columnar: bool = False, **options) -> dict:
+                columnar: bool = False, cluster_id: str | None = None,
+                priority: int | None = None, **options) -> dict:
         """``columnar=True`` requests the proposals as one raw-buffer
         arrays blob (``diff_columnar`` schema) instead of per-proposal
         maps — the fast path for B5-scale results; the returned dict then
-        carries numpy arrays under ``proposalsColumnar``."""
+        carries numpy arrays under ``proposalsColumnar``. ``cluster_id``
+        names the fleet job on the sidecar's multi-job chunk scheduler
+        (default: the session id); ``priority`` orders it in the run queue
+        (higher preempts at the next chunk boundary)."""
         req = wire.propose_request(
             goals=goals, options=options,
             snapshot=_pack_model(model) if model is not None else None,
             session=session, columnar=columnar,
+            cluster_id=cluster_id, priority=priority,
         )
         result: dict | None = None
         for raw in self._propose(req):

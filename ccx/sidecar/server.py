@@ -39,12 +39,131 @@ from ccx.sidecar import SERVICE, identity as _identity, wire
 log = logging.getLogger(__name__)
 
 
+class SnapshotRegistry:
+    """Device-resident snapshot registry — fleet serving's N-cluster cache.
+
+    The host arrays of every session snapshot are kept (the round-8
+    ``_snapshots`` dict, unbounded and cheap), and on top of them the
+    BUILT device model (``arrays_to_model`` output: padded, device-
+    committed tensors) is cached per cluster so a fleet of repeat Propose
+    callers stops paying the build + host→device transfer per call.
+    Device residency is bounded by an HBM budget priced from the cost
+    observatory (``costmodel.fleet_snapshot_budget_bytes``: device
+    capacity minus the captured program working-set watermark, operator-
+    overridable); least-recently-used models are evicted first — eviction
+    only drops the DEVICE copy, the host arrays stay, so an evicted
+    cluster's next Propose rebuilds instead of failing.
+
+    Thread-safe: one lock guards the maps; the model build itself runs
+    outside it (two racing builders of the same session waste one build,
+    never corrupt state)."""
+
+    def __init__(self, hbm_budget_bytes: int | None = None) -> None:
+        self._lock = threading.Lock()
+        #: session -> (generation, host arrays)
+        self._snapshots: dict[str, tuple[int, dict]] = {}
+        #: session -> (generation, device model, device bytes, lru stamp)
+        self._models: dict[str, tuple[int, object, int, int]] = {}
+        self._seq = 0
+        self._explicit_budget = hbm_budget_bytes
+        self.evictions = 0
+        self.hits = 0
+        self.misses = 0
+
+    def budget_bytes(self) -> int:
+        if self._explicit_budget is not None and self._explicit_budget > 0:
+            return int(self._explicit_budget)
+        from ccx.common import costmodel
+
+        return costmodel.fleet_snapshot_budget_bytes()
+
+    # dict-compatible surface (the server's session logic + existing tests
+    # reach through these like the old plain dict)
+    def get(self, session: str):
+        with self._lock:
+            return self._snapshots.get(session)
+
+    def put(self, session: str, generation: int, arrays: dict) -> None:
+        with self._lock:
+            self._snapshots[session] = (int(generation), arrays)
+            # the cached device model is stale now — drop it; the next
+            # Propose for this cluster rebuilds from the new arrays
+            self._models.pop(session, None)
+
+    def model(self, session: str):
+        """The device model for a session's CURRENT snapshot — cache hit
+        when resident, else built and admitted under the HBM budget."""
+        with self._lock:
+            entry = self._snapshots.get(session)
+            if entry is None:
+                return None
+            gen = entry[0]
+            cached = self._models.get(session)
+            if cached is not None and cached[0] == gen:
+                self._seq += 1
+                self._models[session] = (
+                    cached[0], cached[1], cached[2], self._seq
+                )
+                self.hits += 1
+                return cached[1]
+            arrays = entry[1]
+            self.misses += 1
+        m = arrays_to_model(arrays)
+        nbytes = model_device_bytes(m)
+        with self._lock:
+            self._seq += 1
+            self._models[session] = (gen, m, nbytes, self._seq)
+            self._evict_over_budget()
+        return m
+
+    def _evict_over_budget(self) -> None:
+        """LRU eviction of device models over the HBM budget (lock held).
+        The just-admitted model is kept even when it alone exceeds the
+        budget (serving beats strict accounting — one job must always be
+        able to run)."""
+        budget = self.budget_bytes()
+        while len(self._models) > 1:
+            total = sum(v[2] for v in self._models.values())
+            if total <= budget:
+                break
+            victim = min(self._models, key=lambda s: self._models[s][3])
+            del self._models[victim]
+            self.evictions += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            device_bytes = sum(v[2] for v in self._models.values())
+            return {
+                "sessions": len(self._snapshots),
+                "deviceResident": len(self._models),
+                "deviceBytes": device_bytes,
+                "budgetBytes": self.budget_bytes(),
+                "evictions": self.evictions,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+def model_device_bytes(m) -> int:
+    """Device footprint of a built model: sum of its array leaves' nbytes
+    (padded shapes — what actually sits in HBM)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(m):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
 class OptimizerSidecar:
     """Method implementations (transport-independent, tested directly)."""
 
-    def __init__(self, goal_config: GoalConfig | None = None) -> None:
+    def __init__(self, goal_config: GoalConfig | None = None,
+                 snapshot_hbm_budget_bytes: int | None = None) -> None:
         self.goal_config = goal_config or GoalConfig()
-        self._snapshots: dict[str, tuple[int, dict]] = {}
+        self.registry = SnapshotRegistry(snapshot_hbm_budget_bytes)
         self._lock = threading.Lock()
 
     # ----- PutSnapshot ------------------------------------------------------
@@ -61,7 +180,7 @@ class OptimizerSidecar:
         arrays = _decode_snapshot(req["packed"], what="packed snapshot")
         with self._lock:
             if req.get("is_delta"):
-                base = self._snapshots.get(session)
+                base = self.registry.get(session)
                 if base is None:
                     raise ValueError(f"no base snapshot for session {session!r}")
                 base_gen = req.get("base_generation")
@@ -74,7 +193,7 @@ class OptimizerSidecar:
                         f"cached generation {base[0]} for session {session!r}"
                     )
                 arrays = delta_apply(base[1], arrays)
-            self._snapshots[session] = (generation, arrays)
+            self.registry.put(session, generation, arrays)
         return wire.ack_response(generation)
 
     # ----- Propose ----------------------------------------------------------
@@ -84,6 +203,7 @@ class OptimizerSidecar:
         req = wire.unpackb(request)
         wire.check_version(req)
         yield wire.progress_frame("Decoding snapshot")
+        model = None
         if req.get("snapshot") is not None:
             arrays = _decode_snapshot(req["snapshot"], what="snapshot")
         else:
@@ -91,7 +211,7 @@ class OptimizerSidecar:
             # Read, validate, apply, and store under ONE lock acquisition so
             # concurrent deltas for a session cannot silently drop updates.
             with self._lock:
-                entry = self._snapshots.get(session)
+                entry = self.registry.get(session)
                 if entry is None:
                     raise ValueError(f"no snapshot for session {session!r}")
                 if req.get("delta") is not None:
@@ -105,12 +225,20 @@ class OptimizerSidecar:
                     arrays = delta_apply(
                         entry[1], _decode_snapshot(req["delta"], what="delta")
                     )
-                    self._snapshots[session] = (
-                        int(req.get("generation", entry[0] + 1)), arrays
+                    self.registry.put(
+                        session, int(req.get("generation", entry[0] + 1)),
+                        arrays,
                     )
                 else:
                     arrays = entry[1]
-        model = arrays_to_model(arrays)
+            # device-resident fleet path: the registry serves the BUILT
+            # (padded, device-committed) model for this cluster's current
+            # generation — repeat Proposes skip arrays_to_model + the
+            # host->device transfer entirely, N clusters stay live under
+            # the HBM budget (LRU-evicted; an evicted cluster rebuilds)
+            model = self.registry.model(session)
+        if model is None:
+            model = arrays_to_model(arrays)
 
         goals = tuple(req.get("goals") or ()) or DEFAULT_GOAL_ORDER
         unknown = [g for g in goals if g not in GOAL_REGISTRY]
@@ -213,12 +341,21 @@ class OptimizerSidecar:
 
         q: _queue.Queue = _queue.Queue()
         box: dict = {}
+        # fleet job identity: the cluster id names this job on the multi-
+        # job chunk scheduler (and on every span/heartbeat/histogram it
+        # emits); priority orders it in the run queue — an urgent
+        # fix-offline-replicas Propose preempts a queued dryrun at the
+        # next chunk boundary. Absent fields degrade to the session id
+        # (pre-fleet peers) and priority 0.
+        cluster = str(req.get("cluster_id") or req.get("session") or "anon")
+        priority = int(req.get("priority") or 0)
 
         def _run():
             try:
                 box["res"] = optimize(
                     model, self.goal_config, goals, opts,
                     progress_cb=lambda p: q.put(("phase", p)),
+                    job=(cluster, priority),
                 )
             except BaseException as e:  # re-raised below, at the RPC edge
                 box["err"] = e
@@ -259,6 +396,9 @@ class OptimizerSidecar:
                         span=payload.get("span"),
                         chunk=payload["chunk"],
                         total=payload.get("total"),
+                        # per-job progress frames: the interleaved fleet
+                        # stream stays attributable per cluster
+                        job=payload.get("job", cluster),
                     )
         finally:
             TRACER.remove_listener(_tap)
@@ -305,9 +445,20 @@ def _decode_snapshot(packed: bytes, what: str) -> dict:
 
 
 def make_grpc_server(sidecar: OptimizerSidecar | None = None,
-                     address: str = "127.0.0.1:0", max_workers: int = 4):
-    """Returns (grpc server, bound port)."""
+                     address: str = "127.0.0.1:0",
+                     max_workers: int | None = None):
+    """Returns (grpc server, bound port). ``max_workers`` bounds concurrent
+    RPC handlers — the fleet ceiling on in-flight Propose streams (each
+    holds one handler thread while relaying frames). Default: env
+    ``CCX_SIDECAR_WORKERS``, else 16 — sized so a 16-stream fleet bench
+    never convoys in the transport before the chunk scheduler even sees
+    the jobs (the scheduler, not the thread pool, is the policy layer)."""
+    import os
+
     import grpc
+
+    if max_workers is None:
+        max_workers = int(os.environ.get("CCX_SIDECAR_WORKERS", "16"))
 
     from ccx.common import compilestats
 
@@ -384,6 +535,21 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description="ccx TPU optimizer sidecar")
     ap.add_argument("--address", default="127.0.0.1:50051")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="gRPC handler threads (default CCX_SIDECAR_WORKERS "
+                         "or 16) — the transport ceiling on concurrent "
+                         "Propose streams")
+    ap.add_argument("--fleet-max-concurrent", type=int,
+                    default=None,
+                    help="device-residency cap of the multi-job chunk "
+                         "scheduler (default CCX_FLEET_MAX_CONCURRENT or "
+                         "unlimited)")
+    ap.add_argument("--snapshot-hbm-mb", type=float, default=None,
+                    help="HBM budget for the device-resident snapshot "
+                         "registry (default CCX_FLEET_HBM_MB, else auto "
+                         "from device capacity minus the cost "
+                         "observatory's watermark — the standalone twin "
+                         "of optimizer.fleet.snapshot.hbm.mb)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     # same wedged-accelerator safeguard as the service entry point: a hung
@@ -403,7 +569,24 @@ def main(argv=None) -> int:
 
     if _os.environ.get(costmodel.ENV_CAPTURE) != "0":
         costmodel.set_capture(True)
-    server, port = make_grpc_server(address=args.address)
+    # fleet scheduler residency cap (0/unset = unlimited interleave)
+    from ccx.search import scheduler as fleet
+
+    mc = args.fleet_max_concurrent
+    if mc is None:
+        mc_env = _os.environ.get("CCX_FLEET_MAX_CONCURRENT")
+        mc = int(mc_env) if mc_env else None
+    if mc is not None:
+        fleet.configure(max_concurrent=mc)
+    sidecar = OptimizerSidecar(
+        snapshot_hbm_budget_bytes=(
+            int(args.snapshot_hbm_mb * 1e6)
+            if args.snapshot_hbm_mb
+            else None
+        )
+    )
+    server, port = make_grpc_server(sidecar, address=args.address,
+                                    max_workers=args.workers)
     server.start()
     log.info("optimizer sidecar listening on port %s", port)
     server.wait_for_termination()

@@ -34,6 +34,12 @@ WIRE_VERSION = 1
 SUPPORTED_WIRE_VERSIONS = (1,)
 #: envelope field carrying the version (requests, responses, frames alike)
 FIELD_WIRE = "wire"
+#: fleet-serving envelope fields (round 12, additive — absent fields keep
+#: pre-fleet semantics: session id doubles as cluster id, priority 0)
+FIELD_CLUSTER_ID = "cluster_id"
+FIELD_PRIORITY = "priority"
+#: heartbeat-frame field naming the job a chunk belongs to
+FIELD_JOB = "job"
 
 # ----- structured error codes ----------------------------------------------
 
@@ -161,7 +167,8 @@ def ping_request() -> bytes:
 
 def put_snapshot_request(session: str, generation: int, packed: bytes,
                          is_delta: bool = False,
-                         base_generation: int | None = None) -> bytes:
+                         base_generation: int | None = None,
+                         cluster_id: str | None = None) -> bytes:
     req: dict = {
         "session": session,
         "generation": int(generation),
@@ -170,6 +177,12 @@ def put_snapshot_request(session: str, generation: int, packed: bytes,
     }
     if base_generation is not None:
         req["base_generation"] = int(base_generation)
+    if cluster_id is not None:
+        # fleet serving (round 12, additive): names the Kafka cluster this
+        # snapshot belongs to in the sidecar's device-resident registry.
+        # Absent ⇒ the session id doubles as the cluster id (pre-fleet
+        # peers unchanged, fixtures byte-stable).
+        req["cluster_id"] = str(cluster_id)
     return packb(_stamped(req))
 
 
@@ -178,7 +191,9 @@ def propose_request(goals: Iterable[str] = (), options: dict | None = None,
                     delta: bytes | None = None,
                     base_generation: int | None = None,
                     generation: int | None = None,
-                    columnar: bool = False) -> bytes:
+                    columnar: bool = False,
+                    cluster_id: str | None = None,
+                    priority: int | None = None) -> bytes:
     req: dict = {"goals": list(goals), "options": dict(options or {})}
     if snapshot is not None:
         req["snapshot"] = snapshot
@@ -192,6 +207,15 @@ def propose_request(goals: Iterable[str] = (), options: dict | None = None,
         req["generation"] = int(generation)
     if columnar:
         req["columnar_proposals"] = True
+    if cluster_id is not None:
+        # fleet serving (round 12, additive): the job id this Propose runs
+        # under on the multi-job chunk scheduler; absent ⇒ session id
+        req["cluster_id"] = str(cluster_id)
+    if priority is not None:
+        # integer scheduler priority (higher = more urgent — an urgent
+        # fix-offline-replicas preempts a queued dryrun at the next chunk
+        # boundary); absent ⇒ 0
+        req["priority"] = int(priority)
     return packb(_stamped(req))
 
 
@@ -213,12 +237,15 @@ def progress_frame(text: str) -> dict:
 
 def heartbeat_frame(text: str, span: str | None = None,
                     chunk: int | None = None,
-                    total: int | None = None) -> dict:
+                    total: int | None = None,
+                    job: str | None = None) -> dict:
     """A progress frame carrying structured span context — the wire face
     of the flight-recorder chunk heartbeats (ccx.common.tracing), so the
     JVM's OperationProgress can show live per-phase chunk progress during
     a long TPU window. Additive and wire-compatible: pre-observability
-    clients read only the ``progress`` text and ignore the extra keys."""
+    clients read only the ``progress`` text and ignore the extra keys.
+    ``job`` (round 12, additive) is the fleet cluster id the chunk belongs
+    to, so an interleaved multi-job stream stays attributable per job."""
     f: dict = {"progress": text}
     if span is not None:
         f["span"] = span
@@ -226,6 +253,8 @@ def heartbeat_frame(text: str, span: str | None = None,
         f["chunk"] = int(chunk)
     if total is not None:
         f["total"] = int(total)
+    if job is not None:
+        f["job"] = str(job)
     return _stamped(f)
 
 

@@ -316,3 +316,98 @@ def test_check_is_wired_into_campaign_script():
     sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
     assert "bench_ledger.py" in sh and "--check" in sh
     assert "CCX_PROFILE_DIR" in sh
+
+
+# ----- fleet (FLEET_r*.json — bench.py --fleet) ------------------------------
+
+
+def _fleet_line(p99=41.0, p50=24.0, verified=True, n_jobs=16, cores=2,
+                **extra):
+    return {
+        "metric": "B3 fleet serving: 16 concurrent Propose streams "
+                  "through the sidecar (p99 latency)",
+        "value": p99, "unit": "s", "vs_baseline": 1.2, "fleet": True,
+        "config": "B3", "n_jobs": n_jobs, "backend": "cpu",
+        "host_cores": cores, "verified": verified,
+        "latency": {"p50_s": p50, "p99_s": p99, "mean_s": p50,
+                    "walls": [p50, p99]},
+        "throughput_per_min": 23.4, "serialized_s": 48.8,
+        "concurrent_s": 40.9, "speedup": 1.19, "occupancy": 0.99,
+        "mean_depth": 1.9, "urgent": {"wall_s": 4.4, "wave_p50_s": 26.8,
+                                      "verified": True},
+        "effort": {"chains": 8, "steps": 400, "n_jobs": n_jobs},
+        **extra,
+    }
+
+
+def _bank_fleet(tmp_path, n, line):
+    (tmp_path / f"FLEET_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_fleet_rows_parse(tmp_path):
+    _bank_fleet(tmp_path, 1, _fleet_line())
+    rows, partials = bench_ledger.load_fleet(str(tmp_path))
+    assert partials == []
+    (r,) = rows
+    assert r["p99"] == 41.0 and r["n_jobs"] == 16 and r["verified"]
+
+
+def test_fleet_p99_regression_fails(tmp_path):
+    _bank_fleet(tmp_path, 1, _fleet_line(p99=41.0))
+    _bank_fleet(tmp_path, 2, _fleet_line(p99=48.0))
+    rows, _ = bench_ledger.load_fleet(str(tmp_path))
+    failures = bench_ledger.check_fleet(rows)
+    assert failures and "p99" in failures[0]
+
+
+def test_fleet_within_threshold_passes(tmp_path):
+    _bank_fleet(tmp_path, 1, _fleet_line(p99=41.0))
+    _bank_fleet(tmp_path, 2, _fleet_line(p99=43.0))
+    rows, _ = bench_ledger.load_fleet(str(tmp_path))
+    assert bench_ledger.check_fleet(rows) == []
+
+
+def test_fleet_unverified_latest_fails(tmp_path):
+    _bank_fleet(tmp_path, 1, _fleet_line(verified=False))
+    rows, _ = bench_ledger.load_fleet(str(tmp_path))
+    failures = bench_ledger.check_fleet(rows)
+    assert failures and "UNVERIFIED" in failures[0]
+
+
+def test_fleet_different_host_not_comparable(tmp_path):
+    # a 2-core container's p99 must never gate an 8-core (or TPU) round
+    _bank_fleet(tmp_path, 1, _fleet_line(p99=10.0, cores=8))
+    _bank_fleet(tmp_path, 2, _fleet_line(p99=41.0, cores=2))
+    rows, _ = bench_ledger.load_fleet(str(tmp_path))
+    assert bench_ledger.check_fleet(rows) == []
+
+
+def test_fleet_partial_round_reported_not_failed(tmp_path):
+    (tmp_path / "FLEET_r03.json").write_text(
+        json.dumps({"n": 3, "rc": 124, "parsed": None})
+    )
+    rows, partials = bench_ledger.load_fleet(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+    assert bench_ledger.check_fleet(rows) == []
+
+
+def test_fleet_gate_green_on_banked_artifacts():
+    """The repo's own FLEET artifacts must pass the gate."""
+    rows, _ = bench_ledger.load_fleet(str(REPO))
+    assert bench_ledger.check_fleet(rows) == []
+
+
+def test_fleet_rides_cli_table_and_check(tmp_path, capsys):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_fleet(tmp_path, 1, _fleet_line())
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 0
+    bench_ledger.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "fleet serving" in out and "speedup" in out
+
+
+def test_fleet_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_FLEET=1" in sh

@@ -118,6 +118,9 @@ def test_java_wire_constants_match_python():
         "METHOD_PING": "Ping",
         "WIRE_VERSION": wire.WIRE_VERSION,
         "FIELD_WIRE": wire.FIELD_WIRE,
+        "FIELD_CLUSTER_ID": wire.FIELD_CLUSTER_ID,
+        "FIELD_PRIORITY": wire.FIELD_PRIORITY,
+        "FIELD_JOB": wire.FIELD_JOB,
         "ERR_UNSUPPORTED_VERSION": wire.ERR_UNSUPPORTED_VERSION,
         "ERR_MALFORMED": wire.ERR_MALFORMED,
         "ERR_BAD_SNAPSHOT": wire.ERR_BAD_SNAPSHOT,

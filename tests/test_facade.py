@@ -149,6 +149,81 @@ def test_fix_offline_replicas(tmp_path):
     assert 2 not in hosts
 
 
+def test_per_cluster_locks_and_fleet_priorities(tmp_path):
+    """Fleet serving in the facade (ISSUE 8 satellite): proposals for the
+    SAME cluster serialize on one per-cluster mutex, different clusters
+    get different locks (no convoy), and verbs register on the fleet
+    scheduler with the configured identity/priorities — urgent
+    (self-healing) verbs at optimizer.fleet.priority.urgent, dryruns at
+    0."""
+    import threading
+
+    cc, sim, clock = make_cc(tmp_path, sim_cluster(skewed=True))
+    # lock identity: per-cluster, stable, default = configured cluster id
+    a1, a2 = cc._cluster_lock("clusterA"), cc._cluster_lock("clusterA")
+    b = cc._cluster_lock("clusterB")
+    assert a1 is a2 and a1 is not b
+    assert cc._cluster_lock() is cc._cluster_lock("default")
+
+    # same-cluster mutual exclusion is held around the optimizer run:
+    # while the default cluster's lock is held, a rebalance blocks; a
+    # DIFFERENT cluster's lock being held does not perturb it
+    done = threading.Event()
+
+    def run():
+        cc.rebalance(dryrun=True, reason="concurrent")
+        done.set()
+
+    with b:  # another cluster's lock — must not convoy
+        t = threading.Thread(target=run)
+        t.start()
+        assert done.wait(timeout=60), "different-cluster lock convoyed"
+        t.join()
+
+    done.clear()
+    with cc._cluster_lock():  # same cluster — must serialize
+        t = threading.Thread(target=run)
+        t.start()
+        assert not done.wait(timeout=1.0), (
+            "same-cluster proposals did not serialize"
+        )
+    assert done.wait(timeout=60)
+    t.join()
+
+    # fleet job identity/priority per verb (captured via the scheduler)
+    import ccx.search.scheduler as sched
+
+    captured = []
+    orig = sched.FLEET
+
+    class Spy:
+        def __getattr__(self, name):
+            return getattr(orig, name)
+
+        def job(self, cluster_id, priority=0):
+            captured.append((cluster_id, priority))
+            return orig.job(cluster_id, priority)
+
+    sched.FLEET = Spy()
+    try:
+        cc.rebalance(dryrun=True, reason="dryrun")
+        sim.kill_broker(2)
+        clock["now"] += 1000
+        cc.load_monitor.sample_once()
+        cc.fix_offline_replicas(dryrun=True, reason="urgent")
+    finally:
+        sched.FLEET = orig
+    assert captured[0] == ("default", 0)
+    assert captured[1] == (
+        "default", cc.config["optimizer.fleet.priority.urgent"]
+    )
+
+    # AnalyzerState surfaces the fleet scheduler (REST-diagnosable)
+    fleet = cc.state(("analyzer",))["AnalyzerState"]["fleet"]
+    assert fleet["clusterId"] == "default"
+    assert "scheduler" in fleet and "occupancy" in fleet["scheduler"]
+
+
 def test_proposals_cache(tmp_path):
     cc, sim, clock = make_cc(tmp_path)
     p1 = cc.proposals()
@@ -278,6 +353,32 @@ def test_user_task_manager_lifecycle():
     # retention expiry
     clock["now"] += 20_000
     assert utm.tasks() == []
+
+
+def test_user_task_urgent_bypasses_active_cap():
+    """A self-healing submission (urgent=True — the servlet sets it for
+    fix_offline_replicas) must neither 503 at the active-task cap nor
+    queue behind the dryruns saturating it (executor headroom)."""
+    import threading
+
+    utm = UserTaskManager(max_active_tasks=2)
+    gate = threading.Event()
+
+    def slow(progress):
+        gate.wait(5)
+        return {"ok": True}
+
+    utm.submit("REBALANCE", slow)
+    utm.submit("PROPOSALS", slow)
+    with pytest.raises(RuntimeError, match="active user tasks"):
+        utm.submit("REBALANCE", slow)
+    urgent = utm.submit(
+        "FIX_OFFLINE_REPLICAS", lambda p: {"fixed": True}, urgent=True
+    )
+    # runs to completion WHILE the cap-filling tasks still hold the gate
+    assert urgent.future.result(timeout=5) == {"fixed": True}
+    gate.set()
+    utm.shutdown()
 
 
 def test_user_task_error_capture():

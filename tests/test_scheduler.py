@@ -1,0 +1,425 @@
+"""Multi-job chunk scheduler tests (ISSUE 8 acceptance criteria): round-
+robin fairness without starvation, priority preemption within one chunk
+boundary, early-exit isolation between concurrent jobs' carries, the
+16-shape-bucketed-jobs zero-fresh-compile tripwire, and single-job
+bit-exactness of the scheduled path vs the pre-scheduler driver at
+1/10-scale-B5 shape."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ccx.common import compilestats
+from ccx.goals.base import GoalConfig
+from ccx.model.fixtures import RandomClusterSpec, random_cluster
+from ccx.search.scheduler import FLEET, ChunkScheduler
+
+#: goal subset shared by every real-engine test here: enough tiers to
+#: exercise topic groups + leadership, small enough that the module's
+#: compiled program set stays cheap (tier-1 budget)
+GOALS = (
+    "StructuralFeasibility",
+    "RackAwareGoal",
+    "ReplicaDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+)
+
+SMALL = RandomClusterSpec(
+    n_brokers=12, n_racks=3, n_topics=4, n_partitions=220, seed=11
+)
+
+
+def small_opts(seed=3):
+    from ccx.optimizer import OptimizeOptions
+    from ccx.search.annealer import AnnealOptions
+    from ccx.search.greedy import GreedyOptions
+
+    return OptimizeOptions(
+        anneal=AnnealOptions(
+            n_chains=4, n_steps=100, moves_per_step=2, seed=seed,
+            chunk_steps=50,
+        ),
+        polish=GreedyOptions(
+            n_candidates=48, max_iters=24, patience=6, chunk_iters=8
+        ),
+        run_cold_greedy=False,
+        topic_rebalance_rounds=0,
+        swap_polish_iters=0,
+        swap_polish_post_iters=0,
+    )
+
+
+# ----- pure scheduler semantics (no device work) -----------------------------
+
+
+def _fake_job(s, jid, n_chunks, grants, priority=0, chunk_s=0.002,
+              start_barrier=None, registered_evt=None):
+    with s.job(jid, priority) as h:
+        if registered_evt is not None:
+            registered_evt.set()
+        if start_barrier is not None:
+            start_barrier.wait()
+        for i in range(n_chunks):
+            with s.chunk(h):
+                grants.append((jid, i, time.monotonic()))
+                time.sleep(chunk_s)
+
+
+def test_round_robin_fairness_no_starvation():
+    """3 equal-priority jobs: once all are in the run queue, grants rotate
+    — between two consecutive chunks of any job, every other waiting job
+    gets exactly one grant (strict LRU round-robin), so none can starve.
+    dispatch_width=1 pins strict alternation (deterministic order)."""
+    s = ChunkScheduler(dispatch_width=1)
+    grants: list = []
+    barrier = threading.Barrier(3)
+    ths = [
+        threading.Thread(
+            target=_fake_job, args=(s, f"c{k}", 8, grants),
+            kwargs={"start_barrier": barrier},
+        )
+        for k in range(3)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    ids = [j for j, _, _ in grants]
+    assert sorted(ids.count(f"c{k}") for k in range(3)) == [8, 8, 8]
+    # steady state (all three registered by the barrier): any window of 3
+    # consecutive grants contains 3 DISTINCT jobs — no job is ever granted
+    # twice while another waits
+    for w in range(len(ids) - 2):
+        window = ids[w:w + 3]
+        assert len(set(window)) == 3, (w, ids)
+
+
+def test_priority_preemption_within_one_chunk_boundary():
+    """An urgent job registered mid-run dispatches its first chunk after
+    at most ONE more chunk of the running job — the chunk boundary is the
+    preemption point (ISSUE 8 acceptance)."""
+    s = ChunkScheduler(dispatch_width=1)
+    grants: list = []
+    urgent_registered = threading.Event()
+    go_urgent = threading.Event()
+
+    def low():
+        with s.job("dryrun", 0) as h:
+            for i in range(40):
+                with s.chunk(h):
+                    grants.append(("dryrun", i, time.monotonic()))
+                    time.sleep(0.003)
+                if i == 4:
+                    go_urgent.set()
+                    # give the urgent thread a moment to enter the queue;
+                    # the assertion below tolerates one in-flight chunk
+                    urgent_registered.wait(timeout=5)
+
+    def high():
+        go_urgent.wait(timeout=5)
+        _fake_job(s, "fix-offline", 5, grants, priority=10,
+                  registered_evt=urgent_registered)
+
+    t1 = threading.Thread(target=low)
+    t2 = threading.Thread(target=high)
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    ids = [j for j, _, _ in grants]
+    first_urgent = ids.index("fix-offline")
+    # at most one dryrun chunk between the urgent job entering the queue
+    # (>= grant 5) and its first grant
+    assert first_urgent <= 7, ids[:10]
+    # while the urgent job runs, it owns every grant (strict priority)
+    last_urgent = len(ids) - 1 - ids[::-1].index("fix-offline")
+    between = ids[first_urgent:last_urgent + 1]
+    assert between.count("dryrun") <= 1, between
+
+
+def test_admission_cap_bounds_device_residency():
+    """max_concurrent=2: at most two jobs ever hold residency at once;
+    queued jobs still run to completion afterwards."""
+    s = ChunkScheduler(max_concurrent=2, dispatch_width=1)
+    grants: list = []
+    peak = {"n": 0}
+    lock = threading.Lock()
+
+    def job(jid):
+        with s.job(jid, 0) as h:
+            for i in range(4):
+                with s.chunk(h):
+                    with lock:
+                        n = sum(
+                            1 for j in s._jobs if j.resident
+                        )
+                        peak["n"] = max(peak["n"], n)
+                    grants.append(jid)
+                    time.sleep(0.002)
+
+    ths = [threading.Thread(target=job, args=(f"c{k}",)) for k in range(4)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert sorted(grants.count(f"c{k}") for k in range(4)) == [4, 4, 4, 4]
+    assert peak["n"] <= 2, peak
+
+
+def test_unscheduled_thread_is_untouched():
+    """No ambient job ⇒ drive_chunks runs exactly the ungated loop."""
+    from ccx.search.annealer import drive_chunks
+
+    out = drive_chunks(
+        lambda c, off: (c + [off], None), [], total=10, chunk=4
+    )
+    assert out == [0, 4, 8]
+    assert FLEET.current() is None
+
+
+def test_occupancy_and_depth_stats():
+    s = ChunkScheduler()
+    s.reset_stats()
+
+    def job(jid):
+        with s.job(jid, 0) as h:
+            with s.drive(h):
+                for i in range(3):
+                    with s.chunk(h):
+                        time.sleep(0.01)
+
+    ths = [threading.Thread(target=job, args=(f"c{k}",)) for k in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    st = s.stats()
+    assert 0.0 < st["occupancy"] <= 1.0
+    assert st["chunksGranted"] == 6
+    assert st["jobsCompleted"] == 2
+
+
+# ----- per-job observability -------------------------------------------------
+
+
+def test_job_labels_on_heartbeats_histograms_and_spans(tmp_path):
+    """Every flight-recorder record, chunk heartbeat and span histogram a
+    job's thread emits carries job=<cluster-id> — an interleaved trace is
+    attributable (ISSUE 8 satellite)."""
+    import json
+
+    from ccx.common.metrics import MetricsRegistry
+    from ccx.common.tracing import TRACER
+    from ccx.search.annealer import drive_chunks
+
+    rec_path = tmp_path / "rec.jsonl"
+    TRACER.arm(str(rec_path))
+    try:
+        with FLEET.job("analytics-prod", 3) as h:
+            assert FLEET.current() is h
+            with TRACER.span("anneal", kind="phase"):
+                drive_chunks(
+                    lambda c, off: (c, None), None, total=4, chunk=2
+                )
+    finally:
+        TRACER.disarm()
+    records = [
+        json.loads(ln) for ln in rec_path.read_text().splitlines() if ln
+    ]
+    chunk_recs = [r for r in records if r.get("ev") == "chunk"]
+    assert chunk_recs and all(
+        r.get("job") == "analytics-prod" for r in chunk_recs
+    )
+    span_starts = [r for r in records if r.get("ev") == "start"]
+    assert any(
+        (r.get("attrs") or {}).get("job") == "analytics-prod"
+        for r in span_starts
+    )
+
+    # labeled histogram series render as one family with a job label
+    reg = MetricsRegistry(prefix="t")
+    reg.histogram("phase-anneal-seconds", labels={"job": "analytics-prod"}
+                  ).observe(0.5)
+    reg.histogram("phase-anneal-seconds").observe(1.0)
+    text = reg.render_prometheus()
+    assert text.count("# TYPE t_phase_anneal_seconds histogram") == 1
+    assert 't_phase_anneal_seconds_bucket{le="1",job="analytics-prod"}' \
+        in text
+    assert "t_phase_anneal_seconds_sum 1.000000" in text
+    assert 't_phase_anneal_seconds_sum{job="analytics-prod"} 0.500000' \
+        in text
+
+    # label values are wire-controlled strings (cluster ids): ',' '=' '"'
+    # must neither crash the render nor corrupt the exposition
+    reg.histogram(
+        "phase-anneal-seconds", labels={"job": 'kafka,prod="x"'}
+    ).observe(2.0)
+    hostile = reg.render_prometheus()
+    assert 'job="kafka,prod=\\"x\\""' in hostile
+    assert hostile.count("# TYPE t_phase_anneal_seconds histogram") == 1
+
+
+# ----- real-engine semantics -------------------------------------------------
+
+
+def test_single_job_scheduled_optimize_is_bit_exact():
+    """The scheduler only ORDERS chunk dispatches: optimize() under a job
+    handle returns the bit-identical placement of the unscheduled path
+    (1/10-scale-B5-shaped parity rides tier-1 via the same contract at
+    small shape; the budgeted full-shape twin lives in the slow marker
+    below)."""
+    from ccx.optimizer import optimize
+
+    m = random_cluster(SMALL)
+    r1 = optimize(m, GoalConfig(), GOALS, small_opts())
+    r2 = optimize(m, GoalConfig(), GOALS, small_opts(), job=("solo", 7))
+    for field in ("assignment", "leader_slot", "replica_disk"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(r1.model, field)),
+            np.asarray(getattr(r2.model, field)),
+            err_msg=field,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(r1.stack_after.costs), np.asarray(r2.stack_after.costs)
+    )
+    assert r2.span_tree["attrs"]["job"] == "solo"
+
+
+@pytest.mark.slow
+def test_single_job_parity_downscaled_b5():
+    """Full-shape twin of the bit-exactness contract at 1/10-scale B5
+    (100 brokers / 10k partitions — the B5S iteration shape): the
+    scheduled path must be bit-exact at the headline program shapes too."""
+    from ccx.goals.stack import DEFAULT_GOAL_ORDER
+    from ccx.optimizer import OptimizeOptions, optimize
+    from ccx.search.annealer import AnnealOptions
+    from ccx.search.greedy import GreedyOptions
+
+    b5s = RandomClusterSpec(
+        n_brokers=100, n_racks=10, n_topics=50, n_partitions=10_000,
+        skew=0.3, seed=5,
+    )
+    m = random_cluster(b5s)
+    opts = OptimizeOptions(
+        anneal=AnnealOptions(
+            n_chains=8, n_steps=250, moves_per_step=8, seed=42,
+            chunk_steps=125,
+        ),
+        polish=GreedyOptions(
+            n_candidates=128, max_iters=60, patience=8, chunk_iters=30
+        ),
+        run_cold_greedy=False,
+        topic_rebalance_rounds=0,
+        swap_polish_iters=30,
+        swap_polish_post_iters=0,
+    )
+    r1 = optimize(m, GoalConfig(), DEFAULT_GOAL_ORDER, opts)
+    r2 = optimize(m, GoalConfig(), DEFAULT_GOAL_ORDER, opts,
+                  job=("b5s-parity", 1))
+    for field in ("assignment", "leader_slot"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(r1.model, field)),
+            np.asarray(getattr(r2.model, field)),
+            err_msg=field,
+        )
+
+
+def test_early_exit_job_does_not_perturb_other_carries():
+    """Two concurrent scheduled jobs, one of which early-exits (tiny
+    patience), must each produce the bit-identical result of their solo
+    runs — interleaving never leaks state between jobs' donated carries."""
+    from ccx.search.greedy import GreedyOptions, greedy_optimize
+
+    m1 = random_cluster(SMALL)
+    m2 = random_cluster(
+        RandomClusterSpec(
+            n_brokers=12, n_racks=3, n_topics=4, n_partitions=220, seed=23
+        )
+    )
+    cfg = GoalConfig()
+    # quick job early-exits (patience 1); long job keeps descending
+    o_quick = GreedyOptions(
+        n_candidates=48, max_iters=40, patience=1, chunk_iters=4
+    )
+    o_long = GreedyOptions(
+        n_candidates=48, max_iters=40, patience=12, chunk_iters=4
+    )
+    solo1 = greedy_optimize(m1, cfg, GOALS, o_quick)
+    solo2 = greedy_optimize(m2, cfg, GOALS, o_long)
+
+    out: dict = {}
+
+    def run(jid, m, opts, key):
+        with FLEET.job(jid, 0):
+            out[key] = greedy_optimize(m, cfg, GOALS, opts)
+
+    t1 = threading.Thread(target=run, args=("quick", m1, o_quick, "q"))
+    t2 = threading.Thread(target=run, args=("long", m2, o_long, "l"))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    for solo, conc in ((solo1, out["q"]), (solo2, out["l"])):
+        np.testing.assert_array_equal(
+            np.asarray(solo.model.assignment),
+            np.asarray(conc.model.assignment),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(solo.model.leader_slot),
+            np.asarray(conc.model.leader_slot),
+        )
+        assert solo.n_moves == conc.n_moves
+
+
+def test_sixteen_shape_bucketed_jobs_zero_fresh_compiles():
+    """The shape-sharing tripwire (ISSUE 8 acceptance): 16 concurrent
+    jobs on DIFFERENT same-sized clusters — after one warm run per shape
+    bucket, the whole fleet executes with ZERO fresh XLA compiles (the
+    (padded P, padded B, bucketed max-partitions-per-topic) key makes
+    same-bucket snapshots share every compiled program)."""
+    from ccx.optimizer import optimize
+    from ccx.search.state import max_partitions_per_topic
+
+    import dataclasses
+
+    models = [
+        random_cluster(dataclasses.replace(SMALL, seed=100 + i))
+        for i in range(16)
+    ]
+    buckets: dict = {}
+    for m in models:
+        key = (int(m.P), int(m.B), max_partitions_per_topic(m))
+        buckets.setdefault(key, []).append(m)
+    cfg = GoalConfig()
+    # one warm run per bucket pays every compile (the prewarm ledger)
+    for members in buckets.values():
+        optimize(members[0], cfg, GOALS, small_opts())
+
+    before = compilestats.snapshot()
+    errs: list = []
+
+    def run(i, m):
+        try:
+            with FLEET.job(f"fleet-{i}", 0):
+                optimize(m, cfg, GOALS, small_opts())
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ths = [
+        threading.Thread(target=run, args=(i, m))
+        for i, m in enumerate(models)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert not errs, errs
+    warm = compilestats.delta(before, compilestats.snapshot())
+    assert warm["backend_compiles"] == 0, (
+        f"16 shape-bucketed concurrent jobs paid "
+        f"{warm['backend_compiles']} fresh compiles — a per-snapshot "
+        f"static leaked into a jit key: {warm}"
+    )

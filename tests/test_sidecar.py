@@ -171,6 +171,75 @@ def test_grpc_error_surfaces(model):
         server.stop(0)
 
 
+def test_snapshot_registry_device_cache_and_lru_eviction():
+    """Fleet snapshot registry (ISSUE 8): repeat Proposes for a cluster
+    hit the cached device model (zero rebuilds), N clusters stay resident
+    under the HBM budget, and over-budget residents are evicted LRU —
+    eviction only drops the device copy (the arrays stay; the next call
+    rebuilds instead of failing)."""
+    from ccx.model.snapshot import model_to_arrays
+    from ccx.sidecar.server import SnapshotRegistry, model_device_bytes
+
+    models = {
+        f"c{i}": random_cluster(RandomClusterSpec(
+            n_brokers=6, n_racks=3, n_topics=3, n_partitions=32,
+            seed=40 + i,
+        ))
+        for i in range(3)
+    }
+    reg = SnapshotRegistry()
+    for sid, m in models.items():
+        reg.put(sid, 1, model_to_arrays(m))
+    m0 = reg.model("c0")
+    size = model_device_bytes(m0)
+    # budget fits exactly two resident models
+    reg = SnapshotRegistry(hbm_budget_bytes=int(size * 2.5))
+    for sid, m in models.items():
+        reg.put(sid, 1, model_to_arrays(m))
+    assert reg.model("c0") is reg.model("c0")  # cache hit, same object
+    assert reg.stats()["hits"] == 1
+    reg.model("c1")
+    reg.model("c2")  # admits c2, evicts the LRU (c0)
+    st = reg.stats()
+    assert st["deviceResident"] == 2 and st["evictions"] == 1
+    # evicted cluster still serves: host arrays survived, model rebuilds
+    m0b = reg.model("c0")
+    assert m0b is not m0
+    import numpy as np
+
+    np.testing.assert_array_equal(
+        np.asarray(m0.assignment), np.asarray(m0b.assignment)
+    )
+    # a put invalidates the stale device model for that cluster
+    reg.put("c1", 2, model_to_arrays(models["c1"]))
+    assert reg.stats()["deviceResident"] <= 2
+
+
+def test_propose_reuses_registry_model_across_calls():
+    """Two session Proposes for one cluster build the device model ONCE
+    (the registry's miss/hit counters pin the reuse)."""
+    import msgpack
+
+    from ccx.model.snapshot import to_msgpack as pack
+
+    sidecar = OptimizerSidecar()
+    m = small_deterministic()
+    sidecar.put_snapshot(msgpack.packb({
+        "session": "fleet-reuse", "generation": 1, "packed": pack(m),
+    }))
+    req = msgpack.packb({
+        "session": "fleet-reuse", "cluster_id": "fleet-reuse",
+        "goals": ["RackAwareGoal", "ReplicaDistributionGoal",
+                  "LeaderReplicaDistributionGoal"],
+        "options": {"chains": 4, "steps": 50, **LEAN},
+    })
+    assert [u for u in sidecar.propose(req) if "result" in u]
+    assert sidecar.registry.stats()["misses"] == 1
+    assert [u for u in sidecar.propose(req) if "result" in u]
+    st = sidecar.registry.stats()
+    assert st["misses"] == 1 and st["hits"] >= 1
+
+
 def test_sidecar_columnar_proposals_agree_with_rows():
     """columnar_proposals replaces the per-proposal maps with one
     raw-buffer arrays blob; rows and columns must describe the SAME set of

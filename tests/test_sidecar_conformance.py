@@ -58,14 +58,14 @@ def _maybe_regenerate():
 @pytest.fixture(scope="module")
 def wire_replay():
     """ONE golden replay shared by the response assertions and the warmth
-    tripwire: (requests, put_full, put_delta, frames, compile-stats delta
-    of the cold run)."""
+    tripwire: (requests, put_full, put_delta, put_fleet, frames,
+    compile-stats delta of the cold run)."""
     requests = {name: (FIXDIR / name).read_bytes()
                 for name in gen.REQUEST_NAMES}
     before = compilestats.snapshot()  # registers listeners pre-compile
-    put_full, put_delta, frames = gen.run_wire(requests)
+    put_full, put_delta, put_fleet, frames = gen.run_wire(requests)
     cold = compilestats.delta(before, compilestats.snapshot())
-    return requests, put_full, put_delta, frames, cold
+    return requests, put_full, put_delta, put_fleet, frames, cold
 
 
 def test_fixtures_exist():
@@ -83,9 +83,10 @@ def test_request_bytes_are_reproducible():
 
 
 def test_wire_replay_matches_golden_responses(wire_replay):
-    _, put_full, put_delta, frames, _ = wire_replay
+    _, put_full, put_delta, put_fleet, frames, _ = wire_replay
     assert put_full == (FIXDIR / "put_full_response.bin").read_bytes()
     assert put_delta == (FIXDIR / "put_delta_response.bin").read_bytes()
+    assert put_fleet == (FIXDIR / "put_fleet_response.bin").read_bytes()
     golden = json.loads((FIXDIR / gen.RESULT_NAME).read_text())
     assert gen.canonical_result(frames) == golden
 
@@ -114,7 +115,7 @@ def test_warm_recall_of_target_rung_shapes_compiles_nothing(wire_replay):
         # the regen pass already compiled everything before wire_replay's
         # "cold" run, so the vacuity anchor below would be meaningless
         pytest.skip("regenerating fixtures — warmth anchor not measurable")
-    requests, _, _, _, cold = wire_replay
+    requests, _, _, _, _, cold = wire_replay
     # vacuity anchor (same rationale as the bench contract): the counters
     # key off JAX-internal monitoring event names, so a renamed event would
     # read zero everywhere and silently disarm this tripwire. The cold
@@ -141,7 +142,7 @@ def test_empty_goals_resolve_to_default_stack(wire_replay):
     from ccx.goals.stack import DEFAULT_GOAL_ORDER
 
     requests, *_ = wire_replay
-    sc, _, _ = gen.run_puts(requests)
+    sc, _, _, _ = gen.run_puts(requests)
     _, options = gen.target_rung_goals_and_options()
     frames = list(sc.propose(wire.propose_request(
         goals=(), options=options, session=gen.SESSION)))
@@ -157,6 +158,27 @@ def test_delta_base_mismatch_is_rejected():
     bad["base_generation"] = 99
     with pytest.raises(ValueError, match="base generation"):
         sc.put_snapshot(wire.packb(bad))
+
+
+def test_fleet_envelope_fields_are_additive():
+    """Round-12 fleet fields (cluster_id / priority): present on the
+    fleet fixtures, ABSENT from the legacy four (their bytes must stay
+    stable — pre-fleet peers are untouched), and a fleet put lands in the
+    sidecar's snapshot registry under its own session."""
+    requests = gen.build_requests()
+    fput = msgpack.unpackb(requests["put_full_request_fleet.bin"], raw=False)
+    assert fput["cluster_id"] == gen.FLEET_CLUSTER
+    assert fput["session"] == gen.FLEET_SESSION
+    fprop = msgpack.unpackb(requests["propose_request_fleet.bin"], raw=False)
+    assert fprop["cluster_id"] == gen.FLEET_CLUSTER
+    assert fprop["priority"] == gen.FLEET_PRIORITY
+    for legacy in ("put_full_request.bin", "put_delta_request.bin",
+                   "propose_request.bin"):
+        req = msgpack.unpackb(requests[legacy], raw=False)
+        assert "cluster_id" not in req and "priority" not in req
+    sc = OptimizerSidecar()
+    sc.put_snapshot(requests["put_full_request_fleet.bin"])
+    assert sc.registry.get(gen.FLEET_SESSION) is not None
 
 
 def test_ping_shape():

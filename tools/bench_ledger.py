@@ -305,6 +305,136 @@ def render_multichip(mrows: list[dict], legacy: list[dict]) -> str:
     return "\n".join(out)
 
 
+# ----- fleet (FLEET_r*.json) -------------------------------------------------
+
+
+def load_fleet(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``FLEET_r*.json`` under ``root`` — the
+    ``bench.py --fleet`` artifact: p50/p99 latency of N concurrent Propose
+    streams through the sidecar, aggregate throughput, chunk occupancy and
+    the serialized-baseline speedup, all measured in one round."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "FLEET_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("fleet") \
+                or line.get("value") is None:
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed fleet line (rc={wrapper.get('rc')})",
+            })
+            continue
+        lat = line.get("latency") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "config": line.get("config", "?"),
+            "n_jobs": line.get("n_jobs"),
+            "backend": str(line.get("backend", "?")),
+            "host_cores": line.get("host_cores"),
+            "verified": bool(line.get("verified")),
+            "p50": lat.get("p50_s"),
+            "p99": lat.get("p99_s", line.get("value")),
+            "throughput": line.get("throughput_per_min"),
+            "speedup": line.get("speedup"),
+            "occupancy": line.get("occupancy"),
+            "mean_depth": line.get("mean_depth"),
+            "urgent": (line.get("urgent") or {}).get("wall_s"),
+            "effort": line.get("effort") or {},
+        })
+    return rows, partials
+
+
+def fleet_group_key(row: dict) -> str:
+    """Fleet rows are only comparable at identical (config, n_jobs,
+    backend, host_cores, effort) — latency under concurrency depends on
+    the host's core count as much as on the code."""
+    return json.dumps(
+        [row["config"], row["n_jobs"], row["backend"], row["host_cores"],
+         row["effort"]],
+        sort_keys=True,
+    )
+
+
+def check_fleet(frows: list[dict]) -> list[str]:
+    """The fleet gate: in the LATEST banked fleet round, an unverified
+    line fails (unverified = a job failed verification OR a measured
+    phase paid a fresh compile — the zero-warm-fresh tripwire), and a p99
+    regression >10% vs the best banked comparable round fails."""
+    failures: list[str] = []
+    if not frows:
+        return failures
+    latest_round = max(r["round"] for r in frows)
+    for r in (r for r in frows if r["round"] == latest_round):
+        if not r["verified"]:
+            failures.append(
+                f"fleet round {r['round']} {r['config']}x{r['n_jobs']}: "
+                "UNVERIFIED fleet line banked (job verification failure "
+                "or fresh compiles in a measured phase)"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in frows:
+        groups.setdefault(fleet_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"]
+            and r["p99"] is not None
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(p["p99"] for p in prior)
+        if r["p99"] is not None and best:
+            limit = best * (1 + WALL_REGRESSION)
+            if r["p99"] > limit:
+                failures.append(
+                    f"fleet round {r['round']} {r['config']}x{r['n_jobs']}: "
+                    f"p99 {r['p99']:.1f}s regressed >{WALL_REGRESSION:.0%} "
+                    f"vs best banked round ({best:.1f}s, limit {limit:.1f}s)"
+                )
+    return failures
+
+
+def render_fleet(frows: list[dict], partials: list[dict]) -> str:
+    """The fleet section of the trend table."""
+    if not frows and not partials:
+        return ""
+    out = ["", "fleet serving (FLEET_r*.json):"]
+    headers = ["round", "config", "jobs", "backend", "p50 s", "p99 s",
+               "thpt/min", "speedup", "occup", "depth", "urgent s", "ok"]
+    body = []
+    for r in sorted(frows, key=lambda r: r["round"]):
+        body.append([
+            _fmt(r["round"], 0), r["config"], _fmt(r["n_jobs"], 0),
+            f"{r['backend']}/{r['host_cores']}c",
+            _fmt(r["p50"], 1), _fmt(r["p99"], 1),
+            _fmt(r["throughput"], 1), _fmt(r["speedup"], 2),
+            _fmt(r["occupancy"], 2), _fmt(r["mean_depth"], 1),
+            _fmt(r["urgent"], 1),
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 # ----- trend table -----------------------------------------------------------
 
 
@@ -532,17 +662,22 @@ def main(argv=None) -> int:
     root = os.path.abspath(args.dir)
     rows, partials = load_rows(root)
     mrows, mlegacy = load_multichip(root)
+    frows, fpartials = load_fleet(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
             "multichip": mrows, "multichipLegacy": mlegacy,
+            "fleet": frows, "fleetPartials": fpartials,
         }, indent=1))
         return 0
     if args.roofline:
         print(render_roofline(rows))
         return 0
     if args.check:
-        failures = check(rows, partials) + check_multichip(mrows)
+        failures = (
+            check(rows, partials) + check_multichip(mrows)
+            + check_fleet(frows)
+        )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
         if failures:
@@ -550,11 +685,13 @@ def main(argv=None) -> int:
         n = len([r for r in rows if r["round"] is not None])
         print(f"bench ledger green: {n} banked line(s), "
               f"{len(partials)} partial round(s), {len(mrows)} scaling "
-              f"curve(s), no regression vs the best banked rounds")
+              f"curve(s), {len(frows)} fleet line(s), no regression vs "
+              f"the best banked rounds")
         return 0
     out = render_table(rows, partials)
     mc = render_multichip(mrows, mlegacy)
-    print(out + (("\n" + mc) if mc else ""))
+    fl = render_fleet(frows, fpartials)
+    print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else ""))
     return 0
 
 

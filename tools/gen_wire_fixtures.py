@@ -49,10 +49,21 @@ _BENCH_KNOBS = ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
 #: machine-dependent when present)
 VOLATILE = ("wallSeconds", "phaseSeconds", "spanTree", "costModel", "mesh")
 
+#: the round-12 fleet envelopes (cluster_id / priority — additive fields,
+#: wire version unchanged) get their OWN fixtures; the legacy four stay
+#: byte-identical because the new fields are simply absent from them
 REQUEST_NAMES = ("ping_request.bin", "put_full_request.bin",
-                 "put_delta_request.bin", "propose_request.bin")
-RESPONSE_NAMES = ("put_full_response.bin", "put_delta_response.bin")
+                 "put_delta_request.bin", "propose_request.bin",
+                 "put_full_request_fleet.bin", "propose_request_fleet.bin")
+RESPONSE_NAMES = ("put_full_response.bin", "put_delta_response.bin",
+                  "put_fleet_response.bin")
 RESULT_NAME = "propose_result.json"
+
+#: the fleet fixtures' cluster identity (distinct session so the replay
+#: never perturbs the legacy session's generation chain)
+FLEET_SESSION = "conformance-fleet"
+FLEET_CLUSTER = "analytics-prod"
+FLEET_PRIORITY = 10
 
 
 def _delta_arrays():
@@ -113,25 +124,35 @@ def build_requests() -> dict[str, bytes]:
         "propose_request.bin": wire.propose_request(
             goals=goals, options=options, session=SESSION,
         ),
+        "put_full_request_fleet.bin": wire.put_snapshot_request(
+            session=FLEET_SESSION, generation=1,
+            packed=to_msgpack(small_deterministic()), is_delta=False,
+            cluster_id=FLEET_CLUSTER,
+        ),
+        "propose_request_fleet.bin": wire.propose_request(
+            goals=goals, options=options, session=FLEET_SESSION,
+            cluster_id=FLEET_CLUSTER, priority=FLEET_PRIORITY,
+        ),
     }
 
 
 def run_puts(requests: dict[str, bytes], sidecar=None):
-    """Replay the PutSnapshot pair in protocol order; returns the sidecar
-    (holding the session) plus both response byte strings."""
+    """Replay the PutSnapshot trio in protocol order; returns the sidecar
+    (holding the sessions) plus the response byte strings."""
     from ccx.sidecar.server import OptimizerSidecar
 
     sc = sidecar or OptimizerSidecar()
     put_full = sc.put_snapshot(requests["put_full_request.bin"])
     put_delta = sc.put_snapshot(requests["put_delta_request.bin"])
-    return sc, put_full, put_delta
+    put_fleet = sc.put_snapshot(requests["put_full_request_fleet.bin"])
+    return sc, put_full, put_delta, put_fleet
 
 
 def run_wire(requests: dict[str, bytes]):
     """Full protocol replay: puts then the Propose stream frames."""
-    sc, put_full, put_delta = run_puts(requests)
+    sc, put_full, put_delta, put_fleet = run_puts(requests)
     frames = list(sc.propose(requests["propose_request.bin"]))
-    return put_full, put_delta, frames
+    return put_full, put_delta, put_fleet, frames
 
 
 def canonical_result(frames) -> dict:
@@ -151,11 +172,12 @@ def result_json(frames) -> str:
 def write(fixdir: pathlib.Path = FIXDIR) -> None:
     fixdir.mkdir(parents=True, exist_ok=True)
     requests = build_requests()
-    put_full, put_delta, frames = run_wire(requests)
+    put_full, put_delta, put_fleet, frames = run_wire(requests)
     for name, buf in requests.items():
         (fixdir / name).write_bytes(buf)
     (fixdir / "put_full_response.bin").write_bytes(put_full)
     (fixdir / "put_delta_response.bin").write_bytes(put_delta)
+    (fixdir / "put_fleet_response.bin").write_bytes(put_fleet)
     (fixdir / RESULT_NAME).write_text(result_json(frames))
 
 
@@ -171,13 +193,14 @@ def check(fixdir: pathlib.Path = FIXDIR, full: bool = False) -> list[str]:
         elif path.read_bytes() != buf:
             problems.append(f"{name}: regenerated bytes differ")
     if full:
-        put_full, put_delta, frames = run_wire(requests)
+        put_full, put_delta, put_fleet, frames = run_wire(requests)
         result = result_json(frames)
     else:
-        _, put_full, put_delta = run_puts(requests)
+        _, put_full, put_delta, put_fleet = run_puts(requests)
         result = None
     for name, buf in (("put_full_response.bin", put_full),
-                      ("put_delta_response.bin", put_delta)):
+                      ("put_delta_response.bin", put_delta),
+                      ("put_fleet_response.bin", put_fleet)):
         if (fixdir / name).read_bytes() != buf:
             problems.append(f"{name}: replayed response differs")
     if result is not None and (fixdir / RESULT_NAME).read_text() != result:

@@ -121,6 +121,16 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # it never competes for the TPU window; recorder + watchdog stay armed.
   CCX_BENCH_SCALING=1 timeout -k 60 3600 python bench.py
   echo "scaling rc=$?"
+  echo "--- fleet serving rung (16 concurrent B3 Propose streams; FLEET artifact) ---"
+  # continuous batching of concurrent Propose jobs through the multi-job
+  # chunk scheduler + the sidecar gRPC path (ISSUE 8): p50/p99 latency,
+  # aggregate throughput and chunk occupancy vs the serialized baseline,
+  # measured in one round — the JSON line is the FLEET_r*.json artifact
+  # the bench ledger trends and gates. On a real TPU the host phases of
+  # one job overlap the device chunks of another, which is where the
+  # serialized-vs-concurrent gap opens far past the CPU host's core count.
+  CCX_BENCH_FLEET=1 timeout -k 60 2400 python bench.py
+  echo "fleet rc=$?"
   echo "--- remaining BASELINE configs on hardware (B1-B4, lean effort) ---"
   # pin all four effort knobs to the lean values: bench collapses to ONE
   # honestly-labeled "custom" rung per config instead of climbing
