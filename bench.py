@@ -465,6 +465,7 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
             "span_tree": res.span_tree,
             "cost_model": res.cost_model,
             "mesh": res.mesh,
+            "convergence": res.convergence,
             "before": res.stack_before.by_name(),
             "after": res.stack_after.by_name(),
         }
@@ -499,6 +500,7 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
                 "span_tree": res.get("spanTree"),
                 "cost_model": res.get("costModel"),
                 "mesh": res.get("mesh"),
+                "convergence": res.get("convergence"),
                 "before": before,
                 "after": after,
             }
@@ -609,6 +611,7 @@ def run_config(name: str, rung: str, samples: int = 1) -> dict:
         "span_tree": r.get("span_tree"),
         "cost_model": r.get("cost_model"),
         "mesh": r.get("mesh"),
+        "convergence": r.get("convergence"),
         **(
             {
                 "samples": {
@@ -1574,6 +1577,17 @@ def main() -> None:
                 # mesh-sharded rung (CCX_BENCH_SHARDED): mesh shape + live
                 # sharded-program cache stats — VOLATILE like spanTree
                 **({"mesh": r["mesh"]} if r.get("mesh") else {}),
+                # convergence-telemetry block (ccx.search.telemetry):
+                # per-chunk per-goal lex series for every chunk-driven
+                # phase of the warm run — the budget advisor
+                # (tools/convergence_report.py) and the ledger's plateau
+                # columns read it off the BENCH line; VOLATILE like
+                # spanTree
+                **(
+                    {"convergence": r["convergence"]}
+                    if r.get("convergence")
+                    else {}
+                ),
                 # cache hit-ness per run: a warm run with ANY fresh
                 # backend compile is a cache regression
                 # (tests/test_bench_contract.py pins warm == 0)

@@ -1,0 +1,204 @@
+"""Host-side convergence analysis — plateau detection over per-chunk lex
+cost series (ISSUE 9).
+
+The device half lives in ``ccx.search.telemetry`` (the ring-buffer taps the
+chunk engines carry); this module is the pure-python half every consumer
+shares: the optimizer's plateau gauges, ``tools/convergence_report.py``
+(the budget advisor), ``tools/bench_ledger.py`` (trend columns + the
+past-plateau warning) and the flight-recorder ``summarize()`` join.
+
+Deliberately dependency-light — stdlib only, no jax/numpy — so the ledger
+and a dying TPU window's diagnosis tooling can import it instantly (the
+same contract ``ccx.sidecar.wire`` keeps for remote clients).
+
+Vocabulary (shared by every table and gauge built on top):
+
+* a **series** is a list of per-chunk lex cost vectors (priority order —
+  ``OptimizerResult.convergence`` segments carry one per engine run);
+* the **plateau chunk** of a series is the index of the LAST chunk whose
+  vector lexicographically improved on the best seen so far (beyond the
+  engines' own significance tolerance, ``ccx.search.annealer.goal_tols``:
+  ``atol + rtol * |best|``) — every later chunk was budget spent past
+  convergence;
+* the **wasted fraction** is (chunks after the plateau) / (chunks after
+  the first) — the share of the run's chunk budget that no longer moved
+  the lex vector. A chunk records the state at its END, so chunk 0 can
+  never be "wasted": it bought the first measurement.
+"""
+
+from __future__ import annotations
+
+#: significance tolerance for IMPROVEMENT, mirroring the engines'
+#: ``goal_tols`` (a change smaller than this never flipped an acceptance
+#: either)
+RTOL = 1e-6
+ATOL = 1e-6
+#: coarser tolerance for REGRESSION: the descent engines accept
+#: sub-tolerance upward drift in a high tier while a lower tier improves
+#: (the batch-composition rule filters per move, and f32 accumulation
+#: compounds over a 50-iteration chunk — measured at B5 lean:
+#: PotentialNwOut +0.0003 on 250.21, +1.2e-6 relative, while NwOut fell
+#: 98 → 65 in the same chunk). A symmetric tolerance would read that
+#: chunk as "stopped improving" and the advisor would propose cutting a
+#: budget that was buying real quality, so an upward change only blocks
+#: improvement when it is significant at this coarser scale; anything
+#: smaller reads as "equal" and the walk continues to lower tiers.
+UP_RTOL = 1e-4
+UP_ATOL = 1e-3
+
+#: advisory past-plateau threshold shared by the budget advisor
+#: (tools/convergence_report.py) and the ledger's warning
+#: (tools/bench_ledger.py): a rung spending more than this share of its
+#: chunks past plateau is flagged (WARN, never fail — shrinking a budget
+#: is a retune decision for the advisor, not a gate)
+WASTE_WARN = 0.30
+
+
+def lex_improved(vec, best, rtol: float = RTOL, atol: float = ATOL,
+                 up_rtol: float = UP_RTOL, up_atol: float = UP_ATOL) -> bool:
+    """True when ``vec`` is lexicographically significantly below ``best``:
+    walking tiers in priority order, the first decisively-changed goal
+    moved down (asymmetric tolerances — see UP_RTOL above)."""
+    for v, b in zip(vec, best):
+        if v < b - (atol + rtol * abs(b)):
+            return True
+        if v > b + (up_atol + up_rtol * abs(b)):
+            return False
+    return False
+
+
+def plateau_chunk(series) -> int:
+    """Index of the last chunk whose lex vector improved on the running
+    best (0 for an empty/single-chunk/never-improving series).
+
+    Scalar series (plain energies, e.g. the flight recorder's tier-0
+    heartbeat energies) are accepted too — each value is treated as a
+    one-goal vector."""
+    last = 0
+    best = None
+    for i, vec in enumerate(series):
+        row = vec if isinstance(vec, (list, tuple)) else (vec,)
+        if best is None:
+            best = list(row)
+            continue
+        if lex_improved(row, best):
+            best = list(row)
+            last = i
+    return last
+
+
+def wasted_fraction(series) -> float:
+    """Share of the series' chunks spent past the plateau (0.0..1.0)."""
+    n = len(series)
+    if n <= 1:
+        return 0.0
+    return (n - 1 - plateau_chunk(series)) / (n - 1)
+
+
+def segment_stats(seg: dict) -> dict | None:
+    """Plateau stats for ONE telemetry segment (the dict
+    ``ccx.search.telemetry.decode`` emits: ``series`` + optional
+    ``chunk``/``budget``/``truncated``). None when the segment carries no
+    usable series."""
+    series = seg.get("series") or []
+    if not series:
+        return None
+    plateau = plateau_chunk(series)
+    n = len(series)
+    out = {
+        "chunks": n,
+        "plateauChunk": plateau,
+        "wastedFraction": round(wasted_fraction(series), 4),
+        "truncated": bool(seg.get("truncated")),
+    }
+    chunk = seg.get("chunk")
+    budget = seg.get("budget")
+    if chunk:
+        out["chunkSize"] = int(chunk)
+        # budget units (SA steps / descent iterations) covered through the
+        # plateau chunk's END — the floor any retune must keep
+        out["plateauBudget"] = int((plateau + 1) * chunk)
+    if budget is not None:
+        out["budget"] = int(budget)
+    return out
+
+
+def propose_budget(seg: dict, margin: float = 1.25) -> int | None:
+    """Retuned per-phase budget proposal: the budget units spent through
+    the plateau chunk, plus a safety margin, capped at the configured
+    budget (never propose MORE than was configured) and floored at one
+    chunk. None when the segment lacks chunk sizing.
+
+    A truncated segment (more chunks ran than the ring buffer holds) only
+    proves the plateau is AT OR AFTER the last retained early row — the
+    proposal is then the configured budget itself (no evidence to shrink
+    on)."""
+    st = segment_stats(seg)
+    if st is None or "chunkSize" not in st:
+        return None
+    budget = st.get("budget")
+    if st["truncated"]:
+        return budget
+    proposed = int(st["plateauBudget"] * margin)
+    chunk = st["chunkSize"]
+    proposed = max(proposed, chunk)
+    if budget is not None:
+        proposed = min(proposed, budget)
+    return proposed
+
+
+def phase_table(convergence: dict) -> list[dict]:
+    """Flatten an ``OptimizerResult.convergence`` block into per-phase
+    advisor rows (last segment per phase — the converged run; earlier
+    segments of a multi-run phase, e.g. repair-round re-polishes, are
+    summed into the wasted totals but not re-proposed)."""
+    rows: list[dict] = []
+    for phase, segs in (convergence.get("phases") or {}).items():
+        segs = [s for s in segs if s.get("series")]
+        if not segs:
+            continue
+        last = segs[-1]
+        st = segment_stats(last) or {}
+        total_chunks = sum(len(s["series"]) for s in segs)
+        # truncated segments carry a GAPPY ring (opening rows + the
+        # latest chunk): the retained rows say nothing about where the
+        # missing middle plateaued, so — like propose_budget — they
+        # contribute no waste evidence
+        full = [s for s in segs if not s.get("truncated")]
+        past = sum(
+            max(len(s["series"]) - 1 - plateau_chunk(s["series"]), 0)
+            for s in full
+        )
+        steppable = sum(max(len(s["series"]) - 1, 0) for s in full)
+        rows.append({
+            "phase": phase,
+            "segments": len(segs),
+            "chunks": total_chunks,
+            "plateauChunk": st.get("plateauChunk"),
+            "wastedFraction": (
+                round(past / steppable, 4) if steppable else 0.0
+            ),
+            "chunkSize": st.get("chunkSize"),
+            "budget": st.get("budget"),
+            "proposedBudget": propose_budget(last),
+            "truncated": st.get("truncated", False),
+        })
+    rows.sort(key=lambda r: -(r["wastedFraction"] or 0.0))
+    return rows
+
+
+def total_wasted_fraction(convergence: dict) -> float:
+    """Whole-run share of chunk budget past plateau, across every phase
+    and segment — the single number the ledger's >WASTE_WARN warning
+    gates. Truncated segments are skipped (the ring kept only the opening
+    rows + the latest chunk — no evidence of where the middle plateaued),
+    matching ``propose_budget``'s never-shrink-on-truncation rule."""
+    past = steppable = 0
+    for segs in (convergence.get("phases") or {}).values():
+        for s in segs:
+            series = s.get("series") or []
+            if len(series) <= 1 or s.get("truncated"):
+                continue
+            steppable += len(series) - 1
+            past += len(series) - 1 - plateau_chunk(series)
+    return past / steppable if steppable else 0.0

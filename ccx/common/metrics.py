@@ -68,6 +68,22 @@ class Counter:
             self.value += n
 
 
+class Gauge:
+    """A SETTABLE gauge (vs the callable-backed gauges ``gauge()``
+    registers): holds the last value written. Used for push-style live
+    state — e.g. the convergence taps' per-job energy/plateau gauges,
+    where the producer (a chunk heartbeat) knows the value and no
+    callable could recompute it."""
+
+    def __init__(self) -> None:
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
 class Histogram:
     """Prometheus-style cumulative histogram. The default buckets span
     5 ms .. 10 min — sized for optimizer phases and sidecar RPCs, where
@@ -127,9 +143,23 @@ class MetricsRegistry:
         self._timers: dict[str, Timer] = {}
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, object] = {}  # name -> callable() -> float
+        #: settable gauges, composite-keyed like histograms when labeled
+        #: ('name|[["k","v"],...]'); one family may NOT also be a
+        #: callable gauge (duplicate TYPE) — naming keeps them apart
+        self._gauge_values: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
         self._help: dict[str, str] = {}
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _label_key(name: str, labels: dict | None) -> str:
+        import json as _json
+
+        if not labels:
+            return name
+        return name + "|" + _json.dumps(
+            sorted((str(k), str(v)) for k, v in labels.items())
+        )
 
     def _set_help(self, name: str, help: str | None) -> None:
         if help and name not in self._help:
@@ -160,16 +190,24 @@ class MetricsRegistry:
         bucket lines. Label VALUES are arbitrary strings (cluster ids come
         off the wire) — the composite key holds them JSON-encoded so
         ``,``/``=``/``"`` can neither corrupt the key nor the exposition."""
-        import json as _json
-
-        key = name
-        if labels:
-            key = name + "|" + _json.dumps(
-                sorted((str(k), str(v)) for k, v in labels.items())
-            )
+        key = self._label_key(name, labels)
         with self._lock:
             self._set_help(name, help)
             return self._histograms.setdefault(key, Histogram(buckets))
+
+    def set_gauge(self, name: str, value: float,
+                  labels: dict[str, str] | None = None,
+                  help: str | None = None) -> Gauge:
+        """Write a settable gauge series (same label contract as
+        ``histogram``): one ``# TYPE gauge`` family, one sample line per
+        label set. Used by the convergence taps for the live per-job
+        energy / per-phase plateau-step gauges (ISSUE 9)."""
+        key = self._label_key(name, labels)
+        with self._lock:
+            self._set_help(name, help)
+            g = self._gauge_values.setdefault(key, Gauge())
+        g.set(value)
+        return g
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (0.0.4) of everything registered:
@@ -187,6 +225,7 @@ class MetricsRegistry:
             timers = dict(self._timers)
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            gauge_values = dict(self._gauge_values)
             histograms = dict(self._histograms)
             helps = dict(self._help)
 
@@ -216,11 +255,11 @@ class MetricsRegistry:
             n = f"{self.prefix}_{sanitize(name)}"
             head(name, n, "gauge", f"{name} gauge")
             out.append(f"{n} {v}")
-        # histograms: labeled series ('name|[["k","v"],...]' — JSON-packed
-        # label pairs) share one family — HELP/TYPE emitted once per
-        # family, labels merged with le on the bucket lines (the strict
-        # exposition parser forbids duplicate TYPE declarations). Label
-        # values escape \ " and newline per the exposition format.
+        # labeled series ('name|[["k","v"],...]' — JSON-packed label
+        # pairs) share one family — HELP/TYPE emitted once per family
+        # (the strict exposition parser forbids duplicate TYPE
+        # declarations). Label values escape \ " and newline per the
+        # exposition format.
         import json as _json
 
         def esc_label(v: str) -> str:
@@ -228,6 +267,30 @@ class MetricsRegistry:
                 v.replace("\\", "\\\\").replace('"', '\\"')
                 .replace("\n", "\\n")
             )
+
+        def label_str(labelstr: str) -> str:
+            if not labelstr:
+                return ""
+            inner = ",".join(
+                f'{sanitize(k)}="{esc_label(v)}"'
+                for k, v in _json.loads(labelstr)
+            )
+            return "{" + inner + "}"
+
+        # settable gauges (push-style — convergence energy/plateau): one
+        # gauge family per name, one sample per label set, grouped so
+        # every sample follows its family's TYPE line
+        declared_g: set[str] = set()
+        for key, g in sorted(
+            gauge_values.items(),
+            key=lambda kv: (kv[0].partition("|")[0], kv[0]),
+        ):
+            name, _, labelstr = key.partition("|")
+            n = f"{self.prefix}_{sanitize(name)}"
+            if n not in declared_g:
+                declared_g.add(n)
+                head(name, n, "gauge", f"{name} gauge")
+            out.append(f"{n}{label_str(labelstr)} {g.value}")
 
         declared: set[str] = set()
         for key, h in sorted(
@@ -241,11 +304,8 @@ class MetricsRegistry:
                 head(name, n, "histogram", f"{name} histogram")
             extra = ""
             if labelstr:
-                extra = "".join(
-                    f',{sanitize(k)}="{esc_label(v)}"'
-                    for k, v in _json.loads(labelstr)
-                )
-                series = "{" + extra[1:] + "}"
+                series = label_str(labelstr)
+                extra = "," + series[1:-1]
             else:
                 series = ""
             for le, cum in snap["buckets"].items():
@@ -262,6 +322,9 @@ class MetricsRegistry:
                     for k, t in self._timers.items()
                 },
                 "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {
+                    k: g.value for k, g in self._gauge_values.items()
+                },
                 "histograms": {
                     k: {"count": h.count, "sumSec": round(h.sum, 4)}
                     for k, h in self._histograms.items()

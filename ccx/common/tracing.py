@@ -53,6 +53,7 @@ attribute writes and a timestamp.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -220,6 +221,11 @@ class Tracer:
         #: live record taps (sidecar Propose streams heartbeats to the JVM
         #: through one) — called with each record dict, never raising
         self._listeners: list = []
+        #: per-job convergence timeline (ISSUE 9): the last N heartbeat
+        #: energies per job label ("" = no fleet job), LRU-bounded so a
+        #: long fleet run cannot grow it without bound. Feeds the
+        #: /observability per-job section and the VIEWER-safe summary.
+        self._energy: collections.OrderedDict = collections.OrderedDict()
 
     # ----- configuration ----------------------------------------------------
 
@@ -421,15 +427,29 @@ class Tracer:
             self.end(s)
 
     def heartbeat(self, chunk: int, offset: int | None = None,
-                  total: int | None = None) -> None:
+                  total: int | None = None,
+                  energy: float | None = None) -> None:
         """One record per host↔device chunk sync point (``annealer.
-        drive_chunks``). Unarmed cost: two attr writes + a timestamp."""
+        drive_chunks``). Unarmed cost: two attr writes + a timestamp.
+
+        ``energy`` (ISSUE 9 — the convergence taps' tier-0 lex cost,
+        possibly one chunk stale on sync-free SA drives) joins the span
+        attrs, the recorder line, the per-job convergence timeline and
+        the live ``convergence-energy`` Prometheus gauge — a wedged
+        window's last JSONL line then names phase + chunk + QUALITY, not
+        just depth."""
         st = getattr(self._tl, "stack", None)
         span = st[-1] if st else None
         if span is not None:
             span.attrs["chunk"] = int(chunk)
             if total is not None:
                 span.attrs["chunkTotal"] = int(total)
+            if energy is not None:
+                span.attrs["energy"] = round(float(energy), 4)
+        if energy is not None:
+            self._note_energy(
+                energy, chunk, span.path if span is not None else None
+            )
         if self._fd is None and not self._listeners:
             now = time.monotonic()
             tid = threading.get_ident()
@@ -444,10 +464,62 @@ class Tracer:
             rec["offset"] = int(offset)
         if total is not None:
             rec["total"] = int(total)
+        if energy is not None:
+            rec["energy"] = round(float(energy), 4)
         snap = _compile_snapshot()
         if snap is not None:
             rec["compile"] = snap
         self._record(rec)
+
+    # ----- convergence timeline (ISSUE 9) -----------------------------------
+
+    #: heartbeat energies retained per job / jobs retained (LRU)
+    ENERGY_WINDOW = 64
+    ENERGY_JOBS = 32
+
+    def _note_energy(self, energy: float, chunk: int,
+                     span: str | None) -> None:
+        job = self.job() or ""
+        entry = {"chunk": int(chunk), "energy": round(float(energy), 4)}
+        if span is not None:
+            entry["span"] = span
+        with self._lock:
+            dq = self._energy.get(job)
+            if dq is None:
+                dq = self._energy[job] = collections.deque(
+                    maxlen=self.ENERGY_WINDOW
+                )
+            dq.append(entry)
+            self._energy.move_to_end(job)
+            while len(self._energy) > self.ENERGY_JOBS:
+                self._energy.popitem(last=False)
+        try:
+            from ccx.common.metrics import REGISTRY
+
+            REGISTRY.set_gauge(
+                "convergence-energy", float(energy),
+                labels={"job": job} if job else None,
+                help="tier-0 lex energy at the last chunk heartbeat "
+                     "(convergence taps, per fleet job)",
+            )
+        except Exception:  # noqa: BLE001 — enrichment must never raise
+            pass
+
+    def convergence_timeline(self) -> dict:
+        """Per-job heartbeat-energy series (last ENERGY_WINDOW chunks per
+        job) — the /observability convergence section."""
+        with self._lock:
+            return {job: list(dq) for job, dq in self._energy.items()}
+
+    def convergence_summary(self) -> dict:
+        """VIEWER-safe compact form: last energy + chunk per job, no
+        series, no span stacks."""
+        with self._lock:
+            return {
+                job: {**dq[-1], "beats": len(dq)}
+                for job, dq in self._energy.items()
+                if dq
+            }
 
     # ----- recorder ---------------------------------------------------------
 
@@ -642,6 +714,9 @@ class Tracer:
             "watchdogDumps": self._watchdog_dumps,
             "traceSync": self.sync,
             "lastSpanTree": self.last_tree(),
+            # last heartbeat energy per job (compact, stack-free — the
+            # full per-job timeline is USER-gated on /observability)
+            "convergence": self.convergence_summary(),
         }
 
     def observability_json(self, threads: bool = False) -> dict:
@@ -658,6 +733,10 @@ class Tracer:
                 str(k): v for k, v in self._active().items()
             },
             "lastSpanTree": self.last_tree(),
+            # per-job convergence timeline (ISSUE 9): the last N chunk
+            # heartbeat energies per active job — live quality trajectory
+            # of every in-flight proposal, readable DURING a wedge
+            "convergence": self.convergence_timeline(),
         }
         snap = _compile_snapshot()
         if snap is not None:
@@ -690,7 +769,10 @@ TRACER = Tracer()
 def summarize(path: str) -> dict:
     """Parse a flight-recorder JSONL into a dead-window diagnosis: last
     record (phase/chunk/compile at death), open spans never closed,
-    watchdog dumps. Tolerates a torn final line (truncated write)."""
+    watchdog dumps, and — when the convergence taps streamed heartbeat
+    energies — the last-known energy + plateau chunk per span open at
+    death, so the diagnosis prices QUALITY as well as phase. Tolerates a
+    torn final line (truncated write)."""
     records: list[dict] = []
     torn = 0
     with open(path, encoding="utf-8", errors="replace") as f:
@@ -718,12 +800,18 @@ def summarize(path: str) -> dict:
     #: or cold pass — prices what an open-at-death span was expected to
     #: cost (device seconds + HBM watermark, ccx.common.costmodel)
     last_cost: dict[str, dict] = {}
+    #: span path -> heartbeat-energy series of the CURRENT segment (reset
+    #: on arm, like the open-span ledger): the convergence-tap trace the
+    #: plateau detection below runs on
+    energy_series: dict[str, list] = {}
+    energy_last: dict[str, dict] = {}
     for r in records:
         ev = r.get("ev")
         if ev == "arm":
             if started:
                 segments.append((cur_pid, cur_open))
             cur_pid, cur_open, started = r.get("pid"), {}, True
+            energy_series, energy_last = {}, dict(energy_last)
         elif ev == "start":
             started = True
             cur_open[r.get("span", "?")] = r
@@ -733,6 +821,12 @@ def summarize(path: str) -> dict:
                 last_cost[r.get("span", "?")] = r["cost"]
         elif ev == "chunk":
             last_chunk = r
+            if r.get("energy") is not None:
+                span = r.get("span", "?")
+                energy_series.setdefault(span, []).append(r["energy"])
+                energy_last[span] = {
+                    "energy": r["energy"], "chunk": r.get("chunk"),
+                }
         elif ev == "watchdog":
             watchdogs.append(r)
     segments.append((cur_pid, cur_open))
@@ -746,6 +840,21 @@ def summarize(path: str) -> dict:
         for pid, opens in segments for span in opens
         if span in last_cost
     }
+    # last-known energy + plateau chunk for spans open at death — "the
+    # anneal died at chunk 7, energy 212, flat since chunk 4" readout
+    from ccx.common.convergence import plateau_chunk as _plateau
+
+    convergence = {}
+    for pid, opens in segments:
+        for span in opens:
+            if span not in energy_last:
+                continue
+            entry = dict(energy_last[span])
+            series = energy_series.get(span) or []
+            if len(series) > 1:
+                entry["plateauChunk"] = _plateau(series)
+                entry["chunksSeen"] = len(series)
+            convergence[span] = entry
     return {
         "records": len(records),
         "runs": len(segments),
@@ -756,20 +865,83 @@ def summarize(path: str) -> dict:
         # expected device time + HBM watermark for spans open at death,
         # priced from the same phase's last completed run in this file
         "expectedCost": expected_cost,
+        # last-known heartbeat energy (+ plateau) for spans open at death
+        "convergence": convergence,
         "watchdogDumps": len(watchdogs),
         "lastWatchdog": watchdogs[-1] if watchdogs else None,
     }
 
 
+def render_summary(s: dict) -> str:
+    """Human-readable diagnosis of a ``summarize()`` dict (the default
+    CLI output; ``--json`` keeps the machine form for tooling)."""
+    lines = [
+        f"flight recording: {s['records']} records, {s['runs']} run(s), "
+        f"{s['tornLines']} torn line(s)"
+    ]
+    last = s.get("last")
+    if last:
+        lines.append("last record: " + json.dumps(last, default=str))
+    lc = s.get("lastChunk")
+    if lc:
+        extra = (
+            f" energy={lc['energy']}" if lc.get("energy") is not None else ""
+        )
+        lines.append(
+            f"last chunk: {lc.get('span', '?')} chunk {lc.get('chunk')}"
+            f"/{lc.get('total', '?')}{extra}"
+        )
+    if s.get("openSpans"):
+        lines.append("open spans at death:")
+        for span in s["openSpans"]:
+            parts = [f"  {span}"]
+            conv = (s.get("convergence") or {}).get(span.split(" ")[-1])
+            if conv:
+                parts.append(
+                    f"— last energy {conv['energy']} @ chunk "
+                    f"{conv.get('chunk')}"
+                )
+                if conv.get("plateauChunk") is not None:
+                    parts.append(
+                        f"(plateau at chunk {conv['plateauChunk']} of "
+                        f"{conv['chunksSeen']} seen)"
+                    )
+            cost = (s.get("expectedCost") or {}).get(span.split(" ")[-1])
+            if cost:
+                parts.append(f"expected cost {json.dumps(cost)}")
+            lines.append(" ".join(parts))
+    else:
+        lines.append("open spans at death: none (clean exit)")
+    lines.append(
+        f"watchdog dumps: {s['watchdogDumps']}"
+        + (
+            f" (last: {json.dumps(s['lastWatchdog'].get('spans', {}))})"
+            if s.get("lastWatchdog")
+            else ""
+        )
+    )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
-    """``python -m ccx.common.tracing recording.jsonl`` — print the
-    diagnosis of a (possibly dead) run's flight recording."""
+    """``python -m ccx.common.tracing recording.jsonl [--json]`` — print
+    the diagnosis of a (possibly dead) run's flight recording: human-
+    readable by default, ``--json`` for tooling (the budget advisor and
+    campaign scripts consume the machine form)."""
     args = list(sys.argv[1:] if argv is None else argv)
-    if len(args) != 1:
-        print("usage: python -m ccx.common.tracing <recording.jsonl>",
-              file=sys.stderr)
+    as_json = "--json" in args
+    args = [a for a in args if a != "--json"]
+    if len(args) != 1 or args[0] in ("-h", "--help"):
+        print(
+            "usage: python -m ccx.common.tracing <recording.jsonl> [--json]",
+            file=sys.stderr,
+        )
         return 2
-    print(json.dumps(summarize(args[0]), indent=1))
+    if not os.path.exists(args[0]):
+        print(f"no such recording: {args[0]}", file=sys.stderr)
+        return 2
+    s = summarize(args[0])
+    print(json.dumps(s, indent=1) if as_json else render_summary(s))
     return 0
 
 

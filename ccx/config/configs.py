@@ -446,6 +446,30 @@ def observability_config_def() -> ConfigDef:
              "Roofline ceiling override for the CURRENT device: HBM "
              "bandwidth in GB/s used by the cost model's projections. "
              "0 = use the built-in device-spec table.", at_least(0))
+    d.define("observability.convergence", Type.BOOLEAN, True,
+             Importance.MEDIUM,
+             "Convergence telemetry taps (ccx.search.telemetry): thread a "
+             "device-resident ring buffer through every chunk-driven "
+             "search engine, recording per chunk the full per-goal lex "
+             "cost vector, per-move-kind proposal/acceptance counters and "
+             "the SA temperature — surfaced as the convergence block on "
+             "every proposal result, tier-0 energy on flight-recorder "
+             "heartbeats, per-job /observability timelines and the "
+             "convergence-energy/plateau-step Prometheus gauges; "
+             "tools/convergence_report.py turns it into per-phase plateau "
+             "and budget proposals. Zero added host syncs and shape-"
+             "stable (budget retunes never recompile). False restores "
+             "today's compiled programs bit-exactly (env override: "
+             "CCX_CONVERGENCE=0).")
+    d.define("observability.convergence.max.chunks", Type.INT, 256,
+             Importance.LOW,
+             "Ring-buffer depth of the convergence taps, in chunk rows. "
+             "Program SHAPE like the chunk sizes (changing it mints new "
+             "compiled chunk programs — a deployment choice, not a "
+             "per-run retune); runs longer than this keep the opening "
+             "rows plus the latest chunk and are flagged truncated. "
+             "Default 256 covers every banked rung with an order of "
+             "magnitude to spare at ~20 KB of HBM.", at_least(1))
     return d
 
 

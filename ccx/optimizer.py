@@ -89,6 +89,18 @@ class OptimizerResult:
     #: (ccx.parallel.sharding.program_cache_stats). VOLATILE in golden
     #: wire fixtures, like spanTree/costModel.
     mesh: dict | None = None
+    #: convergence-telemetry block (ccx.search.telemetry, ISSUE 9):
+    #: ``{"goals": [...], "phases": {phase: [segment, ...]}}`` — per-chunk
+    #: per-goal lex cost series + cumulative move counters + temperature
+    #: for every chunk-driven search phase this run executed (a phase that
+    #: ran several engine invocations, e.g. repair-round re-polishes,
+    #: carries one segment per invocation). The budget advisor
+    #: (tools/convergence_report.py) and the bench ledger's plateau
+    #: columns consume it. Rides BENCH lines and the sidecar result;
+    #: VOLATILE in golden wire fixtures (run-trajectory data). None with
+    #: taps off (observability.convergence=false) or fully-monolithic
+    #: engine configs.
+    convergence: dict | None = None
     #: input placement, kept so the ClusterModelStats blocks (ref
     #: model/ClusterModelStats.java, SURVEY.md C4) can be derived lazily —
     #: computing them costs an aggregate pass + host transfer, which must not
@@ -159,6 +171,7 @@ class OptimizerResult:
             **({"spanTree": self.span_tree} if self.span_tree else {}),
             **({"costModel": self.cost_model} if self.cost_model else {}),
             **({"mesh": self.mesh} if self.mesh else {}),
+            **({"convergence": self.convergence} if self.convergence else {}),
             **(
                 {
                     "clusterModelStats": {
@@ -511,12 +524,20 @@ def _optimize(
     phases: dict[str, float] = {}
     kind_prop = [0, 0, 0]
     kind_acc = [0, 0, 0]
+    #: per-phase convergence-telemetry segments (ccx.search.telemetry):
+    #: every chunk-driven engine result contributes its decoded per-chunk
+    #: series under the pipeline phase that ran it
+    conv_phases: dict[str, list] = {}
 
-    def _tally(r) -> None:
-        """Accumulate a search result's per-move-kind counters."""
+    def _tally(r, phase: str | None = None) -> None:
+        """Accumulate a search result's per-move-kind counters and (when
+        the convergence taps were armed) its telemetry segment."""
         for i in range(3):
             kind_prop[i] += int(r.n_prop_kind[i])
             kind_acc[i] += int(r.n_acc_kind[i])
+        conv = getattr(r, "convergence", None)
+        if phase is not None and conv:
+            conv_phases.setdefault(phase, []).append(conv)
 
     @contextlib.contextmanager
     def _phase(name: str, **attrs):
@@ -594,7 +615,7 @@ def _optimize(
                 dataclasses.replace(opts.anneal, n_steps=chunk),
                 mesh=mesh,
             )
-            _tally(sa1)
+            _tally(sa1, "anneal")
             t_join = time.monotonic()
             repair_thread.join()
             phases["repair-join"] = time.monotonic() - t_join
@@ -637,7 +658,7 @@ def _optimize(
             )
         else:
             sa = anneal(repaired, cfg, goal_names, opts.anneal, mesh=mesh)
-    _tally(sa)
+    _tally(sa, "anneal")
     if n_repair_lazy is not None:
         # the anneal consumed the repaired arrays, so this sync is free
         n_repair = int(n_repair_lazy)
@@ -657,7 +678,7 @@ def _optimize(
     with _phase("polish", iters=opts.polish.max_iters, run=opts.run_polish):
         if opts.run_polish:
             polish = greedy_optimize(model, cfg, goal_names, opts.polish)
-            _tally(polish)
+            _tally(polish, "polish")
             model = polish.model
             stack_after = polish.stack_after
             n_polish += polish.n_moves
@@ -669,7 +690,7 @@ def _optimize(
                 )
                 n_polish += n_r
                 polish = greedy_optimize(model, cfg, goal_names, opts.polish)
-                _tally(polish)
+                _tally(polish, "polish")
                 if polish.n_moves == 0 and n_r == 0:
                     break
                 model = polish.model
@@ -693,7 +714,7 @@ def _optimize(
     if opts.run_cold_greedy:
         with _phase("portfolio"):
             cold = greedy_optimize(m, cfg, goal_names, opts.polish)
-            _tally(cold)
+            _tally(cold, "portfolio")
             if _lex_better(cold.stack_after, stack_after):
                 model = cold.model
                 stack_after = cold.stack_after
@@ -739,12 +760,12 @@ def _optimize(
                     swept, cfg, goal_names, repolish,
                     trd_guard=opts.topic_rebalance_guarded,
                 )
-                _tally(cand)
+                _tally(cand, "topic-rebalance")
                 if opts.topic_rebalance_guarded and not _lex_better(
                     cand.stack_after, stack_after
                 ):
                     cand = greedy_optimize(swept, cfg, goal_names, repolish)
-                    _tally(cand)
+                    _tally(cand, "topic-rebalance")
                 if not _lex_better(cand.stack_after, stack_after):
                     break
                 model = cand.model
@@ -773,7 +794,7 @@ def _optimize(
                     chunk_iters=opts.swap_polish_chunk_iters,
                 ),
             )
-            _tally(sp)
+            _tally(sp, phase_name)
         return sp
 
     if opts.swap_polish_iters > 0 and allows_inter_broker(goal_names):
@@ -811,7 +832,7 @@ def _optimize(
                     ),
                 ),
             )
-            _tally(lead)
+            _tally(lead, "leader-pass")
             model = lead.model
             stack_after = lead.stack_after
             n_polish += lead.n_moves
@@ -868,6 +889,30 @@ def _optimize(
         }
         REGISTRY.counter(f"proposal-moves-{name}-proposed").inc(kind_prop[i])
         REGISTRY.counter(f"proposal-moves-{name}-accepted").inc(kind_acc[i])
+    convergence = None
+    if conv_phases:
+        convergence = {"goals": list(goal_names), "phases": conv_phases}
+        # live plateau gauges (ISSUE 9): per phase (and per fleet job when
+        # one is registered), the chunk index after which the lex vector
+        # stopped improving — the budget advisor's headline number,
+        # scrapeable DURING a fleet run as each job's phases complete
+        from ccx.common.convergence import plateau_chunk
+        from ccx.common.tracing import TRACER as _tracer
+
+        job = _tracer.job()
+        for phase, segs in conv_phases.items():
+            series = (segs[-1] or {}).get("series") or []
+            if len(series) > 1:
+                REGISTRY.set_gauge(
+                    "convergence-plateau-step",
+                    float(plateau_chunk(series)),
+                    labels={
+                        **({"job": job} if job else {}), "phase": phase,
+                    },
+                    help="chunk index of the last lex-improving chunk of "
+                         "the phase's most recent engine run "
+                         "(convergence taps)",
+                )
     mesh_info = None
     if mesh is not None:
         from ccx.parallel.sharding import program_cache_stats
@@ -889,6 +934,7 @@ def _optimize(
         phase_seconds=phases,
         move_counters=move_counters,
         mesh=mesh_info,
+        convergence=convergence,
         input_model=m,
     )
 

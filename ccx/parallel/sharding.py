@@ -677,16 +677,25 @@ def sharded_anneal(
                 )
                 _cache_put(_RUN_CACHE, init_key, init_fn)
 
+            # convergence taps (ccx.search.telemetry): the tap update runs
+            # OUTSIDE the shard_map body, in the same jitted program — a
+            # tiny auto-sharded reduction over the [chains, G] cost
+            # vectors, no extra host sync, replicated output. Tap
+            # presence is program shape, so it joins the cache key.
+            from ccx.search import telemetry as _telemetry
+
+            taps_on = _telemetry.enabled()
             chunk_key = (
                 "chunk", mesh, goal_names, cfg, pp, b_real,
                 opts.t0, opts.moves_per_step, opts.batched, schedule_on,
-                needs_topic, chunk, _struct_key(m),
+                needs_topic, chunk, taps_on, _struct_key(m),
             )
             chunk_fn = _cache_get(_RUN_CACHE, chunk_key)
             if chunk_fn is None:
 
                 def _chunk_run(states, m_s, evac_s, n_evac_s, group_arg,
-                               t_offset, decay_t, ramp_t, n_total):
+                               t_offset, decay_t, ramp_t, n_total,
+                               tap=None):
                     def body(ss, m_local, evac_l, n_evac_l, group_l,
                              t_off, dec, ramp, n_tot):
                         step = _make_step(m_local, group_l, ramp)
@@ -712,7 +721,7 @@ def sharded_anneal(
 
                     # the scan carry mixes axis-invariant init values
                     # with axis-varying updates; skip the check
-                    return _shard_map(
+                    states = _shard_map(
                         body,
                         mesh,
                         in_specs=(
@@ -723,6 +732,18 @@ def sharded_anneal(
                         check=False,
                     )(states, m_s, evac_s, n_evac_s, group_arg,
                       t_offset, decay_t, ramp_t, n_total)
+                    if tap is not None:
+                        t_last = jnp.maximum(
+                            jnp.minimum(t_offset + chunk, n_total) - 1, 0
+                        )
+                        tap = _telemetry.record(
+                            tap,
+                            _telemetry.lex_best_row(states.cost_vec),
+                            jnp.sum(states.n_prop_kind, axis=0),
+                            jnp.sum(states.n_acc_kind, axis=0),
+                            opts.t0 * decay_t**t_last,
+                        )
+                    return states, tap
 
                 chunk_fn = costmodel.instrument(
                     "sharded-sa-chunk", iters=lambda k, c=chunk: c
@@ -736,15 +757,35 @@ def sharded_anneal(
             )
             n_j = jax.device_put(jnp.asarray(n, jnp.int32), rep)
             states = init_fn(m_sharded, keys, group_rep)
+            tap = (
+                jax.device_put(
+                    _telemetry.make_tap(len(goal_names)), rep
+                )
+                if taps_on
+                else None
+            )
 
-            def run_one(ss, off):
+            def run_one(carry, off):
+                ss, tp = carry
                 off_j = jax.device_put(jnp.asarray(off, jnp.int32), rep)
                 return chunk_fn(
                     ss, m_sharded, evac, n_evac, group_rep,
-                    off_j, decay_j, ramp_j, n_j,
+                    off_j, decay_j, ramp_j, n_j, tp,
                 ), None
 
-            states = drive_chunks(run_one, states, total=n, chunk=chunk)
+            probe = None
+            if tap is not None:
+                # tier-0 heartbeat energy, non-blocking (drive_chunks
+                # reads it via is_ready — the mesh path has no sync)
+                def probe(carry):
+                    return jnp.min(carry[0].cost_vec[:, 0])
+
+            states, tap = drive_chunks(
+                run_one, (states, tap), total=n, chunk=chunk, probe=probe
+            )
+            convergence = _telemetry.decode(
+                tap, goal_names, chunk_size=chunk, budget=n
+            )
         else:
             # ---- monolithic one-shot scan (parity reference) -------------
             # Reuse the compiled program across calls (see _struct_key: a
@@ -798,12 +839,15 @@ def sharded_anneal(
                 )(jax.jit(_run))
                 _cache_put(_RUN_CACHE, cache_key, run)
             states = run(m_sharded, keys, evac, n_evac, group_rep)
+            convergence = None
     return _finish_sharded_anneal(
-        m_sharded, states, cfg, goal_names, opts, stack_before
+        m_sharded, states, cfg, goal_names, opts, stack_before,
+        convergence=convergence,
     )
 
 
-def _finish_sharded_anneal(m_sharded, states, cfg, goal_names, opts, stack_before):
+def _finish_sharded_anneal(m_sharded, states, cfg, goal_names, opts,
+                           stack_before, convergence=None):
     from ccx.search.annealer import AnnealResult, best_chain_index
     from ccx.search.state import with_placement
     from ccx.goals.stack import evaluate_stack
@@ -822,4 +866,5 @@ def _finish_sharded_anneal(m_sharded, states, cfg, goal_names, opts, stack_befor
         best_chain=best,
         n_prop_kind=tuple(int(x) for x in np.asarray(pick.n_prop_kind)),
         n_acc_kind=tuple(int(x) for x in np.asarray(pick.n_acc_kind)),
+        convergence=convergence,
     )

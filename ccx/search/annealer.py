@@ -138,6 +138,11 @@ class AnnealResult:
     #: proposal/acceptance counts — observability (state.MOVE_KIND_NAMES)
     n_prop_kind: tuple[int, ...] = (0, 0, 0)
     n_acc_kind: tuple[int, ...] = (0, 0, 0)
+    #: decoded convergence-telemetry segment (ccx.search.telemetry): the
+    #: per-chunk lex-best cost vector / move counters / temperature series
+    #: the chunk carry recorded. None on the monolithic (unchunked) path
+    #: or with taps off (observability.convergence=false).
+    convergence: dict | None = None
 
     @property
     def improved(self) -> bool:
@@ -1409,7 +1414,18 @@ def _init_chains(
     return jax.vmap(lambda k: state0.replace(key=k))(keys)
 
 
-def drive_chunks(run_one, carry, *, total: int, chunk: int):
+def _probe_ready(x) -> bool:
+    """Non-blocking readiness poll for a dispatched probe scalar. False
+    when the runtime offers no ``is_ready`` (never block — the probe is a
+    best-effort heartbeat enrichment, not a sync point)."""
+    fn = getattr(x, "is_ready", None)
+    try:
+        return bool(fn()) if callable(fn) else False
+    except Exception:  # noqa: BLE001 — a deleted/donated buffer reads False
+        return False
+
+
+def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None):
     """Host-side chunk driver shared by the SA chunk runner and both
     chunked polish engines (ccx.search.greedy): invoke
     ``run_one(carry, off)`` once per chunk offset, threading the (usually
@@ -1435,13 +1451,23 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int):
     Only the dispatch is gated — the early-exit sync runs outside the
     grant so another job dispatches while this chunk executes. With no
     ambient job (tests, tools, single-tenant paths) the loop is exactly
-    the ungated round-11 driver."""
+    the ungated round-11 driver.
+
+    ``probe(carry) -> device scalar`` (optional — the convergence taps,
+    ccx.search.telemetry) supplies the tier-0 lex energy joined onto each
+    heartbeat, WITHOUT adding a host sync: engines with an early-exit
+    sync (``done`` non-None) read the probe at that existing sync; SA
+    chunks (``done=None``, fully pipelined) dispatch the probe async and
+    each heartbeat reports the latest probe that ``is_ready`` — typically
+    the previous chunk's energy, one chunk stale by construction."""
     from ccx.common.tracing import TRACER
     from ccx.search.scheduler import FLEET
 
     step = max(int(chunk), 1)
     n = max(int(total), 0)
     job = FLEET.current()
+    energy = None
+    pending = None
     with (FLEET.drive(job) if job is not None else contextlib.nullcontext()):
         for i, off in enumerate(range(0, n, step)):
             if job is not None:
@@ -1449,7 +1475,22 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int):
                     carry, done = run_one(carry, off)
             else:
                 carry, done = run_one(carry, off)
-            TRACER.heartbeat(i, offset=off, total=n)
+            if probe is not None:
+                try:
+                    val = probe(carry)
+                    if done is not None:
+                        # the early-exit poll below blocks on this chunk
+                        # anyway — reading the probe here adds a scalar
+                        # transfer, not a sync
+                        energy, pending = float(val), None
+                    else:
+                        if pending is not None and _probe_ready(pending):
+                            energy = float(pending)
+                        pending = val
+                except Exception:  # noqa: BLE001 — enrichment only: a
+                    # broken probe must never break the drive loop
+                    probe = None
+            TRACER.heartbeat(i, offset=off, total=n, energy=energy)
             if done is not None and bool(done):
                 break
     return carry
@@ -1472,6 +1513,7 @@ def _run_chunk(
     decay: jnp.ndarray,
     swap_ramp: jnp.ndarray,
     n_total: jnp.ndarray,
+    tap=None,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
@@ -1499,6 +1541,13 @@ def _run_chunk(
     that does not divide ``chunk`` runs its remainder as a zeroed-budget
     tail inside the SAME compiled program — the round-7 restriction
     ("pick n_steps % chunk_steps == 0 or pay a second compile") is gone.
+
+    ``tap`` (optional — the convergence telemetry carry,
+    ccx.search.telemetry) rides through untouched-by-the-scan and gets ONE
+    traced ``dynamic_update_slice`` row at chunk end: the lex-best chain's
+    full cost vector, chain-summed cumulative move counters, and the
+    temperature at the chunk's last live step. None (taps off) traces the
+    identical pre-telemetry program, so taps-off results are bit-exact.
     """
     step, _ = _build_step(
         m, goal_names, cfg, opts, p_real, b_real, max_pt, swap_ramp=swap_ramp
@@ -1515,7 +1564,20 @@ def _run_chunk(
         return ss, None
 
     states, _ = jax.lax.scan(body, states, t_offset + jnp.arange(chunk))
-    return states
+    if tap is not None:
+        from ccx.search import telemetry
+
+        t_last = jnp.maximum(
+            jnp.minimum(t_offset + chunk, n_total) - 1, 0
+        )
+        tap = telemetry.record(
+            tap,
+            telemetry.lex_best_row(states.cost_vec),
+            jnp.sum(states.n_prop_kind, axis=0),
+            jnp.sum(states.n_acc_kind, axis=0),
+            opts.t0 * decay**t_last,
+        )
+    return states, tap
 
 
 @costmodel.instrument("sa-monolith", iters=lambda k: k["opts"].n_steps)
@@ -1693,18 +1755,43 @@ def anneal(
         ramp = jnp.asarray(_swap_ramp_of(opts, n), jnp.float32)
         decay_j = jnp.asarray(decay, jnp.float32)
         n_j = jnp.asarray(n, jnp.int32)
+        # convergence taps (ccx.search.telemetry): the ring buffer rides
+        # the chunk carry; None (taps off) keeps the program bit-exact
+        from ccx.search import telemetry
 
-        def run_one(states, off):
+        tap = telemetry.make_tap(len(goal_names)) if telemetry.enabled() else None
+        if mesh is not None and tap is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # like evac: replicate the tap or the mixed-committment jit
+            # call on a chains-mesh errors out
+            tap = jax.device_put(
+                tap, NamedSharding(mesh, PartitionSpec())
+            )
+
+        def run_one(carry, off):
+            states, tp = carry
             return _run_chunk(
                 states, m, evac_j, n_evac_j,
-                jnp.asarray(off, jnp.int32), decay_j, ramp, n_j,
+                jnp.asarray(off, jnp.int32), decay_j, ramp, n_j, tp,
                 goal_names=goal_names, cfg=cfg, opts=opts_key,
                 p_real=p_real, b_real=b_real, max_pt=max_pt,
                 chunk=int(opts.chunk_steps),
             ), None
 
-        states = drive_chunks(
-            run_one, states, total=n, chunk=opts.chunk_steps
+        probe = None
+        if tap is not None:
+            # tier-0 heartbeat energy: best chain's top-tier cost — read
+            # non-blocking by drive_chunks (SA chunks have no sync point)
+            def probe(carry):
+                return jnp.min(carry[0].cost_vec[:, 0])
+
+        states, tap = drive_chunks(
+            run_one, (states, tap), total=n, chunk=opts.chunk_steps,
+            probe=probe,
+        )
+        convergence = telemetry.decode(
+            tap, goal_names, chunk_size=opts.chunk_steps, budget=n
         )
     else:
         states = _run_chains(
@@ -1713,6 +1800,7 @@ def anneal(
             p_real=p_real, b_real=b_real,
             max_pt=max_pt,
         )
+        convergence = None
 
     best = best_chain_index(np.asarray(states.cost_vec))
     pick = jax.tree.map(lambda a: a[best], states)
@@ -1729,4 +1817,5 @@ def anneal(
         best_chain=best,
         n_prop_kind=tuple(int(x) for x in np.asarray(pick.n_prop_kind)),
         n_acc_kind=tuple(int(x) for x in np.asarray(pick.n_acc_kind)),
+        convergence=convergence,
     )

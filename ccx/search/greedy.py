@@ -155,6 +155,10 @@ class GreedyResult:
     #: acceptance counts (state.MOVE_KIND_NAMES) — observability
     n_prop_kind: tuple[int, ...] = (0, 0, 0)
     n_acc_kind: tuple[int, ...] = (0, 0, 0)
+    #: decoded convergence-telemetry segment (ccx.search.telemetry):
+    #: per-chunk lex cost vector / cumulative move counters recorded by
+    #: the chunk carry. None on the monolithic path or with taps off.
+    convergence: dict | None = None
 
 
 def _lex_lt_batch(costs: jnp.ndarray, cur: jnp.ndarray) -> jnp.ndarray:
@@ -630,6 +634,7 @@ def _greedy_chunk(
     max_iters: jnp.ndarray,
     patience: jnp.ndarray,
     guard_on: jnp.ndarray,
+    tap=None,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
@@ -639,13 +644,26 @@ def _greedy_chunk(
 ):
     """One chunk of the host-driven descent: ``opts.chunk_iters`` (the only
     shape-bearing budget) conditional iterations over the DONATED state.
-    Returns ``(state, it, stale, moves, done)`` — ``done`` is the
-    early-exit flag the host polls between chunks."""
+    Returns ``(state, it, stale, moves, tap, done)`` — ``done`` is the
+    early-exit flag the host polls between chunks; ``tap`` is the
+    convergence-telemetry carry (ccx.search.telemetry — one traced row per
+    chunk: the carried lex cost vector + cumulative move counters; None
+    keeps the pre-telemetry program, bit-exact)."""
     cond, body = _make_greedy_iter(
         m, evac, n_evac, key0, max_iters, patience, guard_on,
         goal_names=goal_names, cfg=cfg, pp=pp, opts=opts, max_pt=max_pt,
     )
-    return _run_chunk_body(cond, body, opts.chunk_iters, state, it, stale, moves)
+    state, it, stale, moves, done = _run_chunk_body(
+        cond, body, opts.chunk_iters, state, it, stale, moves
+    )
+    if tap is not None:
+        from ccx.search import telemetry
+
+        tap = telemetry.record(
+            tap, state.cost_vec, state.n_prop_kind, state.n_acc_kind,
+            jnp.zeros((), jnp.float32),
+        )
+    return state, it, stale, moves, tap, done
 
 
 def greedy_optimize(
@@ -722,20 +740,41 @@ def greedy_optimize(
         maxIters=opts.max_iters,
         leadershipOnly=lead_only,
     ):
+        convergence = None
         if opts.chunk_iters > 0:
+            from ccx.search import telemetry
+
+            tap = (
+                telemetry.make_tap(len(goal_names))
+                if telemetry.enabled()
+                else None
+            )
             zero = jnp.asarray(0, jnp.int32)
-            carry = (_unalias_placement(state0), zero, zero, zero)
+            carry = (_unalias_placement(state0), zero, zero, zero, tap)
 
             def run_one(c, off):
-                *c2, done = _greedy_chunk(
-                    *c, m, evac_j, n_evac_j, key0, mi, pat, guard,
+                *c2, tp, done = _greedy_chunk(
+                    *c[:4], m, evac_j, n_evac_j, key0, mi, pat, guard,
+                    c[4],
                     goal_names=goal_names, cfg=cfg, pp=pp, opts=opts_key,
                     max_pt=max_pt,
                 )
-                return tuple(c2), done
+                return tuple(c2) + (tp,), done
 
-            state, n_iters, _, n_moves = drive_chunks(
-                run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
+            probe = None
+            if tap is not None:
+                # the descent's early-exit poll already syncs each chunk,
+                # so the tier-0 heartbeat energy is a free scalar read
+                def probe(c):
+                    return c[0].cost_vec[0]
+
+            state, n_iters, _, n_moves, tap = drive_chunks(
+                run_one, carry, total=opts.max_iters,
+                chunk=opts.chunk_iters, probe=probe,
+            )
+            convergence = telemetry.decode(
+                tap, goal_names, chunk_size=opts.chunk_iters,
+                budget=opts.max_iters,
             )
         else:
             state, n_iters, n_moves = _greedy_loop(
@@ -755,6 +794,7 @@ def greedy_optimize(
         n_iters=int(np.asarray(n_iters)),
         n_prop_kind=tuple(int(x) for x in np.asarray(state.n_prop_kind)),
         n_acc_kind=tuple(int(x) for x in np.asarray(state.n_acc_kind)),
+        convergence=convergence,
     )
 
 
@@ -1097,6 +1137,7 @@ def _swap_polish_chunk(
     max_iters: jnp.ndarray,
     patience: jnp.ndarray,
     guard_on: jnp.ndarray,
+    tap=None,
     *,
     goal_names: tuple[str, ...],
     cfg: GoalConfig,
@@ -1104,12 +1145,22 @@ def _swap_polish_chunk(
     max_pt: int,
 ):
     """One donated-state chunk of the swap-polish descent (see
-    `_greedy_chunk`)."""
+    `_greedy_chunk` — same telemetry-tap contract)."""
     cond, body = _make_swap_iter(
         m, key0, max_iters, patience, guard_on,
         goal_names=goal_names, cfg=cfg, opts=opts, max_pt=max_pt,
     )
-    return _run_chunk_body(cond, body, opts.chunk_iters, state, it, stale, moves)
+    state, it, stale, moves, done = _run_chunk_body(
+        cond, body, opts.chunk_iters, state, it, stale, moves
+    )
+    if tap is not None:
+        from ccx.search import telemetry
+
+        tap = telemetry.record(
+            tap, state.cost_vec, state.n_prop_kind, state.n_acc_kind,
+            jnp.zeros((), jnp.float32),
+        )
+    return state, it, stale, moves, tap, done
 
 
 def swap_polish(
@@ -1158,20 +1209,38 @@ def swap_polish(
         chunkIters=opts.chunk_iters,
         maxIters=opts.max_iters,
     ):
+        convergence = None
         if opts.chunk_iters > 0:
+            from ccx.search import telemetry
+
+            tap = (
+                telemetry.make_tap(len(goal_names))
+                if telemetry.enabled()
+                else None
+            )
             zero = jnp.asarray(0, jnp.int32)
-            carry = (_unalias_placement(state0), zero, zero, zero)
+            carry = (_unalias_placement(state0), zero, zero, zero, tap)
 
             def run_one(c, off):
-                *c2, done = _swap_polish_chunk(
-                    *c, m, key0, mi, pat, guard,
+                *c2, tp, done = _swap_polish_chunk(
+                    *c[:4], m, key0, mi, pat, guard, c[4],
                     goal_names=goal_names, cfg=cfg, opts=opts_key,
                     max_pt=max_pt,
                 )
-                return tuple(c2), done
+                return tuple(c2) + (tp,), done
 
-            state, n_iters, _, n_moves = drive_chunks(
-                run_one, carry, total=opts.max_iters, chunk=opts.chunk_iters
+            probe = None
+            if tap is not None:
+                def probe(c):
+                    return c[0].cost_vec[0]
+
+            state, n_iters, _, n_moves, tap = drive_chunks(
+                run_one, carry, total=opts.max_iters,
+                chunk=opts.chunk_iters, probe=probe,
+            )
+            convergence = telemetry.decode(
+                tap, goal_names, chunk_size=opts.chunk_iters,
+                budget=opts.max_iters,
             )
         else:
             state, n_iters, n_moves = _swap_polish_loop(
@@ -1190,4 +1259,5 @@ def swap_polish(
         n_iters=int(np.asarray(n_iters)),
         n_prop_kind=tuple(int(x) for x in np.asarray(state.n_prop_kind)),
         n_acc_kind=tuple(int(x) for x in np.asarray(state.n_acc_kind)),
+        convergence=convergence,
     )

@@ -31,7 +31,12 @@ batching needs. This module turns that boundary into a scheduler:
   always goes to the least-recently-served highest-priority waiter;
 * each job carries its own donated carry, budget and flight-recorder span
   (they live on the job's thread; the scheduler never touches them), so
-  one job early-exiting or failing cannot perturb another's search state;
+  one job early-exiting or failing cannot perturb another's search state.
+  Since round 13 the carry also threads the job's convergence tap
+  (``ccx.search.telemetry``) — the per-chunk quality series rides the
+  SAME gated boundary, so every interleaved job's heartbeats (and the
+  per-job ``convergence-energy`` gauge + /observability timeline) carry
+  that job's own tier-0 energy, never a neighbor's;
 * `max_concurrent` bounds how many jobs may be RESIDENT at once — a
   residency slot is taken at registration and held for the job's whole
   pipeline (its model, donated carries and host phases are live while

@@ -1,0 +1,205 @@
+"""Convergence telemetry — device-resident per-chunk quality taps (ISSUE 9).
+
+Every banked rung used to report only the FINAL lex vector: nothing
+observed where the anneal/polish phases plateaued, which is exactly the
+evidence the <5 s B5 chase needs to shrink budgets safely (ROADMAP "Bank
+the number on hardware") and the convergence criterion incremental
+re-optimization will key off (PAPERS.md "Integrative Dynamic
+Reconfiguration...", the consumer-group autoscaler line of work — both
+treat reconfiguration as an online process that must KNOW when it has
+converged, not run a fixed budget).
+
+This module is the device half: a ``(max_chunks, G + EXTRA)`` float32 ring
+buffer ("tap") threaded through the chunk CARRY of every compiled search
+engine — the SA chunk (``ccx.search.annealer._run_chunk``), both chunked
+polish engines (``ccx.search.greedy``) and the mesh-sharded chunk program
+(``ccx.parallel.sharding``). Each chunk program ends with ONE traced
+``lax.dynamic_update_slice`` writing a row: the full per-goal lex cost
+vector (the lex-best chain's, for multi-chain engines), cumulative
+per-move-kind proposal/acceptance counters (``state.MOVE_KIND_NAMES``
+order) and the temperature at the chunk's last step. Contracts:
+
+* **Shape-stable** — ``max_chunks`` is fixed configuration (never derived
+  from a budget), and the row index is traced data, so budget retunes
+  reuse the compiled chunk programs exactly like the traced budgets do.
+* **Zero added host syncs** — the tap rides the existing carry and comes
+  back at the sync points ``drive_chunks`` already has; ``decode`` runs
+  once after the run, where the engine already materializes its result.
+* **Bit-exact off switch** — ``enabled()`` False passes ``tap=None``
+  through every engine: the traced programs are the pre-telemetry ones
+  and results are bit-identical (pinned by tests/test_convergence.py).
+* **Truncation** — a run longer than ``max_chunks`` chunks clamps writes
+  to the LAST row: rows ``0..max_chunks-2`` keep the opening of the run,
+  the final row always holds the latest chunk, and ``decode`` flags
+  ``truncated`` with the true chunk count.
+
+The host-side analysis (plateau detection, budget proposals) lives in
+``ccx.common.convergence`` — dependency-light so the ledger and the
+flight-recorder tooling can use it without jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from ccx.common.convergence import plateau_chunk, wasted_fraction  # noqa: F401
+
+#: row layout past the G goal costs: 3 proposal counters, 3 acceptance
+#: counters (state.MOVE_KIND_NAMES order), temperature
+EXTRA = 7
+
+#: env off-switch for bench/tools/subprocess paths (the config key
+#: ``observability.convergence`` wins when the facade set it explicitly)
+ENV_CONVERGENCE = "CCX_CONVERGENCE"
+
+_DEFAULT_MAX_CHUNKS = 256
+
+_state: dict = {"enabled": None, "max_chunks": _DEFAULT_MAX_CHUNKS}
+
+
+def enabled() -> bool:
+    """Taps armed? Default ON (observability.convergence=true); tri-state
+    like the tracer knobs: an explicit ``set_enabled`` wins, else the env
+    (``CCX_CONVERGENCE=0`` disables), else on."""
+    v = _state["enabled"]
+    if v is None:
+        return os.environ.get(ENV_CONVERGENCE, "1") != "0"
+    return bool(v)
+
+
+def set_enabled(v: bool | None) -> None:
+    """Explicitly arm/disarm (None restores env/default resolution)."""
+    _state["enabled"] = v
+
+
+def max_chunks() -> int:
+    return int(_state["max_chunks"])
+
+
+def set_max_chunks(n: int) -> None:
+    """Ring-buffer depth. Program SHAPE (like ``chunk_iters``): changing
+    it mints new compiled chunk programs — a config choice, never a
+    per-run retune."""
+    _state["max_chunks"] = max(int(n), 1)
+
+
+def configure(enabled: bool | None = None,
+              max_chunks: int | None = None) -> None:
+    """Config-driven setup (facade construction)."""
+    if enabled is not None:
+        set_enabled(bool(enabled))
+    if max_chunks is not None and max_chunks > 0:
+        set_max_chunks(max_chunks)
+
+
+@contextlib.contextmanager
+def taps(v: bool | None):
+    """Test helper: force taps on/off within a block."""
+    prev = _state["enabled"]
+    _state["enabled"] = v
+    try:
+        yield
+    finally:
+        _state["enabled"] = prev
+
+
+# ----- device side (traced) -------------------------------------------------
+
+
+def make_tap(n_goals: int):
+    """Fresh ``(buffer f32[max_chunks, G+EXTRA], count int32)`` pair —
+    the carry element the chunk engines thread. ~20 KB at B5 defaults."""
+    import jax.numpy as jnp
+
+    return (
+        jnp.zeros((max_chunks(), int(n_goals) + EXTRA), jnp.float32),
+        jnp.zeros((), jnp.int32),
+    )
+
+
+def lex_best_row(cost_vecs):
+    """Traced lexicographic argmin over chains: ``[K, G] -> [G]`` — the
+    same column-elimination loop the greedy selection uses (G is static
+    and small, so it unrolls)."""
+    import jax.numpy as jnp
+
+    K, G = cost_vecs.shape
+    alive = jnp.ones((K,), bool)
+    for g in range(G):
+        col = jnp.where(alive, cost_vecs[:, g], jnp.inf)
+        mn = jnp.min(col)
+        tol = 1e-6 + 1e-6 * jnp.abs(mn)
+        alive = alive & (col <= mn + tol)
+    return cost_vecs[jnp.argmax(alive)]
+
+
+def record(tap, cost_vec, n_prop, n_acc, temperature):
+    """Traced per-chunk write: one ``dynamic_update_slice`` row (clamped
+    to the last row once the buffer is full — see module docstring), count
+    always advanced so ``decode`` can report the true chunk total.
+
+    The cumulative move counters share the f32 row with the costs, so
+    they are exact only below 2**24 (~16.7M) — two orders of magnitude
+    above any banked rung's proposal total; past that, per-chunk deltas
+    quantize (the counters are advisory trend evidence, never gated)."""
+    import jax
+    import jax.numpy as jnp
+
+    buf, n = tap
+    row = jnp.concatenate([
+        jnp.asarray(cost_vec, jnp.float32),
+        jnp.asarray(n_prop, jnp.float32),
+        jnp.asarray(n_acc, jnp.float32),
+        jnp.asarray(temperature, jnp.float32)[None],
+    ])
+    idx = jnp.minimum(n, buf.shape[0] - 1)
+    buf = jax.lax.dynamic_update_slice(
+        buf, row[None, :], (idx, jnp.zeros((), n.dtype))
+    )
+    return buf, n + 1
+
+
+# ----- host side ------------------------------------------------------------
+
+
+def decode(tap, goal_names, chunk_size: int | None = None,
+           budget: int | None = None) -> dict | None:
+    """Materialize a tap into the JSON-ready convergence segment that
+    rides ``AnnealResult``/``GreedyResult`` → ``OptimizerResult.
+    convergence``. One device→host transfer, at the point the engine
+    already syncs on its result. Counters are CUMULATIVE (per-chunk deltas
+    are a host-side diff — keeping the device write a pure copy of the
+    carried counters)."""
+    import numpy as np
+
+    if tap is None:
+        return None
+    buf = np.asarray(tap[0])
+    n = int(np.asarray(tap[1]))
+    if n <= 0:
+        return None
+    G = len(goal_names)
+    rows = min(n, buf.shape[0])
+    out: dict = {
+        "goals": list(goal_names),
+        "chunks": n,
+        "truncated": n > buf.shape[0],
+        "series": [
+            [round(float(x), 4) for x in buf[i, :G]] for i in range(rows)
+        ],
+        "proposed": [
+            [int(x) for x in buf[i, G:G + 3]] for i in range(rows)
+        ],
+        "accepted": [
+            [int(x) for x in buf[i, G + 3:G + 6]] for i in range(rows)
+        ],
+        "temperature": [
+            round(float(buf[i, G + 6]), 6) for i in range(rows)
+        ],
+    }
+    if chunk_size:
+        out["chunk"] = int(chunk_size)
+    if budget is not None:
+        out["budget"] = int(budget)
+    return out

@@ -129,6 +129,16 @@ class CruiseControl:
             config["optimizer.fleet.snapshot.hbm.mb"]
         )
         costmodel.export_gauges(REGISTRY)
+        # convergence telemetry taps (ccx.search.telemetry): same
+        # tri-state precedence — an absent key leaves the env
+        # (CCX_CONVERGENCE) in charge of the default-on taps; the
+        # ring-buffer depth is program shape, set once at construction
+        from ccx.search import telemetry
+
+        telemetry.configure(
+            enabled=_explicit("observability.convergence"),
+            max_chunks=config["observability.convergence.max.chunks"],
+        )
 
     # ----- lifecycle (ref startUp order: monitor -> detector -> servlet) ----
 
@@ -729,6 +739,12 @@ class CruiseControl:
                         # a mesh run is armed and that budget retunes are
                         # not minting new compiled programs
                         "mesh": self._mesh_state(),
+                        # convergence-telemetry state (ISSUE 9): taps
+                        # armed + ring depth; the per-job energy summary
+                        # rides observability_summary() above (VIEWER-
+                        # safe — the full timeline is USER-gated on
+                        # /observability)
+                        "convergenceTaps": self._convergence_state(),
                     },
                 }
         if "anomaly_detector" in want:
@@ -932,6 +948,19 @@ class CruiseControl:
             except Exception:  # noqa: BLE001 — state must stay readable
                 out["meshShape"] = None
         return out
+
+    def _convergence_state(self) -> dict:
+        """AnalyzerState.observability.convergenceTaps: taps armed + ring
+        depth (never raises — state must stay readable)."""
+        try:
+            from ccx.search import telemetry
+
+            return {
+                "enabled": telemetry.enabled(),
+                "maxChunks": telemetry.max_chunks(),
+            }
+        except Exception:  # noqa: BLE001 — state must stay readable
+            return {"enabled": None}
 
     def _broker_health_metrics(self) -> dict[int, dict[str, float]]:
         """Latest broker-window metrics for the concurrency adjuster (C26)."""

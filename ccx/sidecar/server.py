@@ -399,6 +399,9 @@ class OptimizerSidecar:
                         # per-job progress frames: the interleaved fleet
                         # stream stays attributable per cluster
                         job=payload.get("job", cluster),
+                        # convergence-tap energy (round 13, additive):
+                        # live quality on the progress stream
+                        energy=payload.get("energy"),
                     )
         finally:
             TRACER.remove_listener(_tap)

@@ -238,14 +238,19 @@ def progress_frame(text: str) -> dict:
 def heartbeat_frame(text: str, span: str | None = None,
                     chunk: int | None = None,
                     total: int | None = None,
-                    job: str | None = None) -> dict:
+                    job: str | None = None,
+                    energy: float | None = None) -> dict:
     """A progress frame carrying structured span context — the wire face
     of the flight-recorder chunk heartbeats (ccx.common.tracing), so the
     JVM's OperationProgress can show live per-phase chunk progress during
     a long TPU window. Additive and wire-compatible: pre-observability
     clients read only the ``progress`` text and ignore the extra keys.
     ``job`` (round 12, additive) is the fleet cluster id the chunk belongs
-    to, so an interleaved multi-job stream stays attributable per job."""
+    to, so an interleaved multi-job stream stays attributable per job.
+    ``energy`` (round 13, additive) is the convergence taps' tier-0 lex
+    energy at this chunk (possibly one chunk stale on sync-free SA
+    drives) — the JVM's progress view then shows live QUALITY, not just
+    depth; absent when taps are off (legacy fixtures byte-stable)."""
     f: dict = {"progress": text}
     if span is not None:
         f["span"] = span
@@ -255,6 +260,8 @@ def heartbeat_frame(text: str, span: str | None = None,
         f["total"] = int(total)
     if job is not None:
         f["job"] = str(job)
+    if energy is not None:
+        f["energy"] = float(energy)
     return _stamped(f)
 
 

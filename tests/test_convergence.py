@@ -1,0 +1,561 @@
+"""Convergence telemetry (ISSUE 9): device-resident per-chunk quality
+taps, plateau analysis, budget advisor.
+
+Contracts pinned here:
+
+* **Bit-exact off AND on** — the taps read the chunk carry, never write
+  it: taps-on and taps-off runs produce bit-identical placements at
+  1/10-scale B5 for all three chunk engines (SA chunk, greedy polish,
+  usage-coupled swap polish) and for the mesh-sharded chunk program.
+* **Compile hygiene** — the ring buffer is shape-stable: budget retunes
+  with taps armed reuse the compiled chunk programs (zero fresh
+  compiles), and a warm ``optimize()`` with taps armed pays zero fresh
+  compiles — the tripwire the warm ladder rides.
+* **Truncation** — runs longer than ``max_chunks`` keep the opening rows
+  plus the latest chunk, flagged ``truncated`` with the true count.
+* **Surfacing** — tier-0 energy on flight-recorder heartbeats (and the
+  ``summarize()`` join pricing a dead window's quality), per-job labeled
+  Prometheus gauges in strict exposition form, the wire heartbeat frame's
+  additive ``energy`` field, per-phase series on
+  ``OptimizerResult.convergence``.
+* **Advisor** — plateau detection + the wasted-budget table + proposed
+  budgets (tools/convergence_report.py), and the ledger's advisory
+  (non-failing) >30%-past-plateau warning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+from ccx.common import compilestats
+from ccx.common.convergence import (
+    phase_table,
+    plateau_chunk,
+    propose_budget,
+    total_wasted_fraction,
+    wasted_fraction,
+)
+from ccx.common.tracing import TRACER
+from ccx.goals.base import GoalConfig
+from ccx.goals.stack import DEFAULT_GOAL_ORDER
+from ccx.model.fixtures import (
+    RandomClusterSpec,
+    random_cluster,
+    small_deterministic,
+)
+from ccx.search import telemetry
+from ccx.search.annealer import AnnealOptions, anneal
+from ccx.search.greedy import (
+    GreedyOptions,
+    SwapPolishOptions,
+    greedy_optimize,
+    swap_polish,
+)
+
+CFG = GoalConfig()
+#: 1/10-scale B5 (the shape test_polish_chunked pins the chunk engines at)
+B5S = RandomClusterSpec(
+    n_brokers=100, n_racks=10, n_topics=50, n_partitions=10_000,
+    n_dead_brokers=2, seed=7,
+)
+SMALL = RandomClusterSpec(
+    n_brokers=14, n_racks=4, n_topics=10, n_partitions=700, seed=31
+)
+
+
+@pytest.fixture(scope="module")
+def m_b5s():
+    return random_cluster(B5S)
+
+
+def _placement(model):
+    return (
+        np.asarray(model.assignment),
+        np.asarray(model.leader_slot),
+        np.asarray(model.replica_disk),
+    )
+
+
+def _assert_bitexact(a, b):
+    for x, y in zip(_placement(a.model), _placement(b.model)):
+        np.testing.assert_array_equal(x, y)
+
+
+# ----- plateau math (host half, ccx.common.convergence) ---------------------
+
+
+def test_plateau_detection_and_wasted_fraction():
+    # improves through chunk 2, flat after — plateau at 2, 3 of 5 steps wasted
+    series = [[10.0, 5.0], [8.0, 5.0], [6.0, 5.0],
+              [6.0, 5.0], [6.0, 5.0], [6.0, 5.0]]
+    assert plateau_chunk(series) == 2
+    assert wasted_fraction(series) == pytest.approx(3 / 5)
+    # lex semantics: a LOWER-tier improvement counts; a higher-tier
+    # regression does not read as improvement
+    assert plateau_chunk([[5.0, 9.0], [5.0, 7.0]]) == 1
+    assert plateau_chunk([[5.0, 9.0], [6.0, 0.0]]) == 0
+    # sub-tolerance drift is not improvement
+    assert plateau_chunk([[5.0], [5.0 - 1e-9]]) == 0
+    # scalar (tier-0 energy) series work too — the flight-record form
+    assert plateau_chunk([9.0, 7.0, 7.0, 7.0]) == 1
+    assert plateau_chunk([]) == 0 and wasted_fraction([]) == 0.0
+
+
+def test_propose_budget_margins_and_caps():
+    seg = {"series": [[3.0], [2.0], [2.0], [2.0]], "chunk": 100,
+           "budget": 400}
+    # plateau at chunk 1 → 200 units through plateau, x1.25 = 250
+    assert propose_budget(seg) == 250
+    # never above the configured budget
+    assert propose_budget({**seg, "series": [[3.0], [2.0], [1.0], [0.5]],
+                           "budget": 400}) == 400
+    # truncated evidence cannot shrink a budget
+    assert propose_budget({**seg, "truncated": True}) == 400
+    # no chunk sizing → no proposal
+    assert propose_budget({"series": [[1.0]]}) is None
+
+
+# ----- device taps: record/decode + truncation ------------------------------
+
+
+def test_record_decode_and_truncation_semantics():
+    import jax.numpy as jnp
+
+    goals = ("A", "B")
+    with telemetry.taps(True):
+        old = telemetry.max_chunks()
+        telemetry.set_max_chunks(3)
+        try:
+            tap = telemetry.make_tap(len(goals))
+            for i in range(5):
+                tap = telemetry.record(
+                    tap,
+                    jnp.asarray([10.0 - i, 1.0]),
+                    jnp.asarray([i, 0, 0]),
+                    jnp.asarray([1, 0, 0]),
+                    jnp.asarray(0.5),
+                )
+            seg = telemetry.decode(tap, goals, chunk_size=50, budget=250)
+        finally:
+            telemetry.set_max_chunks(old)
+    assert seg["chunks"] == 5 and seg["truncated"]
+    # rows 0..max-2 keep the opening, the last row holds the LATEST chunk
+    assert len(seg["series"]) == 3
+    assert seg["series"][0] == [10.0, 1.0]
+    assert seg["series"][1] == [9.0, 1.0]
+    assert seg["series"][2] == [6.0, 1.0]
+    assert seg["proposed"][2] == [4, 0, 0]
+    assert seg["chunk"] == 50 and seg["budget"] == 250
+    # empty tap decodes to None (phase never drove a chunk)
+    assert telemetry.decode(None, goals) is None
+
+
+def test_lex_best_row_picks_lexicographic_winner():
+    import jax.numpy as jnp
+
+    vecs = jnp.asarray([[1.0, 9.0], [1.0, 2.0], [2.0, 0.0]])
+    assert telemetry.lex_best_row(vecs).tolist() == [1.0, 2.0]
+
+
+# ----- bit-exactness: taps on vs off, 1/10-scale B5, all three engines ------
+
+
+def test_anneal_taps_bitexact_b5s(m_b5s):
+    opts = AnnealOptions(
+        n_chains=2, n_steps=30, moves_per_step=8, chunk_steps=16, seed=3
+    )
+    with telemetry.taps(True):
+        on = anneal(m_b5s, CFG, DEFAULT_GOAL_ORDER, opts)
+    with telemetry.taps(False):
+        off = anneal(m_b5s, CFG, DEFAULT_GOAL_ORDER, opts)
+    _assert_bitexact(on, off)
+    assert off.convergence is None
+    conv = on.convergence
+    assert conv["chunks"] == 2  # ceil(30 / 16)
+    assert conv["goals"] == list(DEFAULT_GOAL_ORDER)
+    assert len(conv["series"][0]) == len(DEFAULT_GOAL_ORDER)
+    # SA records a real (decaying) temperature; counters are cumulative
+    assert conv["temperature"][0] > conv["temperature"][1] > 0
+    assert all(
+        b >= a for a, b in zip(conv["proposed"][0], conv["proposed"][1])
+    )
+    # the recorded final vector matches the winning chain's re-evaluated
+    # stack (f32-rounded — the tap stores what the carry held)
+    final = np.asarray(on.stack_after.costs, np.float32)
+    np.testing.assert_allclose(
+        conv["series"][-1], final, rtol=1e-3, atol=0.05
+    )
+
+
+def test_greedy_taps_bitexact_b5s(m_b5s):
+    opts = GreedyOptions(
+        n_candidates=128, max_iters=12, patience=4, chunk_iters=5
+    )
+    with telemetry.taps(True):
+        on = greedy_optimize(m_b5s, CFG, DEFAULT_GOAL_ORDER, opts)
+    with telemetry.taps(False):
+        off = greedy_optimize(m_b5s, CFG, DEFAULT_GOAL_ORDER, opts)
+    _assert_bitexact(on, off)
+    assert on.n_iters == off.n_iters and on.n_moves == off.n_moves
+    assert off.convergence is None
+    conv = on.convergence
+    # 12 iters / 5-iter chunks = 3 chunks (ceil), unless patience exited
+    assert 1 <= conv["chunks"] <= 3
+    assert conv["chunk"] == 5 and conv["budget"] == 12
+    # descent: the lex series never regresses chunk to chunk
+    for prev, cur in zip(conv["series"], conv["series"][1:]):
+        assert not _lex_regressed(prev, cur)
+
+
+def _lex_regressed(prev, cur) -> bool:
+    """cur lexicographically significantly worse than prev."""
+    for p, c in zip(prev, cur):
+        tol = 1e-6 + 1e-6 * abs(p)
+        if c > p + tol:
+            return True
+        if c < p - tol:
+            return False
+    return False
+
+
+def test_swap_polish_taps_bitexact_b5s(m_b5s):
+    opts = SwapPolishOptions(
+        n_swap_candidates=32, n_lead_candidates=32, max_iters=8,
+        patience=4, chunk_iters=3,
+    )
+    with telemetry.taps(True):
+        on = swap_polish(m_b5s, CFG, DEFAULT_GOAL_ORDER, opts)
+    with telemetry.taps(False):
+        off = swap_polish(m_b5s, CFG, DEFAULT_GOAL_ORDER, opts)
+    _assert_bitexact(on, off)
+    assert off.convergence is None
+    assert 1 <= on.convergence["chunks"] <= 3
+    # swap-polish proposes only replica swaps + coupled singles; the
+    # cumulative counters reflect engine activity
+    assert on.convergence["proposed"][-1][1] > 0
+
+
+def test_sharded_taps_bitexact_virtual_mesh():
+    import jax
+
+    from ccx.parallel.sharding import make_mesh, sharded_anneal
+
+    m = random_cluster(SMALL)
+    mesh = make_mesh(jax.devices()[:4], parts=2)
+    opts = AnnealOptions(n_chains=2, n_steps=10, chunk_steps=4, seed=5)
+    with telemetry.taps(True):
+        on = sharded_anneal(m, CFG, DEFAULT_GOAL_ORDER, opts, mesh)
+    with telemetry.taps(False):
+        off = sharded_anneal(m, CFG, DEFAULT_GOAL_ORDER, opts, mesh)
+    _assert_bitexact(on, off)
+    assert off.convergence is None
+    conv = on.convergence
+    assert conv["chunks"] == 3 and len(conv["temperature"]) == 3
+    assert conv["temperature"][0] > conv["temperature"][-1]
+
+
+# ----- compile hygiene ------------------------------------------------------
+
+
+def test_budget_retune_with_taps_armed_pays_zero_fresh_compiles(m_b5s=None):
+    """The shape-stability contract: with taps ARMED, SA/polish budget
+    retunes reuse the compiled chunk programs — max_chunks is fixed
+    config, the row index is data."""
+    m = small_deterministic()
+    goals = ("StructuralFeasibility", "ReplicaDistributionGoal")
+    with telemetry.taps(True):
+        anneal(m, CFG, goals, AnnealOptions(
+            n_chains=2, n_steps=8, chunk_steps=4, seed=1))
+        greedy_optimize(m, CFG, goals, GreedyOptions(
+            n_candidates=8, max_iters=4, patience=2, chunk_iters=2))
+        before = compilestats.snapshot()
+        # retunes: different step/iter budgets, same chunk shapes
+        anneal(m, CFG, goals, AnnealOptions(
+            n_chains=2, n_steps=14, chunk_steps=4, seed=2))
+        greedy_optimize(m, CFG, goals, GreedyOptions(
+            n_candidates=8, max_iters=7, patience=3, chunk_iters=2))
+        delta = compilestats.delta(before, compilestats.snapshot())
+    assert delta["backend_compiles"] == 0, delta
+
+
+def test_optimize_convergence_block_and_warm_zero_compile(tmp_path):
+    """End-to-end: OptimizerResult.convergence carries per-chunk per-goal
+    series for the pipeline phases, rides to_json, and the warm rerun
+    with taps armed pays ZERO fresh compiles (the warm-ladder tripwire
+    with taps on)."""
+    from ccx.optimizer import OptimizeOptions, optimize
+
+    m = small_deterministic()
+    goals = ("StructuralFeasibility", "ReplicaDistributionGoal")
+    opts = OptimizeOptions(
+        anneal=AnnealOptions(n_chains=2, n_steps=8, chunk_steps=4),
+        polish=GreedyOptions(n_candidates=8, max_iters=4, chunk_iters=2),
+        require_hard_zero=False, run_cold_greedy=True,
+        topic_rebalance_rounds=0, swap_polish_iters=4,
+    )
+    with telemetry.taps(True):
+        optimize(m, CFG, goals, opts)  # cold: may compile
+        before = compilestats.snapshot()
+        res = optimize(m, CFG, goals, opts)
+        delta = compilestats.delta(before, compilestats.snapshot())
+    assert delta["backend_compiles"] == 0, delta
+    conv = res.convergence
+    assert conv["goals"] == list(goals)
+    for phase in ("anneal", "polish", "portfolio", "swap-polish"):
+        segs = conv["phases"][phase]
+        assert segs and segs[-1]["series"]
+        assert len(segs[-1]["series"][0]) == len(goals)
+    assert res.to_json(include_proposals=False)["convergence"] is conv
+    # the advisor's table digests the block
+    rows = phase_table(conv)
+    assert {r["phase"] for r in rows} >= {"anneal", "polish"}
+    assert 0.0 <= total_wasted_fraction(conv) <= 1.0
+    # the plateau gauge landed (phase-labeled)
+    from ccx.common.metrics import REGISTRY
+
+    text = REGISTRY.render_prometheus()
+    assert 'ccx_convergence_plateau_step{phase="anneal"}' in text
+
+
+def test_taps_off_restores_pretelemetry_result():
+    """observability.convergence=false end-to-end: no convergence block,
+    no convergence key in to_json."""
+    from ccx.optimizer import OptimizeOptions, optimize
+
+    m = small_deterministic()
+    goals = ("StructuralFeasibility", "ReplicaDistributionGoal")
+    opts = OptimizeOptions(
+        anneal=AnnealOptions(n_chains=2, n_steps=8, chunk_steps=4),
+        polish=GreedyOptions(n_candidates=8, max_iters=4, chunk_iters=2),
+        require_hard_zero=False, run_cold_greedy=True,
+        topic_rebalance_rounds=0, swap_polish_iters=4,
+    )
+    with telemetry.taps(False):
+        res = optimize(m, CFG, goals, opts)
+    assert res.convergence is None
+    assert "convergence" not in res.to_json(include_proposals=False)
+
+
+# ----- heartbeat energy: recorder, summarize join, /observability -----------
+
+
+def test_heartbeat_energy_reaches_recorder_and_timeline(tmp_path):
+    path = tmp_path / "conv.jsonl"
+    m = small_deterministic()
+    goals = ("StructuralFeasibility", "ReplicaDistributionGoal")
+    TRACER.arm(str(path))
+    try:
+        with telemetry.taps(True):
+            greedy_optimize(m, CFG, goals, GreedyOptions(
+                n_candidates=8, max_iters=6, patience=3, chunk_iters=2))
+    finally:
+        TRACER.disarm()
+    chunks = [
+        json.loads(ln) for ln in path.read_text().splitlines()
+        if json.loads(ln).get("ev") == "chunk"
+    ]
+    assert chunks, "no chunk heartbeats recorded"
+    with_energy = [c for c in chunks if "energy" in c]
+    # the descent syncs every chunk, so every heartbeat carries energy
+    assert with_energy == chunks
+    # ... and the tracer's per-job timeline + VIEWER summary picked it up
+    timeline = TRACER.convergence_timeline()
+    assert timeline.get("") and timeline[""][-1]["energy"] is not None
+    summary = TRACER.convergence_summary()
+    assert summary[""]["beats"] >= 1
+    assert "activeSpans" not in TRACER.observability_summary()
+    assert "convergence" in TRACER.observability_summary()
+    assert "convergence" in TRACER.observability_json()
+
+
+def test_summarize_joins_energy_and_plateau_on_open_spans(tmp_path):
+    """A wedged window's diagnosis prices QUALITY: the open span joins
+    its last-known energy and plateau chunk from the heartbeat stream."""
+    from ccx.common import tracing
+
+    path = tmp_path / "wedge.jsonl"
+    lines = [
+        {"ev": "arm", "pid": 1, "v": 1},
+        {"ev": "start", "span": "optimize/anneal"},
+        {"ev": "chunk", "span": "optimize/anneal", "chunk": 0,
+         "energy": 9.0},
+        {"ev": "chunk", "span": "optimize/anneal", "chunk": 1,
+         "energy": 4.0},
+        {"ev": "chunk", "span": "optimize/anneal", "chunk": 2,
+         "energy": 4.0},
+        {"ev": "chunk", "span": "optimize/anneal", "chunk": 3,
+         "energy": 4.0},
+        # no end record: the window died here
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+    s = tracing.summarize(str(path))
+    assert s["openSpans"] == ["optimize/anneal"]
+    conv = s["convergence"]["optimize/anneal"]
+    assert conv["energy"] == 4.0 and conv["chunk"] == 3
+    assert conv["plateauChunk"] == 1 and conv["chunksSeen"] == 4
+    # human rendering + --json CLI both cover the join
+    text = tracing.render_summary(s)
+    assert "last energy 4.0" in text and "plateau at chunk 1" in text
+    rc = tracing.main([str(path), "--json"])
+    assert rc == 0
+
+
+# ----- Prometheus: labeled gauges in strict exposition form -----------------
+
+
+def test_labeled_convergence_gauges_strict_exposition():
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    from test_observability import _parse_exposition
+
+    from ccx.common.metrics import MetricsRegistry
+
+    reg = MetricsRegistry(prefix="t")
+    reg.set_gauge("convergence-energy", 212.5, labels={"job": "c-1"},
+                  help="live energy")
+    reg.set_gauge("convergence-energy", 99.0, labels={"job": 'we"ird'})
+    reg.set_gauge("convergence-plateau-step", 7.0,
+                  labels={"job": "c-1", "phase": "anneal"})
+    reg.set_gauge("convergence-plateau-step", 2.0, labels={"phase": "polish"})
+    fams = _parse_exposition(reg.render_prometheus())
+    fam = fams["t_convergence_energy"]
+    assert fam["type"] == "gauge"
+    samples = fam["samples"]["t_convergence_energy"]
+    assert sorted(v for _, v in samples) == [99.0, 212.5]
+    assert any('job="c-1"' in (lab or "") for lab, _ in samples)
+    steps = fams["t_convergence_plateau_step"]["samples"][
+        "t_convergence_plateau_step"
+    ]
+    assert sorted(v for _, v in steps) == [2.0, 7.0]
+    # the full process registry (with every default family) still parses
+    from ccx.common.metrics import REGISTRY
+
+    _parse_exposition(REGISTRY.render_prometheus())
+
+
+# ----- wire face ------------------------------------------------------------
+
+
+def test_heartbeat_frame_energy_additive():
+    from ccx.sidecar import wire
+
+    f = wire.heartbeat_frame("anneal chunk 4", span="optimize/anneal",
+                             chunk=4, total=500, energy=212.5)
+    assert f["energy"] == 212.5 and f["wire"] == wire.WIRE_VERSION
+    decoded = wire.decode_frame(wire.pack_frame(f))
+    assert decoded["energy"] == 212.5
+    # absent stays absent — legacy frames byte-stable
+    assert "energy" not in wire.heartbeat_frame("x", chunk=1)
+    # the result's convergence block is VOLATILE in golden fixtures
+    sys.path.insert(
+        0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+    )
+    import gen_wire_fixtures as gen
+
+    assert "convergence" in gen.VOLATILE
+
+
+# ----- budget advisor + ledger warning --------------------------------------
+
+
+def _synthetic_convergence(waste_high: bool) -> dict:
+    flat = [[5.0, 3.0]] * 8
+    improving = [[9.0 - i, 3.0] for i in range(8)]
+    return {
+        "goals": ["A", "B"],
+        "phases": {
+            "anneal": [{
+                "goals": ["A", "B"], "chunks": 8, "truncated": False,
+                "series": ([[9.0, 3.0], [5.0, 3.0]] + flat[:6])
+                if waste_high else improving,
+                "proposed": [[i, 0, 0] for i in range(8)],
+                "accepted": [[i, 0, 0] for i in range(8)],
+                "temperature": [0.1] * 8,
+                "chunk": 250, "budget": 2000,
+            }],
+        },
+    }
+
+
+def test_convergence_report_renders_and_proposes(tmp_path, capsys):
+    sys.path.insert(
+        0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+    )
+    import convergence_report as cr
+
+    wrapper = {"n": 9, "parsed": {
+        "rung": "target", "value": 16.0, "backend": "cpu",
+        "convergence": _synthetic_convergence(waste_high=True),
+    }}
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(wrapper))
+    assert cr.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "target rung" in out and "anneal" in out
+    # plateau at chunk 1 of 8 → 6/7 ≈ 86% past plateau, flagged
+    assert "86%" in out and "⚠" in out
+    # proposed budget: 2 chunks x 250 x 1.25 = 625
+    assert "625" in out
+    assert cr.main(["--dir", str(tmp_path), "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows[0]["phases"][0]["plateauChunk"] == 1
+    assert rows[0]["phases"][0]["proposedBudget"] == 625
+
+
+def test_convergence_report_flight_mode(tmp_path, capsys):
+    sys.path.insert(
+        0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+    )
+    import convergence_report as cr
+
+    path = tmp_path / "flight.jsonl"
+    recs = [{"ev": "arm", "pid": 1}]
+    for i, e in enumerate([9.0, 4.0, 4.0, 4.0]):
+        recs.append({"ev": "chunk", "span": "optimize/anneal",
+                     "chunk": i, "energy": e})
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    assert cr.main(["--flight", str(path), "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows[0]["span"] == "optimize/anneal"
+    assert rows[0]["plateauChunk"] == 1
+    assert rows[0]["wastedFraction"] == pytest.approx(2 / 3, abs=1e-4)
+
+
+def test_ledger_warns_not_fails_on_wasted_budget(tmp_path, capsys):
+    sys.path.insert(
+        0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+    )
+    import bench_ledger
+
+    line = {
+        "metric": "B5 ...", "value": 16.0, "unit": "s",
+        "verified": True, "verification_failures": [],
+        "proposals": 60000, "cold_s": 20.0, "backend": "cpu",
+        "rung": "target", "effort": {"chains": 16},
+        "goals": {"TopicReplicaDistributionGoal": {"violations": [1.0, 0.0]}},
+        "convergence": _synthetic_convergence(waste_high=True),
+    }
+    (tmp_path / "BENCH_r09.json").write_text(
+        json.dumps({"n": 9, "parsed": line})
+    )
+    rc = bench_ledger.main(["--dir", str(tmp_path), "--check"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.err  # WARN must not fail the gate
+    assert "LEDGER WARN" in captured.err
+    assert "past plateau" in captured.err
+    # trend table shows the new columns
+    assert bench_ledger.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "plateau" in out and "past%" in out and "86%" in out
+    # a low-waste round warns nothing
+    line["convergence"] = _synthetic_convergence(waste_high=False)
+    (tmp_path / "BENCH_r09.json").write_text(
+        json.dumps({"n": 9, "parsed": line})
+    )
+    rc = bench_ledger.main(["--dir", str(tmp_path), "--check"])
+    captured = capsys.readouterr()
+    assert rc == 0 and "LEDGER WARN" not in captured.err

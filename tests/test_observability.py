@@ -374,15 +374,28 @@ def _parse_exposition(text: str) -> dict:
             sample == fam + sfx for sfx in ok_suffixes
         ), f"sample {sample} outside family {fam}"
         current["samples"].setdefault(sample, []).append((labels, value))
+    def _series_key(labels: str | None) -> tuple:
+        pairs = re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels or "")
+        return tuple(sorted(p for p in pairs if p[0] != "le"))
+
     for fam in families.values():
         if fam["type"] == "histogram":
-            buckets = fam["samples"][fam["name"] + "_bucket"]
-            les = [lab.split('"')[1] for lab, _ in buckets]
-            counts = [v for _, v in buckets]
-            assert les[-1] == "+Inf"
-            assert counts == sorted(counts), "buckets must be cumulative"
-            (_, total), = fam["samples"][fam["name"] + "_count"]
-            assert counts[-1] == total, "+Inf bucket != count"
+            # Cumulative semantics hold PER label-series: a family may carry
+            # one bucket ladder per label set (e.g. fleet's per-job series).
+            series: dict[tuple, list] = {}
+            for lab, v in fam["samples"][fam["name"] + "_bucket"]:
+                le = re.search(r'le="((?:[^"\\]|\\.)*)"', lab).group(1)
+                series.setdefault(_series_key(lab), []).append((le, v))
+            totals = {_series_key(lab): v
+                      for lab, v in fam["samples"][fam["name"] + "_count"]}
+            assert set(series) == set(totals), \
+                f"bucket/count label-series mismatch in {fam['name']}"
+            for key, buckets in series.items():
+                les = [le for le, _ in buckets]
+                counts = [v for _, v in buckets]
+                assert les[-1] == "+Inf"
+                assert counts == sorted(counts), "buckets must be cumulative"
+                assert counts[-1] == totals[key], "+Inf bucket != count"
     return families
 
 

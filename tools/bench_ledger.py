@@ -55,6 +55,18 @@ import os
 import re
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # standalone runs start with tools/ as path[0]
+    sys.path.insert(0, _REPO)
+
+# plateau math + the WASTE_WARN advisory threshold shared with
+# tools/convergence_report.py — stdlib-only (ccx.common.convergence
+# imports no jax/numpy), so the ledger stays dependency-light
+from ccx.common.convergence import (  # noqa: E402
+    WASTE_WARN,
+    total_wasted_fraction,
+)
+
 #: --check thresholds: wall regression gate vs the best comparable banked
 #: round, and the per-goal quality envelope (relative + absolute slack —
 #: small violation counts jitter by a few moves run to run)
@@ -133,6 +145,7 @@ def load_rows(root: str) -> tuple[list[dict], list[dict]]:
             "goals_after": _goals_after(p.get("goals") or {}),
             "samples": None,
             "cost_model": None,
+            "convergence": None,
         })
     return rows, partials
 
@@ -163,6 +176,7 @@ def _row_from_line(line: dict, rnd: int, source: str) -> dict:
         "goals_after": _goals_after(line.get("goals") or {}),
         "samples": line.get("samples"),
         "cost_model": line.get("costModel"),
+        "convergence": line.get("convergence"),
     }
 
 
@@ -468,12 +482,30 @@ def _model_vs_wall(row: dict) -> str:
     return f"{s:.2f}s ({s / row['wall'] * 100:.0f}%)"
 
 
+def _convergence_cells(row: dict) -> tuple[str, str]:
+    """(plateau, past-plateau %) trend cells from a line's convergence
+    block (ccx.search.telemetry). The plateau cell shows the ANNEAL
+    phase's plateau chunk (the headline budget knob); the past% cell is
+    the whole run's chunk budget spent past plateau across every phase."""
+    conv = row.get("convergence")
+    if not conv:
+        return "-", "-"
+    from ccx.common.convergence import plateau_chunk
+
+    plateau = "-"
+    anneal = (conv.get("phases") or {}).get("anneal") or []
+    if anneal and anneal[-1].get("series"):
+        plateau = str(plateau_chunk(anneal[-1]["series"]))
+    wf = total_wasted_fraction(conv)
+    return plateau, f"{wf * 100:.0f}%"
+
+
 def render_table(rows: list[dict], partials: list[dict]) -> str:
     out = []
     headers = ["round", "rung", "backend", "wall s", "cold s", "ok",
                "proposals", "samples"]
     headers += [k for k, _ in QUALITY_CELLS]
-    headers += ["model/wall"]
+    headers += ["model/wall", "plateau", "past%"]
     body = []
     for r in sorted(rows, key=lambda r: (r["round"] is None, r["round"] or 0,
                                          r["rung"])):
@@ -487,6 +519,7 @@ def render_table(rows: list[dict], partials: list[dict]) -> str:
         for _, goal in QUALITY_CELLS:
             cells.append(_fmt(r["goals_after"].get(goal), 0))
         cells.append(_model_vs_wall(r))
+        cells.extend(_convergence_cells(r))
         body.append(cells)
     widths = [max(len(h), *(len(row[i]) for row in body)) if body else len(h)
               for i, h in enumerate(headers)]
@@ -499,7 +532,10 @@ def render_table(rows: list[dict], partials: list[dict]) -> str:
             out.append(f"partial: {p['file']} — {p['why']}")
     out.append("")
     out.append("backend* = fallback applied (see backend_detail); "
-               "model/wall = roofline-projected device seconds vs wall")
+               "model/wall = roofline-projected device seconds vs wall; "
+               "plateau = anneal-phase plateau chunk, past% = chunk "
+               "budget spent past plateau (convergence taps — "
+               "tools/convergence_report.py for the full advisor table)")
     return "\n".join(out)
 
 
@@ -560,6 +596,32 @@ def check(rows: list[dict], partials: list[dict]) -> list[str]:
                     f"limit {limit:.1f})"
                 )
     return failures
+
+
+def warn_convergence(rows: list[dict]) -> list[str]:
+    """Advisory (never-failing) past-plateau check: a LATEST-round banked
+    rung whose convergence block shows >WASTE_WARN of its chunk budget
+    spent past plateau gets a WARNING naming the advisor tool. Old rounds
+    (no convergence block) and partials are skipped — the warning prices
+    waste on fresh evidence only."""
+    warnings: list[str] = []
+    banked = [r for r in rows if r["round"] is not None]
+    if not banked:
+        return warnings
+    latest_round = max(r["round"] for r in banked)
+    for r in (r for r in banked if r["round"] == latest_round):
+        conv = r.get("convergence")
+        if not conv:
+            continue
+        wf = total_wasted_fraction(conv)
+        if wf > WASTE_WARN:
+            warnings.append(
+                f"round {r['round']} {r['rung']}: {wf:.0%} of chunk "
+                f"budget spent past plateau (advisory threshold "
+                f"{WASTE_WARN:.0%}) — run tools/convergence_report.py "
+                "for per-phase retuned budget proposals"
+            )
+    return warnings
 
 
 # ----- --roofline ------------------------------------------------------------
@@ -680,6 +742,10 @@ def main(argv=None) -> int:
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
+        # advisory only — a wasteful budget is a retune opportunity, not
+        # a regression; WARNs never flip the exit code
+        for w in warn_convergence(rows):
+            print(f"LEDGER WARN: {w}", file=sys.stderr)
         if failures:
             return 1
         n = len([r for r in rows if r["round"] is not None])

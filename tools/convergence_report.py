@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Convergence report / budget advisor — plateau analysis over banked
+convergence telemetry (ISSUE 9).
+
+The convergence taps (``ccx.search.telemetry``) record, per chunk of every
+chunk-driven search phase, the full per-goal lex cost vector; this tool
+turns those series into the evidence a budget retune needs:
+
+* **plateau step** per phase — the chunk after which the lex vector
+  stopped improving beyond tolerance (``ccx.common.convergence``);
+* a **wasted-budget table** — "swap_polish spent 43% of its steps past
+  plateau";
+* **proposed per-phase budgets** — budget units through the plateau plus
+  a 25% safety margin, never above the configured budget.
+
+Inputs (any mix):
+
+* ``BENCH_r*.json`` / ``CONVERGENCE_*.json`` under ``--dir`` (default:
+  repo root) — lines whose ``convergence`` block the taps populated
+  (BENCH rounds banked before round 13 carry none and are skipped);
+* explicit artifact paths as positional arguments;
+* ``--flight recording.jsonl`` — a flight-recorder file: the per-span
+  heartbeat ENERGY series (tier-0 only — coarser than the full lex
+  vector, but available even for a run that died mid-phase). The
+  campaign runs this form over its recording at campaign end.
+
+Dependency-light (stdlib + ``ccx.common.convergence``, which is stdlib-
+only) so it runs instantly in a dying TPU window, next to the bench
+ledger.
+
+Also: ``--bank B5 --rungs target,lean`` runs the named bench rungs
+in-process with taps armed and banks ``CONVERGENCE_<config>.json`` — the
+artifact form used to analyze the banked B5 target/lean rungs without
+re-banking a whole BENCH round (that path imports jax/bench).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # standalone runs start with tools/ as path[0]
+    sys.path.insert(0, _REPO)
+
+from ccx.common.convergence import (  # noqa: E402
+    WASTE_WARN,
+    phase_table,
+    plateau_chunk,
+    total_wasted_fraction,
+)
+
+
+def _fmt(v, nd=1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _load_lines(root: str, paths: list[str]) -> list[dict]:
+    """Flatten artifacts into ``{"source", "rung", "convergence", ...}``
+    rows. Accepts BENCH wrapper form ({"parsed": line}), bare BENCH
+    lines, and CONVERGENCE_*.json ({"rungs": {rung: {...}}})."""
+    if not paths:
+        paths = sorted(
+            glob.glob(os.path.join(root, "BENCH_r*.json"))
+            + glob.glob(os.path.join(root, "CONVERGENCE_*.json"))
+        )
+    rows: list[dict] = []
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            d = json.load(open(path))
+        except (OSError, ValueError) as e:
+            print(f"skipping {name}: {e}", file=sys.stderr)
+            continue
+        if isinstance(d.get("rungs"), dict):  # CONVERGENCE_*.json
+            for rung, line in d["rungs"].items():
+                if line.get("convergence"):
+                    rows.append({
+                        "source": name,
+                        "rung": rung,
+                        "backend": d.get("backend", line.get("backend")),
+                        "wall": line.get("wall_s"),
+                        "convergence": line["convergence"],
+                    })
+            continue
+        line = d.get("parsed") if "parsed" in d else d
+        if isinstance(line, dict) and line.get("convergence"):
+            rows.append({
+                "source": name,
+                "rung": line.get("rung", "?"),
+                "backend": line.get("backend"),
+                "wall": line.get("value"),
+                "convergence": line["convergence"],
+            })
+    return rows
+
+
+def analyze(rows: list[dict]) -> list[dict]:
+    out = []
+    for r in rows:
+        out.append({
+            "source": r["source"],
+            "rung": r["rung"],
+            "backend": r.get("backend"),
+            "wall": r.get("wall"),
+            "phases": phase_table(r["convergence"]),
+            "totalWastedFraction": round(
+                total_wasted_fraction(r["convergence"]), 4
+            ),
+        })
+    return out
+
+
+def render(analyzed: list[dict]) -> str:
+    if not analyzed:
+        return (
+            "no artifact carries a convergence block yet — run the bench "
+            "at HEAD (taps are on by default), or bank one with "
+            "`python tools/convergence_report.py --bank B5`"
+        )
+    out: list[str] = []
+    for a in analyzed:
+        head = (
+            f"{a['source']} · {a['rung']} rung"
+            + (f" ({a['backend']})" if a.get("backend") else "")
+            + (f" · wall {_fmt(a['wall'], 1)}s" if a.get("wall") else "")
+        )
+        out.append(head)
+        headers = ["phase", "chunks", "plateau", "past-plateau",
+                   "chunk", "budget", "proposed"]
+        body = []
+        for p in a["phases"]:
+            wf = p["wastedFraction"]
+            body.append([
+                p["phase"] + (" (trunc)" if p["truncated"] else ""),
+                _fmt(p["chunks"], 0),
+                _fmt(p["plateauChunk"], 0),
+                f"{wf * 100:.0f}%" + (" ⚠" if wf > WASTE_WARN else ""),
+                _fmt(p["chunkSize"], 0),
+                _fmt(p["budget"], 0),
+                _fmt(p["proposedBudget"], 0),
+            ])
+        widths = [
+            max(len(h), *(len(row[i]) for row in body)) if body else len(h)
+            for i, h in enumerate(headers)
+        ]
+        out.append("  " + "  ".join(
+            h.ljust(w) for h, w in zip(headers, widths)
+        ))
+        for row in body:
+            out.append("  " + "  ".join(
+                c.ljust(w) for c, w in zip(row, widths)
+            ))
+        tw = a["totalWastedFraction"]
+        flag = " — ⚠ past the {:.0f}% advisory".format(
+            WASTE_WARN * 100
+        ) if tw > WASTE_WARN else ""
+        out.append(
+            f"  total: {tw * 100:.0f}% of chunk budget spent past "
+            f"plateau{flag}"
+        )
+        out.append(
+            "  proposed = budget units through the plateau chunk x1.25, "
+            "capped at the configured budget"
+        )
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
+# ----- flight-recorder mode --------------------------------------------------
+
+
+def analyze_flight(path: str) -> list[dict]:
+    """Per-span plateau analysis over a flight recording's heartbeat
+    ENERGY series (tier-0 only — what the recorder streams live). Each
+    ``arm`` record starts a fresh segment, mirroring ``tracing.
+    summarize``; spans are reported per segment so a campaign file's
+    crashed rung and healthy rerun stay separate."""
+    out: list[dict] = []
+    seg = 0
+    series: dict[str, list] = {}
+
+    def flush():
+        for span, vals in series.items():
+            if len(vals) < 2:
+                continue
+            p = plateau_chunk(vals)
+            out.append({
+                "run": seg,
+                "span": span,
+                "chunks": len(vals),
+                "plateauChunk": p,
+                "wastedFraction": round(
+                    (len(vals) - 1 - p) / (len(vals) - 1), 4
+                ),
+                "lastEnergy": vals[-1],
+            })
+
+    try:
+        f = open(path, encoding="utf-8", errors="replace")
+    except OSError as e:
+        print(f"cannot read flight record {path}: {e}", file=sys.stderr)
+        return out
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("ev") == "arm":
+                flush()
+                series = {}
+                seg += 1
+            elif r.get("ev") == "chunk" and r.get("energy") is not None:
+                series.setdefault(r.get("span", "?"), []).append(
+                    r["energy"]
+                )
+    flush()
+    return out
+
+
+def render_flight(rows: list[dict], path: str) -> str:
+    if not rows:
+        return (
+            f"{os.path.basename(path)}: no heartbeat energies recorded "
+            "(taps off, or the run died before its first chunk)"
+        )
+    out = [f"flight-record convergence ({os.path.basename(path)}):"]
+    headers = ["run", "span", "chunks", "plateau", "past-plateau",
+               "last energy"]
+    body = [
+        [
+            _fmt(r["run"], 0), r["span"], _fmt(r["chunks"], 0),
+            _fmt(r["plateauChunk"], 0),
+            f"{r['wastedFraction'] * 100:.0f}%"
+            + (" ⚠" if r["wastedFraction"] > WASTE_WARN else ""),
+            _fmt(r["lastEnergy"], 2),
+        ]
+        for r in rows
+    ]
+    widths = [
+        max(len(h), *(len(row[i]) for row in body))
+        for i, h in enumerate(headers)
+    ]
+    out.append("  " + "  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for row in body:
+        out.append("  " + "  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    out.append(
+        "  (tier-0 energy only — full per-goal series ride the BENCH/"
+        "CONVERGENCE artifacts)"
+    )
+    return "\n".join(out)
+
+
+# ----- --bank ----------------------------------------------------------------
+
+
+def bank(config: str, rungs: list[str], out_path: str | None,
+         samples: int = 1) -> str:
+    """Run the named bench rungs in-process with taps armed and bank
+    their convergence blocks as ``CONVERGENCE_<config>.json`` — the
+    artifact the plateau analysis of the banked target/lean rungs reads
+    (docs/perf-notes.md). Warm-measured like the bench: one cold run
+    compiles, the banked block comes from a warm run."""
+    import time
+
+    from ccx.search import telemetry
+
+    telemetry.set_enabled(True)
+    import bench  # noqa: E402 — repo root on sys.path above
+    from ccx.goals.base import GoalConfig
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.optimizer import optimize
+
+    import jax
+
+    m = random_cluster(bench_spec(config))
+    out: dict = {
+        "config": config,
+        "backend": jax.default_backend(),
+        "rungs": {},
+    }
+    for rung in rungs:
+        goal_names, opts, effort = bench.build_opts(config, rung)
+        cfg = GoalConfig()
+        print(f"[bank] {config}:{rung} cold run (compiles)...",
+              file=sys.stderr, flush=True)
+        optimize(m, cfg, goal_names, opts)
+        walls, res = [], None
+        for i in range(max(samples, 1)):
+            t0 = time.monotonic()
+            res = optimize(m, cfg, goal_names, opts)
+            walls.append(time.monotonic() - t0)
+            print(f"[bank] {config}:{rung} warm {walls[-1]:.1f}s",
+                  file=sys.stderr, flush=True)
+        out["rungs"][rung] = {
+            "wall_s": round(min(walls), 3),
+            "effort": effort,
+            "verified": bool(res.verification.ok),
+            "convergence": res.convergence,
+        }
+    path = out_path or os.path.join(_REPO, f"CONVERGENCE_{config}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifacts", nargs="*",
+                    help="explicit artifact paths (default: scan --dir)")
+    ap.add_argument("--dir", default=_REPO)
+    ap.add_argument("--flight", metavar="JSONL",
+                    help="analyze a flight-recorder file's heartbeat "
+                         "energies instead of banked artifacts")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--bank", metavar="CONFIG",
+                    help="run bench rungs in-process (taps armed) and "
+                         "bank CONVERGENCE_<CONFIG>.json")
+    ap.add_argument("--rungs", default="target,lean",
+                    help="comma-separated rungs for --bank")
+    ap.add_argument("--out", help="output path for --bank")
+    ap.add_argument("--samples", type=int, default=1,
+                    help="warm samples per rung for --bank")
+    args = ap.parse_args(argv)
+
+    if args.bank:
+        path = bank(
+            args.bank, [r for r in args.rungs.split(",") if r],
+            args.out, samples=args.samples,
+        )
+        print(f"banked {path}")
+        rows = _load_lines("", [path])
+        print(json.dumps(analyze(rows), indent=1) if args.json
+              else render(analyze(rows)))
+        return 0
+    if args.flight:
+        rows = analyze_flight(args.flight)
+        print(json.dumps(rows, indent=1) if args.json
+              else render_flight(rows, args.flight))
+        return 0
+    rows = _load_lines(os.path.abspath(args.dir), args.artifacts)
+    analyzed = analyze(rows)
+    print(json.dumps(analyzed, indent=1) if args.json
+          else render(analyzed))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,8 +46,12 @@ _BENCH_KNOBS = ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
 #: and roofline projections, machine- and backend-dependent by
 #: construction; mesh is the r11 mesh-sharded-run block — mesh shape and
 #: live sharded-program cache occupancy, absent on single-device runs and
-#: machine-dependent when present)
-VOLATILE = ("wallSeconds", "phaseSeconds", "spanTree", "costModel", "mesh")
+#: machine-dependent when present; convergence is the r13 telemetry block
+#: — per-chunk search-trajectory series, run-dependent by construction)
+VOLATILE = (
+    "wallSeconds", "phaseSeconds", "spanTree", "costModel", "mesh",
+    "convergence",
+)
 
 #: the round-12 fleet envelopes (cluster_id / priority — additive fields,
 #: wire version unchanged) get their OWN fixtures; the legacy four stay

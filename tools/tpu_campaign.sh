@@ -148,6 +148,15 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # when a wedge cut the campaign short and this block never ran — the
   # JSONL itself is the artifact; this summary is a convenience)
   timeout -k 10 60 python -m ccx.common.tracing "$CCX_FLIGHT_RECORDER"
+  echo "--- convergence / wasted-budget table (budget advisor) ---"
+  # plateau analysis over the SAME flight record (per-span heartbeat
+  # energies: which phase of which rung kept burning chunks past its
+  # plateau) plus the banked-artifact advisor table — the evidence for
+  # shrinking rung budgets toward the <5 s T1 without quality risk
+  # (tools/convergence_report.py; full per-goal series ride the BENCH
+  # lines this campaign just banked)
+  timeout -k 10 60 python tools/convergence_report.py --flight "$CCX_FLIGHT_RECORDER"
+  timeout -k 10 120 python tools/convergence_report.py
   echo "--- bench ledger (trend + regression gate + roofline) ---"
   # the cross-round view of what this campaign just banked next to every
   # earlier round, the >10%-wall / quality-envelope tripwires, and the
