@@ -1026,6 +1026,247 @@ def run_fleet(name: str, n_jobs: int) -> None:
     print(_state["final_json"], flush=True)
 
 
+def _steady_options() -> dict:
+    """The steady rung's warm-path engine options (wire schema keys):
+    the incremental warm budget. Fixed (not env-tunable) so
+    STEADY_r*.json rounds stay comparable."""
+    return {
+        # 8 iterations is the <500 ms operating point on the banked host
+        # (r14: ~18 ms/iteration at B5 CPU on top of the ~360 ms fused
+        # init/finish + verify floor; 12 iters measured ~+70 ms for ~35 %
+        # more applied moves — the quality tripwire pins 8 within
+        # tolerance of from-scratch)
+        "warm_swap_iters": 8, "warm_swap_patience": 3,
+        "warm_swap_candidates": 32,
+        "warm_steps": 100, "warm_chunk_steps": 25, "warm_chains": 2,
+        "warm_moves": 8, "plateau_window": 1,
+    }
+
+
+def run_steady(name: str, n_iters: int, drift: float = 0.01) -> None:
+    """``--steady`` / CCX_BENCH_STEADY: steady-state incremental
+    re-proposals under live metrics drift (ISSUE 10; ROADMAP "Incremental
+    re-optimization under live drift").
+
+    Drives the full steady-state serving loop through a real localhost
+    gRPC sidecar and prints ONE JSON line — the STEADY_r*.json artifact
+    ``tools/bench_ledger.py`` trends and gates:
+
+    1. full snapshot up (gen 1) + one COLD from-scratch Propose at the
+       official target-rung effort — the baseline wall and the first
+       converged placement (the sidecar banks it as the warm base);
+    2. the "cluster" applies the proposal: a gen-2 full snapshot whose
+       placement is the converged one;
+    3. one un-timed warm iteration pays the warm pipeline's compiles
+       (prewarm — the zero-warm-fresh-compile tripwire arms after it);
+    4. N measured windows: perturb ``drift`` of the partitions' metrics,
+       send a METRICS-ONLY delta PutSnapshot (grafted onto the resident
+       device model — no rebuild), then a ``warm_start`` Propose resolved
+       by (session, base_generation). p50/p99 of the warm walls are the
+       headline; every window must verify and the measured loop must pay
+       zero fresh compiles.
+
+    Acceptance target (ROADMAP): warm re-proposal < 500 ms at B5 on this
+    host for a 1 % drift — fast enough to run on every metrics window.
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from ccx.common import compilestats, costmodel
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.model.snapshot import (
+        delta_encode,
+        model_to_arrays,
+        pack_arrays,
+        to_msgpack,
+    )
+    from ccx.search import incremental as incr
+    from ccx.sidecar.client import SidecarClient
+    from ccx.sidecar.server import OptimizerSidecar, make_grpc_server
+
+    if os.environ.get("CCX_COST_CAPTURE") != "0":
+        costmodel.set_capture(True)
+    session = f"steady-{name}"
+    warm_opts = _steady_options()
+
+    enter_phase(f"steady:{name}:model")
+    spec = bench_spec(name)
+    m0 = random_cluster(spec)
+    goal_names, cold_opts, cold_effort = build_opts(name, "target")
+    cold_wire = _wire_options(cold_opts)
+
+    sidecar = OptimizerSidecar()
+    server, port = make_grpc_server(sidecar, address="127.0.0.1:0")
+    server.start()
+    client = SidecarClient(f"127.0.0.1:{port}")
+    log(f"[steady] sidecar on port {port} ({jax.default_backend()})")
+
+    enter_phase(f"steady:{name}:cold")
+    client.put_snapshot(None, session=session, generation=1,
+                        packed=to_msgpack(m0))
+    t0 = time.monotonic()
+    cold_res = client.propose(
+        session=session, goals=goal_names, columnar=True,
+        on_progress=lambda p: enter_phase(f"steady:{name}:{p}"),
+        **cold_wire,
+    )
+    cold_s = time.monotonic() - t0
+    log(f"[steady] cold propose {cold_s:.1f}s "
+        f"verified={cold_res['verified']}")
+
+    # the "cluster" applies the proposal: gen-2 snapshot with the
+    # converged placement (read from the in-process store — the sidecar
+    # banked it as the session's warm base) and the same metrics
+    warm_base = incr.STORE.get(session)
+    if warm_base is None:
+        raise SystemExit("[steady] sidecar banked no warm base — is "
+                         "CCX_INCREMENTAL=0 set?")
+    m_applied = m0.replace(
+        assignment=warm_base.assignment,
+        leader_slot=warm_base.leader_slot,
+        replica_disk=warm_base.replica_disk,
+    )
+    arrays = model_to_arrays(m_applied)
+    client.put_snapshot(None, session=session, generation=2,
+                        packed=to_msgpack(m_applied))
+    base_gen = 1  # the store's generation after the cold propose
+    gen = 2
+
+    rng = np.random.default_rng(123)
+    p_real = int(np.asarray(m0.partition_valid).sum())
+    n_drift = max(int(p_real * drift), 1)
+
+    def drift_window() -> dict:
+        """One metrics window: perturb `drift` of the partitions' loads
+        (±50 %, lognormal-ish), returning the delta-encoded arrays."""
+        new = dict(arrays)
+        idx = rng.choice(p_real, n_drift, replace=False)
+        for field in ("leader_load", "follower_load"):
+            a = np.asarray(arrays[field], np.float32).copy()
+            a[:, idx] *= rng.uniform(0.5, 1.5, size=(1, n_drift)).astype(
+                np.float32
+            )
+            new[field] = a
+        return new
+
+    def warm_propose() -> dict:
+        t0 = time.monotonic()
+        res = client.propose(
+            session=session, goals=goal_names, columnar=True,
+            warm_start=True, base_generation=base_gen,
+            **{**cold_wire, **warm_opts},
+        )
+        return {
+            "wall": time.monotonic() - t0,
+            "verified": bool(res["verified"]),
+            "proposals": int(res["numProposals"]),
+            "incremental": res.get("incremental"),
+            "convergence": res.get("convergence"),
+        }
+
+    def put_drift() -> float:
+        nonlocal arrays, gen
+        new = drift_window()
+        delta = delta_encode(arrays, new)
+        t0 = time.monotonic()
+        client.put_snapshot(None, session=session, generation=gen + 1,
+                            packed=pack_arrays(delta), is_delta=True,
+                            base_generation=gen)
+        gen += 1
+        arrays = new
+        return time.monotonic() - t0
+
+    # prewarm: the warm pipeline's (small) program set compiles once here
+    enter_phase(f"steady:{name}:prewarm")
+    put_drift()
+    r = warm_propose()
+    base_gen = gen
+    log(f"[steady] prewarm warm propose {r['wall']:.2f}s "
+        f"(compiles paid here) inc={r['incremental']}")
+
+    enter_phase(f"steady:{name}:measured")
+    # steady-state serving posture: the resident program set is fully
+    # built after the prewarm window — freeze it out of the cycle
+    # collector so a gen-2 sweep (~250 ms here, the lone p99 outlier)
+    # never lands inside a measured window. The standalone sidecar does
+    # the same at startup (server.freeze_gc_steady_state).
+    from ccx.sidecar.server import freeze_gc_steady_state
+
+    freeze_gc_steady_state()
+    cs0 = compilestats.snapshot()
+    windows = []
+    for i in range(max(n_iters, 1)):
+        put_s = put_drift()
+        r = warm_propose()
+        base_gen = gen
+        r["put_s"] = put_s
+        windows.append(r)
+        log(f"[steady] window {i + 1}/{n_iters}: put={put_s * 1e3:.0f}ms "
+            f"warm={r['wall'] * 1e3:.0f}ms verified={r['verified']} "
+            f"diff={r['proposals']}")
+    cs1 = compilestats.snapshot()
+    warm_compiles = compilestats.delta(cs0, cs1)
+    zero_warm = warm_compiles.get("backend_compiles", 0) == 0
+
+    walls = sorted(r["wall"] for r in windows)
+    p50 = statistics.median(walls)
+    p99 = walls[min(int(round(0.99 * (len(walls) - 1))), len(walls) - 1)]
+    all_verified = all(r["verified"] for r in windows)
+    all_warm = all(
+        (r["incremental"] or {}).get("warmStart") for r in windows
+    )
+    last_inc = windows[-1]["incremental"]
+    out = {
+        "metric": (
+            f"{name} steady-state warm re-proposal wall through the "
+            f"sidecar ({drift:.0%} metrics drift per window, p99)"
+        ),
+        "value": round(p99, 3),
+        "unit": "s",
+        # headline ratio: cold from-scratch wall over warm p50 — what the
+        # warm-start control loop buys per window
+        "vs_baseline": round(cold_s / max(p50, 1e-9), 1),
+        "steady": True,
+        "config": name,
+        "n_iters": len(windows),
+        "drift_fraction": drift,
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count(),
+        "verified": bool(all_verified and all_warm and zero_warm),
+        "cold_s": round(cold_s, 2),
+        "warm": {
+            "p50_s": round(p50, 3),
+            "p99_s": round(p99, 3),
+            "mean_s": round(statistics.mean(walls), 3),
+            "walls": [round(w, 3) for w in walls],
+        },
+        "put_delta_s": round(
+            statistics.median(r["put_s"] for r in windows), 3
+        ),
+        "diff_rows": int(
+            statistics.median(r["proposals"] for r in windows)
+        ),
+        "all_warm_started": all_warm,
+        "zero_warm_fresh_compiles": zero_warm,
+        "compile_cache": {"measured": warm_compiles},
+        "incremental": last_inc,
+        # the last warm window's per-chunk lex series: the budget advisor
+        # (tools/convergence_report.py) prices warm-start budgets from it
+        "convergence": windows[-1].get("convergence"),
+        "registry": sidecar.registry.stats(),
+        "store": incr.STORE.stats(),
+        "effort": {**warm_opts, "cold": cold_effort,
+                   "n_iters": len(windows), "drift": drift},
+    }
+    client.close()
+    server.stop(0)
+    _state["done"] = True
+    _state["final_json"] = json.dumps(out)
+    print(_state["final_json"], flush=True)
+
+
 def run_mesh_bench(name: str) -> None:
     """CCX_BENCH_MESH=1: partition-axis-sharded anneal step slope at the
     config's shape over every visible device (SURVEY.md §5.7 — the
@@ -1112,8 +1353,37 @@ def main() -> None:
         "--fleet-jobs", type=int,
         default=int(os.environ.get("CCX_BENCH_FLEET_JOBS", "16")),
     )
+    ap.add_argument("--steady", action="store_true",
+                    default=os.environ.get("CCX_BENCH_STEADY") not in
+                    (None, "", "0"))
+    ap.add_argument(
+        "--steady-iters", type=int,
+        default=int(os.environ.get("CCX_BENCH_STEADY_ITERS", "20")),
+    )
     cli, _unknown = ap.parse_known_args()
     samples = max(cli.samples, 1)
+
+    if cli.steady:
+        # steady-state incremental re-proposal mode (STEADY_r*.json
+        # artifact): repeat warm_start Proposes per metrics window
+        # through the sidecar. Persistent compile cache like the ladder.
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(
+                "JAX_COMPILATION_CACHE_DIR",
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+                ),
+            ),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        name = os.environ.get("CCX_BENCH", "B5")
+        _state["name"] = name
+        run_steady(name, n_iters=max(cli.steady_iters, 1))
+        return
 
     if cli.fleet:
         # fleet serving mode (FLEET_r*.json artifact): concurrent Propose

@@ -368,6 +368,80 @@ def analyzer_config_def() -> ConfigDef:
              "captured working-set watermark), floor 64 MB "
              "(ccx.common.costmodel.fleet_snapshot_budget_bytes).",
              at_least(0))
+    d.define("optimizer.incremental.enabled", Type.BOOLEAN, False,
+             Importance.MEDIUM,
+             "Arm incremental re-optimization (ccx.search.incremental): "
+             "the facade's proposal verbs and the sidecar's warm-start "
+             "Propose path keep each cluster session's last converged "
+             "placement device-resident, re-score only drift-touched "
+             "bands on a new metrics window, warm-start the search from "
+             "the previous solution with a short plateau-terminated "
+             "budget, and emit the minimal diff. Off (default) restores "
+             "from-scratch proposals everywhere; env CCX_INCREMENTAL=0 "
+             "force-disables regardless of this key.")
+    d.define("optimizer.incremental.warm.swap.iters", Type.INT, 8,
+             Importance.LOW,
+             "Usage-coupled swap-polish iterations of a warm re-proposal "
+             "— the primary warm engine (pure lex descent over "
+             "pressure-ranked swaps + leadership transfers; re-scores "
+             "the band-pressure tables from carried aggregates each "
+             "iteration). 8 is the <500 ms B5 operating point on the "
+             "banked host (~18 ms/iteration there). 0 disables.",
+             at_least(0))
+    d.define("optimizer.incremental.warm.swap.patience", Type.INT, 3,
+             Importance.LOW,
+             "Consecutive no-improvement iterations before the warm "
+             "swap polish stops (traced — its plateau rule).",
+             at_least(1))
+    d.define("optimizer.incremental.warm.swap.candidates", Type.INT, 32,
+             Importance.LOW,
+             "Candidate pool of the warm swap polish (split evenly "
+             "between replica-swap pairs and leadership transfers). The "
+             "applied disjoint batch saturates near 16 moves/iteration, "
+             "so pools past ~32 buy wall, not quality, on a warm "
+             "budget.", at_least(2))
+    d.define("optimizer.incremental.warm.steps", Type.INT, 100,
+             Importance.LOW,
+             "SA step budget (upper bound) of the STRUCTURAL-damage warm "
+             "path (repair + targeted SA before the swap polish); the "
+             "plateau exit usually stops earlier.", at_least(1))
+    d.define("optimizer.incremental.warm.chunk.steps", Type.INT, 25,
+             Importance.LOW,
+             "Steps per warm SA chunk — the plateau-decision granularity "
+             "(its own small compiled chunk program, paid once).",
+             at_least(1))
+    d.define("optimizer.incremental.warm.chains", Type.INT, 2,
+             Importance.LOW,
+             "SA chains of the warm run: warm starts are exploitation, "
+             "not exploration.", at_least(1))
+    d.define("optimizer.incremental.warm.moves", Type.INT, 8,
+             Importance.LOW,
+             "Proposals per chain step of the warm run.", at_least(1))
+    d.define("optimizer.incremental.plateau.window", Type.INT, 1,
+             Importance.LOW,
+             "Chunks without lexicographic improvement before the warm "
+             "drive stops (the plateau-terminated budget, read from the "
+             "convergence taps at the existing chunk boundary). Host "
+             "data: retuning it never recompiles any program.",
+             at_least(1))
+    d.define("optimizer.incremental.warm.t0", Type.DOUBLE, 1e-8,
+             Importance.LOW,
+             "Warm-run initial temperature (soft-cost units): effectively "
+             "pure descent — a converged placement is refined, never "
+             "re-randomized, and a tiny budget must not net-accept "
+             "Metropolis noise it has no budget to recover from.",
+             at_least(0.0))
+    d.define("optimizer.incremental.warm.leader.iters", Type.INT, 0,
+             Importance.LOW,
+             "Leadership-only greedy iterations after the warm SA "
+             "(0 = skip): leader-bytes drift sometimes needs transfers "
+             "the low-temperature SA misses.", at_least(0))
+    d.define("optimizer.incremental.max.sessions", Type.INT, 32,
+             Importance.LOW,
+             "Sessions kept in the process-wide warm-placement store "
+             "(LRU; ~12 MB of device arrays per B5-scale session). An "
+             "evicted session simply cold-starts on its next proposal.",
+             at_least(1))
     d.define("optimizer.repair.backend", Type.STRING, "device",
              Importance.LOW,
              "hard_repair loop driver: 'device' runs the whole sweep loop "

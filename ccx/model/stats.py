@@ -88,14 +88,27 @@ def _dist(values: np.ndarray) -> tuple[float, float, float, float]:
     )
 
 
+#: module-level jitted aggregate pass — a per-call ``jax.jit`` wrapper
+#: re-traces (and re-compiles) on every invocation because the jit cache
+#: keys on the wrapper object, not the wrapped function
+_AGG_JIT = None
+
+
+def _agg(m):
+    global _AGG_JIT
+    if _AGG_JIT is None:
+        import jax
+
+        _AGG_JIT = jax.jit(broker_aggregates)
+    return _AGG_JIT(m)
+
+
 def cluster_model_stats(
     m: TensorClusterModel, agg: BrokerAggregates | None = None
 ) -> ClusterModelStats:
     """Compute the stats block from a model state (one aggregate pass)."""
     if agg is None:
-        import jax
-
-        agg = jax.jit(broker_aggregates)(m)
+        agg = _agg(m)
     alive = np.asarray(m.broker_valid & m.broker_alive)
     loads = np.asarray(agg.broker_load)              # [RES, B]
     repl = np.asarray(agg.replica_count)
@@ -157,9 +170,7 @@ def host_rollup(
     one row). Keys are host ids; values carry summed loads, capacity, and
     replica/leader counts — the host axis of kafka_cluster_state/load."""
     if agg is None:
-        import jax
-
-        agg = jax.jit(broker_aggregates)(m)
+        agg = _agg(m)
     alive = np.asarray(m.broker_valid & m.broker_alive)
     hosts = np.asarray(m.broker_host)
     loads = np.asarray(agg.broker_load)

@@ -44,6 +44,11 @@ from ccx.search.greedy import (
     greedy_optimize,
     swap_polish,
 )
+from ccx.search.incremental import (
+    ColdStartRequired,
+    IncrementalOptions,
+    WarmStart,
+)
 from ccx.search.repair import (
     finalize_preferred_leaders,
     hard_repair,
@@ -89,6 +94,14 @@ class OptimizerResult:
     #: (ccx.parallel.sharding.program_cache_stats). VOLATILE in golden
     #: wire fixtures, like spanTree/costModel.
     mesh: dict | None = None
+    #: incremental re-optimization block (ccx.search.incremental, ISSUE
+    #: 10): present on warm-started runs ({"warmStart": true, session,
+    #: baseGeneration, touchedBrokers, driftPartitions, plateau, ...})
+    #: and on cold runs that were REQUESTED warm but fell back
+    #: ({"coldStart": true, "reason": ...}). Rides BENCH lines and the
+    #: sidecar result; VOLATILE in golden wire fixtures (run-trajectory
+    #: data, like convergence).
+    incremental: dict | None = None
     #: convergence-telemetry block (ccx.search.telemetry, ISSUE 9):
     #: ``{"goals": [...], "phases": {phase: [segment, ...]}}`` — per-chunk
     #: per-goal lex cost series + cumulative move counters + temperature
@@ -106,6 +119,13 @@ class OptimizerResult:
     #: computing them costs an aggregate pass + host transfer, which must not
     #: tax callers (bench hot path) that never read the stats.
     input_model: TensorClusterModel | None = None
+    #: warm-path only: the f32[6, B] band-pressure DEVICE stack of the
+    #: shipped placement under the shipped metrics — the next window's
+    #: delta cache, computed by the fused ``incremental.warm_finish``
+    #: program alongside the result stack. Callers banking the result
+    #: (``incremental.remember``) pass it through so the bank costs zero
+    #: extra device work. Never serialized (see ``to_json``).
+    warm_pressure: object | None = None
 
     @property
     def stats_before(self) -> ClusterModelStats | None:
@@ -134,7 +154,19 @@ class OptimizerResult:
     def violation_summary(self) -> dict[str, float]:
         return {n: v for n, (v, _) in self.stack_after.by_name().items() if v > 0}
 
-    def to_json(self, include_proposals: bool = True) -> dict:
+    def to_json(
+        self,
+        include_proposals: bool = True,
+        include_stats: bool = True,
+    ) -> dict:
+        """``include_stats=False`` omits the ClusterModelStats blocks —
+        they cost one full aggregate pass + bulk device->host transfer
+        EACH for before/after (~260 ms at B5 on CPU), which would
+        dominate a <500 ms steady-state warm re-proposal. The sidecar
+        passes False for warm-started results (the minimal-diff
+        contract: a steady-state window consumes the diff and the goal
+        summary; full distribution stats ride the cold proposals and the
+        load endpoint)."""
         before = self.stack_before.by_name()
         after = self.stack_after.by_name()
         return {
@@ -171,6 +203,7 @@ class OptimizerResult:
             **({"spanTree": self.span_tree} if self.span_tree else {}),
             **({"costModel": self.cost_model} if self.cost_model else {}),
             **({"mesh": self.mesh} if self.mesh else {}),
+            **({"incremental": self.incremental} if self.incremental else {}),
             **({"convergence": self.convergence} if self.convergence else {}),
             **(
                 {
@@ -185,7 +218,9 @@ class OptimizerResult:
                         self.stats_after
                     ),
                 }
-                if self.stats_before is not None and self.stats_after is not None
+                if include_stats
+                and self.stats_before is not None
+                and self.stats_after is not None
                 else {}
             ),
         }
@@ -340,6 +375,13 @@ class OptimizeOptions:
     #: parallelism; raise for clusters whose model shards (100k+
     #: partitions) dominate chain parallelism.
     mesh_parts: int = 1
+    #: incremental re-optimization knobs (ccx.search.incremental, ISSUE
+    #: 10; config ``optimizer.incremental.*``): governs the warm pipeline
+    #: entered via ``optimize(warm_start=...)``. Inert on cold runs — the
+    #: default IncrementalOptions() keeps every cold program bit-exact.
+    incremental: IncrementalOptions = dataclasses.field(
+        default_factory=IncrementalOptions
+    )
 
 
 def prewarm_options(opts: OptimizeOptions) -> OptimizeOptions:
@@ -461,6 +503,7 @@ def optimize(
     opts: OptimizeOptions = OptimizeOptions(),
     progress_cb=None,
     job: tuple[str, int] | str | None = None,
+    warm_start: WarmStart | None = None,
 ) -> OptimizerResult:
     """Full-stack proposal computation (reference call stack 3.2, L3a part).
 
@@ -485,6 +528,17 @@ def optimize(
     spans/heartbeats/histograms carry ``job=<cluster-id>``. None (the
     default) runs unscheduled; with no other job registered the scheduled
     path is bit-exact vs unscheduled (grants only order dispatches).
+
+    ``warm_start`` (a ``ccx.search.incremental.WarmStart``, ISSUE 10)
+    enters the incremental re-optimization pipeline when
+    ``opts.incremental`` is armed: the previous converged placement is
+    grafted onto this snapshot's metrics, only the drift-touched bands
+    are re-scored, the search runs a short plateau-terminated warm
+    budget, and the result's proposals are the minimal diff. Falls back
+    to the cold pipeline (with ``OptimizerResult.incremental`` naming the
+    reason) when the warm base cannot be applied. Steady-state warm jobs
+    register on the fleet scheduler exactly like cold ones — same
+    ``job=`` path, same priority/residency rules.
     """
     if job is not None:
         from ccx.search.scheduler import FLEET
@@ -493,14 +547,38 @@ def optimize(
             job if isinstance(job, tuple) else (job, 0)
         )
         with FLEET.job(str(cluster_id), int(priority)):
-            return optimize(m, cfg, goal_names, opts, progress_cb)
+            return optimize(
+                m, cfg, goal_names, opts, progress_cb,
+                warm_start=warm_start,
+            )
     cost0 = costmodel.exec_snapshot()
+    warm = warm_start if (
+        warm_start is not None and opts.incremental.armed
+    ) else None
     root = TRACER.start(
         "optimize", kind="op",
         P=int(m.P), B=int(m.B), goals=len(goal_names),
+        **({"warm": True} if warm is not None else {}),
     )
+    cold_reason = None
     try:
-        res = _optimize(m, cfg, goal_names, opts, progress_cb)
+        res = None
+        if warm is not None:
+            try:
+                res = _optimize_warm(m, cfg, goal_names, opts, progress_cb,
+                                     warm)
+            except ColdStartRequired as e:
+                cold_reason = str(e)
+        if res is None:
+            res = _optimize(m, cfg, goal_names, opts, progress_cb)
+            if cold_reason is not None:
+                res = dataclasses.replace(
+                    res,
+                    incremental={
+                        "warmStart": False, "coldStart": True,
+                        "reason": cold_reason,
+                    },
+                )
     finally:
         # the root MUST close on every exit path — a leaked root would nest
         # every later call on this thread under a dead tree
@@ -936,6 +1014,157 @@ def _optimize(
         mesh=mesh_info,
         convergence=convergence,
         input_model=m,
+    )
+
+
+def _optimize_warm(
+    m: TensorClusterModel,
+    cfg: GoalConfig,
+    goal_names: tuple[str, ...],
+    opts: OptimizeOptions,
+    progress_cb,
+    warm: WarmStart,
+) -> OptimizerResult:
+    """The incremental warm pipeline (ccx.search.incremental, ISSUE 10):
+    previous placement grafted onto the new metrics, drift-targeted
+    plateau-terminated warm search, preferred-leader finalize, minimal
+    diff, full verification. Deliberately lean — the steady-state
+    target is a <500 ms re-proposal at B5 on the banked host, so the
+    pipeline runs exactly one short search phase plus the exact final
+    guarantees (finalize + verify) and nothing else. Raises
+    ``ColdStartRequired`` when the warm base cannot be applied."""
+    from ccx.search import incremental as inc
+
+    t0 = time.monotonic()
+    phases: dict[str, float] = {}
+    kind_prop = [0, 0, 0]
+    kind_acc = [0, 0, 0]
+    conv_phases: dict[str, list] = {}
+
+    def _tally(r, phase: str | None = None) -> None:
+        for i in range(3):
+            kind_prop[i] += int(r.n_prop_kind[i])
+            kind_acc[i] += int(r.n_acc_kind[i])
+        conv = getattr(r, "convergence", None)
+        if phase is not None and conv:
+            conv_phases.setdefault(phase, []).append(conv)
+
+    @contextlib.contextmanager
+    def _phase(name: str, **attrs):
+        if progress_cb is not None:
+            progress_cb(name)
+        s = TRACER.start(name, kind="phase", **attrs)
+        try:
+            with annotate(f"ccx:{name}"):
+                yield
+        finally:
+            TRACER.end(s)
+            phases[name] = s.wall_s
+
+    (model, stack_before, stack_after, search, info, base_model,
+     bank_press, n_engine_moves) = inc.reoptimize(
+        m, warm, cfg, goal_names, opts.incremental, opts,
+        phase=_phase, tally=_tally,
+    )
+    # exact final guarantee, same as the cold pipeline: canonicalize
+    # preferred leaders (the verifier's zero-PLE-slack contract). The
+    # stack is NOT re-evaluated here — the warm pipeline defers the
+    # result eval past canonicalization so the final placement is scored
+    # exactly once, fused with the next window's pressure bank.
+    with _phase("preferred-leader"):
+        model, stack_after, _ = finalize_preferred_leaders(
+            model, cfg, goal_names, stack_after, reevaluate=False
+        )
+    if stack_after is None:
+        with _phase("warm-finish"):
+            stack_after, bank_press = inc.warm_finish(model, cfg, goal_names)
+    # never ship a warm result lexicographically behind its own
+    # (repaired) base: the engines are descent-only, but a leadership
+    # pass can in principle net-regress — when it does, the base IS the
+    # better proposal, and its diff is the steady state's natural no-op.
+    # SIGNIFICANCE tolerances (ccx.common.convergence — relative, the
+    # asymmetric plateau rule), not the portfolio's absolute 1e-6: the
+    # result stack is re-evaluated from scratch while the engines carried
+    # incremental f32 sums, and ~1e-5-relative noise on a 1e3-scale high
+    # tier must not read as "worse" and no-op a real improvement.
+    if inc._significantly_lex_worse(stack_after, stack_before):
+        model = base_model
+        stack_after = stack_before
+        bank_press = None  # pressure was scanned off the unshipped model
+        n_engine_moves = 0  # the engines' moves are not in the output
+        info["reverted"] = "lex"
+    with _phase("diff"):
+        proposals = diff(m, model)
+    with _phase("verify"):
+        verification = verify_optimization(
+            m,
+            model,
+            cfg,
+            goal_names,
+            proposals=proposals,
+            require_hard_zero=opts.require_hard_zero,
+            check_evacuation=opts.check_evacuation,
+            stack_before=stack_before,
+            stack_after=stack_after,
+        )
+        if not verification.ok:
+            # a warm search can make a lex-legitimate trade the per-goal
+            # violation verifier rejects (lower-tier counts over slack).
+            # The steady-state contract is "every window ships a VERIFIED
+            # proposal": fall back to the (repaired) warm base — its diff
+            # is the no-op/repair-only plan, trivially self-consistent —
+            # and let the next metrics window try again.
+            base_proposals = diff(m, base_model)
+            base_verification = verify_optimization(
+                m,
+                base_model,
+                cfg,
+                goal_names,
+                proposals=base_proposals,
+                require_hard_zero=opts.require_hard_zero,
+                check_evacuation=opts.check_evacuation,
+                stack_before=stack_before,
+                stack_after=stack_before,
+            )
+            if base_verification.ok:
+                model = base_model
+                stack_after = stack_before
+                proposals = base_proposals
+                verification = base_verification
+                bank_press = None  # scanned off the unshipped model
+                n_engine_moves = 0  # moves not in the output
+                info["reverted"] = "verification"
+    if costmodel.capture_enabled() and costmodel.pending_count():
+        with _phase("cost-capture", pending=costmodel.pending_count()):
+            costmodel.capture_pending()
+    from ccx.common.metrics import REGISTRY
+    from ccx.search.state import MOVE_KIND_NAMES
+
+    move_counters = {}
+    for i, name in enumerate(MOVE_KIND_NAMES):
+        move_counters[name] = {
+            "proposed": kind_prop[i], "accepted": kind_acc[i]
+        }
+    REGISTRY.counter("incremental-warm-proposals").inc(1)
+    convergence = None
+    if conv_phases:
+        convergence = {"goals": list(goal_names), "phases": conv_phases}
+    info["diffSize"] = len(proposals)
+    return OptimizerResult(
+        proposals=proposals,
+        stack_before=stack_before,
+        stack_after=stack_after,
+        verification=verification,
+        model=model,
+        wall_seconds=time.monotonic() - t0,
+        n_sa_accepted=getattr(search, "n_accepted", 0),
+        n_polish_moves=n_engine_moves,
+        phase_seconds=phases,
+        move_counters=move_counters,
+        convergence=convergence,
+        incremental=info,
+        input_model=m,
+        warm_pressure=bank_press,
     )
 
 

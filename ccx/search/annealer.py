@@ -122,6 +122,17 @@ class AnnealOptions:
     #: (whose chunk program cache is keyed on static config, budgets
     #: traced) — a mesh run keeps bounded compile + per-chunk heartbeats.
     chunk_steps: int = 0
+    #: >0 arms the plateau-early-exit mode of the chunked drive (ISSUE
+    #: 10, incremental re-optimization): after each chunk the driver
+    #: reads the convergence tap's CURRENT row at the chunk boundary and
+    #: stops once this many consecutive chunks fail to lex-improve
+    #: (ccx.common.convergence tolerances) — a detected-plateau budget
+    #: instead of a fixed one. Host-side data only: the window never
+    #: enters any traced program, so retunes NEVER recompile (the chunk
+    #: runner's static key zeroes it — pinned). Requires chunk_steps > 0
+    #: and the telemetry taps armed; 0 (default) is today's fixed-budget
+    #: drive, bit-exact.
+    plateau_window: int = 0
     seed: int = 0
 
 
@@ -143,6 +154,10 @@ class AnnealResult:
     #: the chunk carry recorded. None on the monolithic (unchunked) path
     #: or with taps off (observability.convergence=false).
     convergence: dict | None = None
+    #: plateau-exit report (ISSUE 10): ``{"exited", "chunksRun",
+    #: "chunksBudget", "window"}`` when the plateau-early-exit mode was
+    #: armed (AnnealOptions.plateau_window > 0), else None.
+    plateau: dict | None = None
 
     @property
     def improved(self) -> bool:
@@ -1425,7 +1440,50 @@ def _probe_ready(x) -> bool:
         return False
 
 
-def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None):
+@dataclasses.dataclass
+class PlateauExit:
+    """Plateau-terminated budget for one ``drive_chunks`` call (ISSUE 10).
+
+    ``row(carry)`` returns the convergence tap's CURRENT chunk row (the
+    lex-best per-goal cost vector) as a device array; the driver reads it
+    at the chunk boundary — for engines with an early-exit sync that read
+    is free, for sync-free SA drives it IS the early-exit sync (one small
+    transfer per chunk, the price of a data-dependent budget). The
+    decision deliberately does NOT reuse the non-blocking heartbeat
+    probe: that one is a chunk stale by construction, and an exit rule
+    one chunk behind both overshoots the budget and — worse — reads the
+    *previous* chunk's improvement as the current one's, so a drive that
+    drifts exactly at the plateau boundary would exit a chunk early
+    (pinned by tests/test_incremental.py).
+
+    ``window``/``min_chunks`` are host data — retuning them reuses every
+    compiled program. Result fields are filled in by the driver."""
+
+    row: object
+    window: int = 1
+    min_chunks: int = 1
+    # ----- filled by drive_chunks ------------------------------------------
+    # chunks_run and last_improved_chunk share a 1-based basis (ordinal
+    # of the chunk), so ``chunks_run - last_improved_chunk`` is exactly
+    # the number of chunks run past the plateau (0 = improved-to-the-end)
+    exited: bool = False
+    chunks_run: int = 0
+    last_improved_chunk: int = 0
+
+    def to_json(self, budget_chunks: int | None = None) -> dict:
+        out = {
+            "exited": bool(self.exited),
+            "chunksRun": int(self.chunks_run),
+            "window": int(self.window),
+            "lastImprovedChunk": int(self.last_improved_chunk),
+        }
+        if budget_chunks is not None:
+            out["chunksBudget"] = int(budget_chunks)
+        return out
+
+
+def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None,
+                 plateau: PlateauExit | None = None):
     """Host-side chunk driver shared by the SA chunk runner and both
     chunked polish engines (ccx.search.greedy): invoke
     ``run_one(carry, off)`` once per chunk offset, threading the (usually
@@ -1459,7 +1517,18 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None):
     sync (``done`` non-None) read the probe at that existing sync; SA
     chunks (``done=None``, fully pipelined) dispatch the probe async and
     each heartbeat reports the latest probe that ``is_ready`` — typically
-    the previous chunk's energy, one chunk stale by construction."""
+    the previous chunk's energy, one chunk stale by construction.
+
+    ``plateau`` (a :class:`PlateauExit`) arms the plateau-terminated
+    budget (ISSUE 10): after each chunk the driver reads the convergence
+    tap's CURRENT row via ``plateau.row(carry)`` and ends the drive once
+    ``plateau.window`` consecutive chunks stop lex-improving
+    (``ccx.common.convergence`` tolerances, the same asymmetric rule the
+    budget advisor uses). The read doubles as this chunk's heartbeat
+    energy, so a plateau-armed drive's heartbeats are CURRENT, never the
+    non-blocking probe's one-chunk-stale value — the exit decision and
+    the recorded quality both describe the chunk that just ran."""
+    from ccx.common.convergence import lex_improved
     from ccx.common.tracing import TRACER
     from ccx.search.scheduler import FLEET
 
@@ -1468,6 +1537,8 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None):
     job = FLEET.current()
     energy = None
     pending = None
+    best_vec = None
+    since_improve = 0
     with (FLEET.drive(job) if job is not None else contextlib.nullcontext()):
         for i, off in enumerate(range(0, n, step)):
             if job is not None:
@@ -1475,7 +1546,32 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None):
                     carry, done = run_one(carry, off)
             else:
                 carry, done = run_one(carry, off)
-            if probe is not None:
+            done_plateau = False
+            if plateau is not None:
+                try:
+                    import numpy as _np
+
+                    vec = [float(x) for x in _np.asarray(plateau.row(carry))]
+                except Exception:  # noqa: BLE001 — a broken tap read must
+                    plateau = None  # degrade to the fixed budget, not crash
+                else:
+                    # this read IS the chunk-boundary sync: energy below is
+                    # the CURRENT chunk's tier-0 cost, and the exit rule
+                    # compares the current chunk, not the stale probe
+                    energy = vec[0] if vec else None
+                    plateau.chunks_run = i + 1
+                    if best_vec is None or lex_improved(vec, best_vec):
+                        best_vec = list(vec)
+                        since_improve = 0
+                        plateau.last_improved_chunk = i + 1
+                    else:
+                        since_improve += 1
+                    done_plateau = (
+                        i + 1 >= max(plateau.min_chunks, 1)
+                        and since_improve >= max(plateau.window, 1)
+                    )
+                    plateau.exited = done_plateau and off + step < n
+            if probe is not None and plateau is None:
                 try:
                     val = probe(carry)
                     if done is not None:
@@ -1492,6 +1588,8 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None):
                     probe = None
             TRACER.heartbeat(i, offset=off, total=n, energy=energy)
             if done is not None and bool(done):
+                break
+            if done_plateau:
                 break
     return carry
 
@@ -1743,9 +1841,13 @@ def anneal(
         # its on/off sign may shape the program, so the static key pins
         # p_swap_end to a sign sentinel and schedule retunes reuse the
         # compiled chunk
+        # plateau_window is a host-side drive knob (PlateauExit), never
+        # program shape — zero it in the static key so arming/retuning
+        # the plateau exit reuses the compiled chunk (pinned)
         opts_key = dataclasses.replace(
             opts, n_steps=0, seed=0,
             p_swap_end=1.0 if opts.p_swap_end >= 0 else -1.0,
+            plateau_window=0,
         )
         states = _init_chains(
             m, keys, goal_names=goal_names, cfg=cfg, max_pt=max_pt
@@ -1786,12 +1888,37 @@ def anneal(
             def probe(carry):
                 return jnp.min(carry[0].cost_vec[:, 0])
 
+        plateau = None
+        if opts.plateau_window > 0 and tap is not None:
+            # plateau-early-exit (ISSUE 10): the exit rule reads the
+            # tap's CURRENT row — the lex-best full cost vector the chunk
+            # program just wrote — at the chunk boundary. The read is the
+            # warm drive's one sync per chunk; the window is host data
+            # (no program sees it, retunes never recompile).
+            G = len(goal_names)
+
+            def tap_row(carry):
+                buf, cnt = carry[1]
+                idx = jnp.clip(cnt - 1, 0, buf.shape[0] - 1)
+                return buf[idx, :G]
+
+            plateau = PlateauExit(
+                row=tap_row, window=int(opts.plateau_window)
+            )
+
         states, tap = drive_chunks(
             run_one, (states, tap), total=n, chunk=opts.chunk_steps,
-            probe=probe,
+            probe=probe, plateau=plateau,
         )
         convergence = telemetry.decode(
             tap, goal_names, chunk_size=opts.chunk_steps, budget=n
+        )
+        plateau_info = (
+            plateau.to_json(
+                budget_chunks=(n + opts.chunk_steps - 1) // opts.chunk_steps
+            )
+            if plateau is not None
+            else None
         )
     else:
         states = _run_chains(
@@ -1801,6 +1928,7 @@ def anneal(
             max_pt=max_pt,
         )
         convergence = None
+        plateau_info = None
 
     best = best_chain_index(np.asarray(states.cost_vec))
     pick = jax.tree.map(lambda a: a[best], states)
@@ -1818,4 +1946,5 @@ def anneal(
         n_prop_kind=tuple(int(x) for x in np.asarray(pick.n_prop_kind)),
         n_acc_kind=tuple(int(x) for x in np.asarray(pick.n_acc_kind)),
         convergence=convergence,
+        plateau=plateau_info,
     )

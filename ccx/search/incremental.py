@@ -1,0 +1,811 @@
+"""Incremental re-optimization — the warm-start drift loop (ISSUE 10).
+
+Every proposal used to be computed from scratch, but real clusters drift
+continuously: "Integrative Dynamic Reconfiguration in a Parallel Stream
+Processing Engine" (PAPERS.md) treats reconfiguration as an online
+process, and the consumer-group autoscaler line of work makes elasticity
+events the common case. This module turns the optimizer from a verb into
+a control loop: keep the last converged placement device-resident per
+cluster session, and on a metrics window
+
+1. **re-score only the touched bands** — the band-pressure tables
+   (``ccx.search.state.broker_pressure``) double as the delta cache: the
+   previous run banked its per-broker pressure vector, the new metrics
+   produce a new one, and only brokers whose pressure moved beyond a
+   tolerance are "touched". Partitions with a replica on a touched broker
+   (plus any structural offenders) become the warm run's targeted hot
+   list, so a tiny budget concentrates where the drift is;
+2. **warm-start the search from the previous solution** — the previous
+   placement is grafted onto the new metric tensors (a few device array
+   replacements, never a model rebuild) and the SA/polish machinery runs
+   from it with a short traced budget at low temperature (descent with a
+   whisper of Metropolis, not an anneal from random);
+3. **terminate on detected plateau** instead of a fixed budget — the
+   convergence taps (``ccx.search.telemetry``) already write the lex-best
+   cost vector at every chunk boundary; the plateau-early-exit mode in
+   ``annealer.drive_chunks`` reads that row at the existing chunk
+   boundary and stops the drive once ``plateau_window`` chunks stop
+   improving (``ccx.common.convergence`` tolerances). The window is host
+   data: retuning it never recompiles anything;
+4. **emit a minimal diff** — the proposal is the placement delta against
+   the warm base (``ccx.proposals.diff``/``diff_columnar``), which at a
+   1 % metrics drift is a few hundred rows, not a 60k full plan.
+
+Gating: the whole subsystem is OFF unless armed — config
+``optimizer.incremental.enabled`` (REST-overridable) or an explicit
+warm-start request, and env ``CCX_INCREMENTAL=0`` force-disables
+everything. Disarmed, every program traced/compiled today is traced
+bit-identically (the plateau loop is host-side and the warm pipeline is
+never entered) — pinned by tests/test_incremental.py.
+
+The store below is process-wide (like ``scheduler.FLEET`` and the
+tracer): the sidecar's Propose path, the facade's verbs and the bench all
+share one map of device-resident converged placements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+
+#: env off-switch (the config key ``optimizer.incremental.enabled`` wins
+#: when the facade set it explicitly; the env kills the subsystem outright
+#: for bench/tools/subprocess paths)
+ENV_INCREMENTAL = "CCX_INCREMENTAL"
+
+#: relative band-pressure change that marks a broker "touched" by drift
+#: (either direction, on any of the six pressure tables). 2 % of the
+#: pressure scale: smaller than any drift worth re-optimizing for, large
+#: enough that f32 noise never marks the whole cluster touched.
+PRESSURE_RTOL = 0.02
+PRESSURE_ATOL = 1e-3
+
+
+def env_enabled() -> bool:
+    """False when ``CCX_INCREMENTAL=0`` — the hard off-switch that
+    restores today's cold-only behavior everywhere."""
+    return os.environ.get(ENV_INCREMENTAL, "1") != "0"
+
+
+@dataclasses.dataclass(frozen=True)
+class IncrementalOptions:
+    """Warm-path knobs (config ``optimizer.incremental.*``)."""
+
+    #: master gate (``optimizer.incremental.enabled``); the env
+    #: ``CCX_INCREMENTAL=0`` overrides True.
+    enabled: bool = False
+    #: usage-coupled swap-polish iterations of the warm run — the PRIMARY
+    #: warm engine (``optimizer.incremental.warm.swap.iters``). Pure lex
+    #: descent over pressure-ranked replica swaps + leadership transfers:
+    #: it re-scores the band-pressure tables from the carried aggregates
+    #: every iteration (O(B) — the delta-cache re-scoring), targets
+    #: exactly the drift-touched cells, and can never regress the lex
+    #: vector. 8 is the <500 ms budget at B5 on the banked host (~18
+    #: ms/live-iteration there; the descent applies a disjoint batch per
+    #: iteration, so 8 iterations land up to ~128 moves — a 1 % drift's
+    #: usage-band damage — while 12 buys ~35 % more moves for ~70 ms;
+    #: the warm-vs-cold quality tripwire in tests/test_incremental.py
+    #: pins that this budget stays within tolerance of from-scratch).
+    warm_swap_iters: int = 8
+    #: consecutive no-improvement iterations before the warm swap polish
+    #: stops (traced — the descent's own plateau rule)
+    warm_swap_patience: int = 3
+    #: total candidate pool of the warm swap polish, split evenly between
+    #: replica-swap pairs and leadership transfers
+    #: (``optimizer.incremental.warm.swap.candidates``). Smaller than the
+    #: cold rung's 128: the applied disjoint batch saturates near 16
+    #: moves/iteration well below that, and the warm wall scales with the
+    #: pool (measured at B5 CPU: 64+64 ≈ +40 ms/iter vs 16+16 ≈ +13
+    #: ms/iter at an identical applied-move count)
+    warm_swap_candidates: int = 32
+    #: SA step budget of the STRUCTURAL-damage warm path (dead brokers /
+    #: disks in the drift window — repair + targeted SA before the swap
+    #: polish); an upper bound, the plateau exit usually stops earlier
+    #: (``optimizer.incremental.warm.steps``)
+    warm_steps: int = 100
+    #: steps per warm SA chunk — the plateau-decision granularity
+    #: (``optimizer.incremental.warm.chunk.steps``). Its own (small)
+    #: compiled chunk program, paid once and shared by every warm call.
+    warm_chunk_steps: int = 25
+    #: chains of the warm run (``optimizer.incremental.warm.chains``):
+    #: warm starts are exploitation, not exploration — 2 keeps a spare
+    #: diversity chain at ~1/8 the cost of the cold rung's 16
+    warm_chains: int = 2
+    #: proposals per chain step (``optimizer.incremental.warm.moves``)
+    warm_moves_per_step: int = 8
+    #: chunks without lex improvement before the warm SA drive stops
+    #: (``optimizer.incremental.plateau.window``). Host data — retunes
+    #: never recompile (pinned).
+    plateau_window: int = 1
+    #: warm-run initial temperature (soft-cost units): effectively pure
+    #: descent — a converged placement is refined, never re-randomized,
+    #: and a tiny budget must not net-accept Metropolis noise it has no
+    #: budget to recover from (``optimizer.incremental.warm.t0``)
+    warm_t0: float = 1e-8
+    #: leadership-only greedy iterations after the warm engines (0 =
+    #: skip; ``optimizer.incremental.warm.leader.iters``) — leader-bytes
+    #: drift sometimes needs transfers the coupled draw misses
+    warm_leader_iters: int = 0
+    #: sessions kept in the process-wide placement store (LRU;
+    #: ``optimizer.incremental.max.sessions``)
+    max_sessions: int = 32
+
+    @property
+    def armed(self) -> bool:
+        return self.enabled and env_enabled()
+
+
+@dataclasses.dataclass
+class WarmStart:
+    """One session's last converged placement — the warm base.
+
+    The placement arrays are DEVICE arrays taken by reference from the
+    previous ``OptimizerResult.model`` (assignment ``int32[P, R]``,
+    leader_slot ``int32[P]``, replica_disk ``int32[P, R]`` — ~12 MB at
+    B5, two orders of magnitude below the snapshot model itself), plus
+    the band-pressure vector banked as the drift delta-cache and the lex
+    cost vector for quality accounting."""
+
+    session: str
+    generation: int
+    assignment: object
+    leader_slot: object
+    replica_disk: object
+    #: f32[6, B] DEVICE array — the six broker_pressure tables stacked,
+    #: under the metrics the placement was optimized for (the delta
+    #: cache). Banked async: ``remember`` dispatches the fused pressure
+    #: program and never syncs; the first read is the next window's
+    #: drift scan.
+    pressure: object | None = None
+    #: host tuple of the converged lex cost vector (reporting only)
+    cost_vec: tuple = ()
+    #: monotonic stamp for LRU eviction
+    stamp: float = 0.0
+
+    def shape_key(self) -> tuple:
+        a = self.assignment
+        return (tuple(a.shape), tuple(self.leader_slot.shape))
+
+
+class PlacementStore:
+    """Process-wide device-resident placement registry, keyed by session.
+
+    ``put`` keeps placements by reference (no copy, no transfer);
+    ``get(session, base_generation)`` returns the stored placement only
+    when the generation matches (None asks for the latest). LRU-bounded:
+    a steady-state fleet keeps its hot sessions resident, cold sessions
+    age out and simply cold-start on their next Propose (eviction is
+    never an error — the graceful-degradation contract the snapshot
+    registry set)."""
+
+    def __init__(self, max_sessions: int = 32) -> None:
+        self._lock = threading.Lock()
+        self._by_session: dict[str, WarmStart] = {}
+        self.max_sessions = int(max_sessions)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def put(self, warm: WarmStart) -> None:
+        with self._lock:
+            warm.stamp = time.monotonic()
+            self._by_session[warm.session] = warm
+            while len(self._by_session) > max(self.max_sessions, 1):
+                victim = min(
+                    self._by_session, key=lambda s: self._by_session[s].stamp
+                )
+                del self._by_session[victim]
+                self.evictions += 1
+
+    def get(self, session: str,
+            base_generation: int | None = None) -> WarmStart | None:
+        with self._lock:
+            warm = self._by_session.get(session)
+            if warm is None or (
+                base_generation is not None
+                and int(base_generation) != warm.generation
+            ):
+                self.misses += 1
+                return None
+            warm.stamp = time.monotonic()
+            self.hits += 1
+            return warm
+
+    def generation(self, session: str) -> int | None:
+        with self._lock:
+            warm = self._by_session.get(session)
+            return None if warm is None else warm.generation
+
+    def drop(self, session: str) -> None:
+        with self._lock:
+            self._by_session.pop(session, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_session.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "sessions": len(self._by_session),
+                "maxSessions": self.max_sessions,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+
+#: the process-wide store (sidecar Propose path, facade verbs, bench)
+STORE = PlacementStore()
+
+
+def configure(max_sessions: int | None = None) -> None:
+    """Config hook (``optimizer.incremental.max.sessions``)."""
+    if max_sessions is not None and max_sessions > 0:
+        STORE.max_sessions = int(max_sessions)
+
+
+# ----- warm-base construction ------------------------------------------------
+
+
+def remember(
+    session: str, generation: int, model, cfg=None, pressure=None
+) -> WarmStart:
+    """Bank a converged result as the session's warm base: placement
+    arrays by reference, plus the band-pressure delta cache (one jitted
+    aggregate pass over the model). The pressure program is DISPATCHED
+    here but never synced — the bank stays a device array and the first
+    read happens at the next window's drift scan, long after the device
+    finished. A blocking bank was ~116 ms of the measured warm wall at
+    B5 on CPU; the async one is ~5 ms of dispatch — and a warm result
+    carries the bank precomputed (``OptimizerResult.warm_pressure``, the
+    fused ``warm_finish`` program's second output): pass it as
+    ``pressure`` and this banks with ZERO extra device work. Called by
+    the sidecar / facade / bench after every successful proposal for the
+    session."""
+    cost = ()
+    if pressure is None:
+        try:
+            pressure = _pressure_stack(model, cfg)
+        except Exception:  # noqa: BLE001 — the delta cache is an
+            pressure = None  # optimization, never a correctness dependency
+    warm = WarmStart(
+        session=str(session),
+        generation=int(generation),
+        assignment=model.assignment,
+        leader_slot=model.leader_slot,
+        replica_disk=model.replica_disk,
+        pressure=pressure,
+        cost_vec=cost,
+    )
+    STORE.put(warm)
+    return warm
+
+
+#: module-level jitted pressure programs (ONE compile per model shape —
+#: a per-call jax.jit wrapper would recompile every time)
+_PRESSURE_JIT = None
+_TOUCHED_JIT = None
+
+
+def _pressure_stack(model, cfg):
+    """f32[6, B] DEVICE array: the six ``broker_pressure`` tables of a
+    model under its own metrics, as one fused jitted program (aggregate
+    pass + band math + stack). Async by design — callers that only bank
+    it never sync."""
+    global _PRESSURE_JIT
+
+    from ccx.goals.base import GoalConfig
+
+    if _PRESSURE_JIT is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ccx.common import costmodel
+        from ccx.model.aggregates import broker_aggregates
+        from ccx.search.state import broker_pressure
+
+        @costmodel.instrument("pressure-scan")
+        @functools.partial(jax.jit, static_argnames=("cfg",))
+        def _stack(m, *, cfg):
+            p = broker_pressure(m, broker_aggregates(m), cfg=cfg)
+            return jnp.stack(
+                (p.usage_over, p.usage_under, p.lead_over, p.lead_under,
+                 p.lbi_over, p.lbi_under)
+            )
+
+        _PRESSURE_JIT = _stack
+    return _PRESSURE_JIT(model, cfg=cfg or GoalConfig())
+
+
+#: module-level jitted warm programs (ONE compile per model shape each).
+#: ``_warm_init``: the fused first half of a metrics-only warm window —
+#: full broker aggregates computed ONCE and shared by (a) the descent
+#: engine's starting SearchState, (b) the exact stack evaluation of the
+#: warm base under the new metrics (its hard-violation count is the
+#: structural-path gate, replacing the separate hot-list sync), (c) the
+#: band-pressure stack of the drift scan and (d) the touched-band mask
+#: against the banked delta cache. Before the fusion every one of those
+#: consumers paid its own aggregate pass — ~290 ms of the measured warm
+#: wall at B5 on CPU collapsed to ~105 ms.
+#: ``_warm_finish``: the fused second half — ONE aggregate pass over the
+#: final (canonicalized) placement yields the exact result stack AND the
+#: band-pressure stack banked as the next window's delta cache, so
+#: ``remember`` never dispatches its own pressure program on the warm
+#: path.
+_WARM_INIT_JIT = None
+_WARM_FINISH_JIT = None
+
+
+def _press6(p):
+    import jax.numpy as jnp
+
+    return jnp.stack(
+        (p.usage_over, p.usage_under, p.lead_over, p.lead_under,
+         p.lbi_over, p.lbi_under)
+    )
+
+
+def _warm_init_program():
+    global _WARM_INIT_JIT
+    if _WARM_INIT_JIT is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ccx.common import costmodel
+        from ccx.goals.stack import _evaluate
+        from ccx.model.aggregates import broker_aggregates
+        from ccx.search.state import (
+            broker_pressure,
+            init_search_state,
+            make_topic_group,
+            stack_needs_topic,
+        )
+
+        @costmodel.instrument("warm-init")
+        @functools.partial(
+            jax.jit,
+            static_argnames=("cfg", "goal_names", "max_pt", "has_banked"),
+        )
+        def _init(m, banked, key, *, cfg, goal_names, max_pt, has_banked):
+            agg = broker_aggregates(m)
+            stack = _evaluate(m, agg, cfg, goal_names)
+            press = _press6(broker_pressure(m, agg, cfg))
+            if has_banked:
+                delta = jnp.abs(press - banked)
+                tol = PRESSURE_ATOL + PRESSURE_RTOL * jnp.maximum(
+                    jnp.abs(banked), jnp.abs(press)
+                )
+                mask = jnp.any(delta > tol, axis=0)
+            else:
+                # no comparable cache: every band re-scored (safe default)
+                mask = jnp.ones(press.shape[1], bool)
+            group = (
+                make_topic_group(m, max_pt)
+                if stack_needs_topic(goal_names)
+                else None
+            )
+            state0 = init_search_state(
+                m, cfg, goal_names, key, group=group, agg=agg
+            )
+            return state0, stack, press, mask, jnp.sum(mask).astype(jnp.int32)
+
+        _WARM_INIT_JIT = _init
+    return _WARM_INIT_JIT
+
+
+def warm_finish(model, cfg, goal_names: tuple[str, ...]):
+    """(exact StackResult, f32[6, B] pressure stack) of a final placement
+    as ONE fused program — the result evaluation and the next window's
+    delta-cache bank share a single aggregate pass."""
+    global _WARM_FINISH_JIT
+    if _WARM_FINISH_JIT is None:
+        import functools
+
+        import jax
+
+        from ccx.common import costmodel
+        from ccx.goals.stack import _evaluate
+        from ccx.model.aggregates import broker_aggregates
+        from ccx.search.state import broker_pressure
+
+        @costmodel.instrument("warm-finish")
+        @functools.partial(
+            jax.jit, static_argnames=("cfg", "goal_names")
+        )
+        def _finish(m, *, cfg, goal_names):
+            agg = broker_aggregates(m)
+            return (
+                _evaluate(m, agg, cfg, goal_names),
+                _press6(broker_pressure(m, agg, cfg)),
+            )
+
+        _WARM_FINISH_JIT = _finish
+    return _WARM_FINISH_JIT(model, cfg=cfg, goal_names=tuple(goal_names))
+
+
+def _touched_mask(new, old):
+    """(bool[B] mask, i32 count) DEVICE arrays: bands whose pressure
+    moved beyond the asymmetric tolerance between two pressure stacks.
+    Jitted and non-blocking — the common (metrics-only) warm path reads
+    the count only when the info block is assembled, after the warm
+    engines already ran."""
+    global _TOUCHED_JIT
+
+    if _TOUCHED_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _cmp(new, old):
+            delta = jnp.abs(new - old)
+            tol = PRESSURE_ATOL + PRESSURE_RTOL * jnp.maximum(
+                jnp.abs(old), jnp.abs(new)
+            )
+            mask = jnp.any(delta > tol, axis=0)
+            return mask, jnp.sum(mask).astype(jnp.int32)
+
+        _TOUCHED_JIT = _cmp
+    return _TOUCHED_JIT(new, old)
+
+
+def warm_model(m_new, warm: WarmStart):
+    """The new snapshot's metric/topology tensors with the previous
+    converged placement grafted on — a few device-array replacements,
+    never a rebuild. None when the padded shapes disagree (topology
+    changed enough that the warm placement is meaningless — callers
+    cold-start)."""
+    if tuple(m_new.assignment.shape) != tuple(warm.assignment.shape) or (
+        tuple(m_new.leader_slot.shape) != tuple(warm.leader_slot.shape)
+    ):
+        return None
+    return m_new.replace(
+        assignment=warm.assignment,
+        leader_slot=warm.leader_slot,
+        replica_disk=warm.replica_disk,
+    )
+
+
+# ----- drift scan: touched bands -> targeted hot list ------------------------
+
+
+def touched_brokers(warm: WarmStart, model, cfg=None):
+    """bool[B] numpy mask of brokers whose band pressure moved beyond
+    tolerance between the banked delta cache and the same placement under
+    the NEW metrics — the "touched bands" the warm run re-scores. All-True
+    when no cache was banked (every band re-scored: the safe default)."""
+    import numpy as np
+
+    new = _pressure_stack(model, cfg)
+    if warm.pressure is None or tuple(warm.pressure.shape) != tuple(new.shape):
+        return np.ones(new.shape[1], bool), new
+    mask, _count = _touched_mask(new, warm.pressure)
+    return np.asarray(mask), new
+
+
+def drift_hot_list(model, touched, goal_names: tuple[str, ...], cfg):
+    """The warm run's targeted hot list: structural offenders (the
+    device hot list — dead brokers/disks, rack duplicates, capacity)
+    UNIONED with partitions holding a replica on a touched broker, padded
+    to the shared ``_evac_bucket`` size so the warm chunk program keys on
+    the same operand shape as every other engine. Returns
+    ``(evac int32[bucket], n_evac, n_structural)`` — ``n_structural`` > 0
+    means the warm base is infeasible and the caller must repair."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ccx.search.annealer import (
+        _evac_bucket,
+        hot_partition_list_device,
+    )
+
+    evac_s, n_s = hot_partition_list_device(
+        model, goal_names=goal_names, cfg=cfg
+    )
+    n_structural = int(n_s)
+    touched = np.asarray(touched)
+    a = np.asarray(model.assignment)
+    pvalid = np.asarray(model.partition_valid)
+    B = model.B
+    on_touched = (
+        ((a >= 0) & touched[np.clip(a, 0, B - 1)]).any(axis=1) & pvalid
+    )
+    drift_idx = np.nonzero(on_touched)[0]
+    if n_structural:
+        drift_idx = np.union1d(
+            drift_idx, np.asarray(evac_s)[:n_structural]
+        )
+    bucket = _evac_bucket(model.P)
+    if len(drift_idx) > bucket:
+        # over-full drift set: keep the structural offenders and an even
+        # subsample of the rest — targeting is a bias, not a correctness
+        # gate (acceptance still vets every move)
+        keep = drift_idx[:: (len(drift_idx) + bucket - 1) // bucket]
+        drift_idx = keep[:bucket]
+    out = np.zeros(bucket, np.int32)
+    out[: len(drift_idx)] = drift_idx.astype(np.int32)
+    return (
+        jnp.asarray(out),
+        jnp.asarray(len(drift_idx), jnp.int32),
+        n_structural,
+    )
+
+
+# ----- the warm pipeline -----------------------------------------------------
+
+
+def warm_anneal_options(iopts: IncrementalOptions, base_anneal):
+    """The warm run's AnnealOptions: the cold rung's proposal mix with a
+    short traced budget, low temperature, boosted hot-list draw and the
+    plateau exit armed. Chunk size/chains are the only new program shapes
+    (one compile each, shared by every warm call)."""
+    return dataclasses.replace(
+        base_anneal,
+        n_chains=max(iopts.warm_chains, 1),
+        n_steps=max(iopts.warm_steps, 1),
+        moves_per_step=max(iopts.warm_moves_per_step, 1),
+        chunk_steps=max(iopts.warm_chunk_steps, 1),
+        t0=iopts.warm_t0,
+        t1=min(base_anneal.t1, iopts.warm_t0),
+        p_evac=0.5,
+        plateau_window=max(iopts.plateau_window, 1),
+    )
+
+
+def reoptimize(
+    m,
+    warm: WarmStart,
+    cfg,
+    goal_names: tuple[str, ...],
+    iopts: IncrementalOptions,
+    base_opts,
+    phase=None,
+    tally=None,
+):
+    """The warm pipeline body (called by ``ccx.optimizer.optimize`` under
+    its root span; ``phase`` is the optimizer's phase context manager,
+    ``tally`` its move-counter/convergence accumulator).
+
+    Two paths share it. The COMMON path (metrics-only drift) runs ONE
+    fused init program (``_warm_init``: descent state + exact base stack
+    + pressure scan + touched mask off a single aggregate pass) and ONE
+    engine: the usage-coupled swap polish — pure lex descent that
+    re-scores the band-pressure tables from its carried aggregates every
+    iteration, so the drift-touched cells are targeted without any [P]
+    re-scan. The result stack is DEFERRED: the caller canonicalizes
+    preferred leaders first, then evaluates the final placement once via
+    ``warm_finish`` (which also yields the pressure bank). The
+    STRUCTURAL path (the base stack's hard tier is non-zero — a broker/
+    disk died inside the drift window, or drift overflowed a capacity)
+    first repairs and runs a short plateau-terminated warm SA over the
+    targeted hot list — slower by construction, correctness first.
+
+    Returns ``(model, stack_before, stack_after, search_result, info,
+    base_model, bank_pressure, n_engine_moves)`` — ``stack_after`` is
+    None on the common path (the caller runs ``warm_finish`` after
+    canonicalization); ``bank_pressure`` is the f32[6, B] delta cache to
+    ``remember`` (None when the final placement was not the one
+    scanned); ``n_engine_moves`` counts applied swap-polish + leadership
+    moves across every engine that ran (``OptimizerResult.
+    n_polish_moves``). ``info`` is the ``OptimizerResult.incremental``
+    block. Raises ``ColdStartRequired``
+    when the warm base cannot be applied (shape mismatch): the caller
+    falls back to the cold pipeline."""
+    import contextlib
+
+    import jax
+    import numpy as np
+
+    from ccx.search.annealer import anneal, allows_inter_broker
+    from ccx.search.greedy import SwapPolishOptions, swap_polish
+
+    nullphase = contextlib.nullcontext
+
+    def _phase(name, **attrs):
+        return phase(name, **attrs) if phase is not None else nullphase()
+
+    with _phase("warm-model"):
+        wm = warm_model(m, warm)
+        if wm is None:
+            raise ColdStartRequired(
+                f"shape mismatch: snapshot {tuple(m.assignment.shape)} vs "
+                f"warm base {warm.shape_key()[0]}"
+            )
+
+    run_swap = iopts.warm_swap_iters > 0 and allows_inter_broker(goal_names)
+    ksw = max(iopts.warm_swap_candidates // 2, 1)
+    spo = SwapPolishOptions(
+        n_swap_candidates=ksw,
+        n_lead_candidates=max(iopts.warm_swap_candidates - ksw, 0),
+        max_iters=iopts.warm_swap_iters,
+        patience=max(iopts.warm_swap_patience, 1),
+        trd_guard=base_opts.swap_polish_guarded,
+        chunk_iters=max(iopts.warm_swap_iters, 1),
+    )
+
+    with _phase("drift-scan"):
+        # the fused init program: ONE aggregate pass yields the descent
+        # state, the exact stack of the warm base under the NEW metrics,
+        # the band-pressure stack and the touched mask vs the banked
+        # delta cache. Its hard-violation count is the structural-path
+        # gate (the stack's StructuralFeasibility tier covers dead
+        # brokers/disks, rack breaks and capacity overflows — the same
+        # offenses the hot list scans for), so the common path pays
+        # exactly one sync here and no separate hot-list program.
+        from ccx.search.state import max_partitions_per_topic
+
+        has_banked = warm.pressure is not None and tuple(
+            warm.pressure.shape
+        ) == (6, int(wm.B))
+        state0, stack_before, new_pressure, touched_dev, touched_n = (
+            _warm_init_program()(
+                wm,
+                warm.pressure if has_banked else None,
+                jax.random.PRNGKey(spo.seed),
+                cfg=cfg,
+                goal_names=tuple(goal_names),
+                max_pt=max_partitions_per_topic(wm),
+                has_banked=has_banked,
+            )
+        )
+        structural = float(stack_before.hard_violations) > 0
+        evac = n_evac = None
+        n_offenders = 0
+        if structural:
+            # structural damage: the targeted hot list (structural
+            # offenders ∪ drift-touched partitions) feeds the warm SA —
+            # the rare path pays the extra scan + sync
+            touched = np.asarray(touched_dev)
+            evac, n_evac, n_offenders = drift_hot_list(
+                wm, touched, goal_names, cfg
+            )
+
+    def _touched_count():
+        if not has_banked:
+            # no comparable cache banked: every band was re-scored
+            return int(wm.B)
+        return int(np.asarray(touched_n))
+
+    sa = None
+    if structural:
+        # hard damage in the drift window (dead broker/disk, rack break,
+        # capacity overflow): repair + a short plateau-terminated warm SA
+        # over the targeted hot list re-establish feasibility before the
+        # polish — the cold pipeline's contract, at warm budgets. Slower
+        # than the metrics-only path by construction.
+        from ccx.search.repair import hard_repair
+
+        with _phase("repair", backend=base_opts.repair_backend):
+            wm, _n_rep = hard_repair(
+                wm, cfg, goal_names, backend=base_opts.repair_backend
+            )
+        aopts = warm_anneal_options(iopts, base_opts.anneal)
+        with _phase(
+            "anneal",
+            chains=aopts.n_chains,
+            steps=aopts.n_steps,
+            chunkSteps=aopts.chunk_steps,
+            warm=True,
+        ):
+            sa = anneal(wm, cfg, goal_names, aopts, evac=(evac, n_evac))
+        if tally is not None:
+            tally(sa, "anneal")
+        # the repaired-and-annealed placement becomes the warm base the
+        # revert guard protects (never revert INTO infeasibility)
+        wm = sa.model
+        stack_before = sa.stack_before
+        model = sa.model
+        stack_after = sa.stack_after
+    else:
+        model = wm
+        stack_after = None
+
+    search = sa
+    n_engine_moves = 0
+    if run_swap:
+        # the primary warm engine (module docstring): coupled swap pairs
+        # + leadership transfers, lex-descent only. Candidate shape
+        # matches the cold pipeline's swap-polish program split; the
+        # chunk size is the warm budget itself (one small chunk program,
+        # compiled once, shared by every warm call). The common path
+        # hands the fused init's (state0, stack_before) in and DEFERS
+        # the result stack (the caller evaluates once, after preferred-
+        # leader canonicalization); the structural path re-inits from
+        # the repaired placement but defers the same way.
+        with _phase("swap-polish", iters=iopts.warm_swap_iters, warm=True):
+            sp = swap_polish(
+                model, cfg, goal_names, spo,
+                init=None if structural else (state0, stack_before),
+                defer_stack_after=True,
+            )
+        if tally is not None:
+            tally(sp, "swap-polish")
+        model = sp.model
+        stack_after = None
+        search = search or sp
+        n_engine_moves += int(getattr(sp, "n_moves", 0))
+    bank_pressure = None
+    if not structural and not run_swap:
+        # every engine disabled (warm_swap_iters=0 on a soft window):
+        # the proposal is the base itself — already evaluated by the
+        # fused init, whose pressure stack doubles as the next bank
+        stack_after = stack_before
+        bank_pressure = new_pressure
+
+    n_lead = 0
+    if iopts.warm_leader_iters > 0:
+        import dataclasses as _dc
+
+        from ccx.search.greedy import greedy_optimize
+
+        with _phase("leader-pass", iters=iopts.warm_leader_iters):
+            lead = greedy_optimize(
+                model, cfg, goal_names,
+                _dc.replace(
+                    base_opts.polish,
+                    leadership_only=True,
+                    max_iters=iopts.warm_leader_iters,
+                ),
+            )
+            if tally is not None:
+                tally(lead, "leader-pass")
+            model = lead.model
+            n_lead = int(lead.n_moves)
+            n_engine_moves += n_lead
+            if n_lead:
+                # leadership moved off the placement the pending stack /
+                # pressure bank were scored on: defer both to the
+                # caller's fused warm-finish over the FINAL model — a
+                # bank scanned before these moves would misread the next
+                # window's leadership bands as fresh drift
+                stack_after = None
+                bank_pressure = None
+            else:
+                stack_after = lead.stack_after
+
+    info = {
+        "warmStart": True,
+        "coldStart": False,
+        "session": warm.session,
+        "baseGeneration": warm.generation,
+        "touchedBrokers": _touched_count(),
+        "driftPartitions": None if n_evac is None else int(n_evac),
+        "structuralOffenders": int(n_offenders),
+        "swapIters": iopts.warm_swap_iters,
+        "plateau": sa.plateau if sa is not None else None,
+        "leaderMoves": n_lead,
+    }
+    # the revert guard (never ship a warm result lexicographically behind
+    # its own repaired base) lives in the CALLER (_optimize_warm): with
+    # the result stack deferred past preferred-leader canonicalization,
+    # the guard can only run once the final stack exists.
+    return (model, stack_before, stack_after, search, info, wm,
+            bank_pressure, n_engine_moves)
+
+
+def _significantly_lex_worse(after, before) -> bool:
+    """True when ``after``'s (hard-violations, cost-vector) key is
+    significantly lexicographically worse than ``before``'s, under the
+    convergence module's asymmetric tolerances."""
+    import numpy as np
+
+    from ccx.common.convergence import lex_improved
+
+    ka = (float(after.hard_violations),) + tuple(
+        float(x) for x in np.asarray(after.costs)
+    )
+    kb = (float(before.hard_violations),) + tuple(
+        float(x) for x in np.asarray(before.costs)
+    )
+    return lex_improved(kb, ka)
+
+
+class ColdStartRequired(Exception):
+    """The warm base cannot be applied to this snapshot (e.g. padded-shape
+    mismatch after a topology change) — fall back to the cold pipeline."""

@@ -814,6 +814,7 @@ def finalize_preferred_leaders(
     cfg: GoalConfig,
     goal_names: tuple[str, ...],
     stack_after,
+    reevaluate: bool = True,
 ):
     """The pipeline's LAST stage, shared by every verified path (optimize()
     and the facade's greedy backend): canonicalize preferred leaders and
@@ -823,11 +824,18 @@ def finalize_preferred_leaders(
 
     Returns (model, stack_after, n_canonicalized). No-op for stacks that
     don't score PreferredLeaderElectionGoal (e.g. intra-broker disk-only).
+
+    ``reevaluate=False`` (the warm pipeline) returns ``stack_after=None``
+    instead of paying the re-evaluation when canonicalization changed the
+    placement — the caller evaluates the final model exactly once anyway
+    (``incremental.warm_finish`` fuses that eval with the pressure bank).
     """
     if "PreferredLeaderElectionGoal" not in goal_names:
         return model, stack_after, 0
     model, n = canonicalize_preferred_leaders(model)
     if n:
+        if not reevaluate:
+            return model, None, n
         from ccx.goals.stack import evaluate_stack
 
         stack_after = evaluate_stack(model, cfg, goal_names)

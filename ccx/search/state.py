@@ -816,11 +816,19 @@ def init_search_state(
     goal_names: tuple[str, ...],
     key: jnp.ndarray,
     group: "TopicGroup | None" = None,
+    agg: BrokerAggregates | None = None,
 ) -> SearchState:
     """Full (non-incremental) evaluation of the starting state. The cost
     vector is assembled through the same row functions the incremental path
-    uses, so deltas can never drift from the initial evaluation semantics."""
-    agg = broker_aggregates(m)
+    uses, so deltas can never drift from the initial evaluation semantics.
+
+    ``agg`` lets a caller that ALREADY paid the aggregate pass (the warm
+    pipeline's fused init program, which shares one pass between this
+    state, the stack eval and the pressure scan) hand it in; None (every
+    cold caller) computes it here, tracing the identical program as
+    before the parameter existed."""
+    if agg is None:
+        agg = broker_aggregates(m)
     part_sums = pt.partition_sums(
         m, m.assignment, m.leader_slot, m.replica_disk, m.partition_valid
     )

@@ -139,6 +139,15 @@ class CruiseControl:
             enabled=_explicit("observability.convergence"),
             max_chunks=config["observability.convergence.max.chunks"],
         )
+        # incremental re-optimization (ccx.search.incremental, ISSUE 10):
+        # size the process-wide warm-placement store; per-cluster
+        # generations are facade-local monotonic counters
+        from ccx.search import incremental as _incremental
+
+        _incremental.configure(
+            max_sessions=config["optimizer.incremental.max.sessions"]
+        )
+        self._incremental_gen: dict[str, int] = {}
 
     # ----- lifecycle (ref startUp order: monitor -> detector -> servlet) ----
 
@@ -277,6 +286,43 @@ class CruiseControl:
             swap_polish_chunk_iters=self.config[
                 "optimizer.swap.polish.chunk.iters"
             ],
+            # incremental re-optimization (ISSUE 10): the warm pipeline
+            # refines a full placement stack — leadership-/disk-only fast
+            # paths keep from-scratch semantics
+            incremental=self._incremental_options(
+                disabled=leadership_only or disk_only
+            ),
+        )
+
+    def _incremental_options(self, disabled: bool = False):
+        from ccx.search.incremental import IncrementalOptions
+
+        return IncrementalOptions(
+            enabled=(
+                not disabled
+                and self.config["optimizer.incremental.enabled"]
+            ),
+            warm_swap_iters=self.config[
+                "optimizer.incremental.warm.swap.iters"
+            ],
+            warm_swap_patience=self.config[
+                "optimizer.incremental.warm.swap.patience"
+            ],
+            warm_swap_candidates=self.config[
+                "optimizer.incremental.warm.swap.candidates"
+            ],
+            warm_steps=self.config["optimizer.incremental.warm.steps"],
+            warm_chunk_steps=self.config[
+                "optimizer.incremental.warm.chunk.steps"
+            ],
+            warm_chains=self.config["optimizer.incremental.warm.chains"],
+            warm_moves_per_step=self.config["optimizer.incremental.warm.moves"],
+            plateau_window=self.config["optimizer.incremental.plateau.window"],
+            warm_t0=self.config["optimizer.incremental.warm.t0"],
+            warm_leader_iters=self.config[
+                "optimizer.incremental.warm.leader.iters"
+            ],
+            max_sessions=self.config["optimizer.incremental.max.sessions"],
         )
 
     def _cluster_lock(self, cluster_id: str | None = None) -> threading.Lock:
@@ -318,10 +364,34 @@ class CruiseControl:
                 TRACER.span(verb, kind="verb", backend=backend,
                             goals=len(goal_names)), \
                 profiling.trace(self.config["optimizer.profile.dir"]):
-            return self._run_optimizer_timed(model, goal_names, opts, progress, backend)
+            # incremental re-optimization (ISSUE 10): resolve this
+            # cluster's last converged placement as the warm base (the
+            # steady-state loop); a verified result banks the NEXT base.
+            # Cold-start fallback is optimize()'s own (shape mismatch →
+            # normal pipeline with the reason on the result).
+            from ccx.search import incremental as _inc
+
+            warm = None
+            if getattr(opts, "incremental", None) is not None \
+                    and opts.incremental.armed and backend != "greedy":
+                warm = _inc.STORE.get(cid)
+            res = self._run_optimizer_timed(
+                model, goal_names, opts, progress, backend, warm_start=warm
+            )
+            if (
+                getattr(opts, "incremental", None) is not None
+                and opts.incremental.armed
+                and backend != "greedy"
+                and res.verification.ok
+            ):
+                gen = self._incremental_gen.get(cid, 0) + 1
+                self._incremental_gen[cid] = gen
+                _inc.remember(cid, gen, res.model, self.goal_config,
+                              pressure=res.warm_pressure)
+            return res
 
     def _run_optimizer_timed(self, model, goal_names, opts, progress,
-                             backend) -> OptimizerResult:
+                             backend, warm_start=None) -> OptimizerResult:
         if backend == "greedy":
             import time as _t
 
@@ -354,7 +424,8 @@ class CruiseControl:
                 n_sa_accepted=0,
                 n_polish_moves=g.n_moves,
             )
-        return optimize(model, self.goal_config, goal_names, opts)
+        return optimize(model, self.goal_config, goal_names, opts,
+                        warm_start=warm_start)
 
     def _model(self, options: ModelBuildOptions | None = None,
                requirements: ModelCompletenessRequirements | None = None,
@@ -719,6 +790,12 @@ class CruiseControl:
                             "optimizer.swap.polish.chunk.iters"
                         ],
                     },
+                    # incremental re-optimization state (ISSUE 10):
+                    # armed + warm knobs + live store occupancy, so an
+                    # operator confirms from REST whether steady-state
+                    # proposals warm-start and how many sessions are
+                    # device-resident
+                    "incremental": self._incremental_state(),
                     # fleet serving state (ccx.search.scheduler): the
                     # multi-job chunk scheduler's live run queue + window
                     # stats — an operator confirms from REST that
@@ -948,6 +1025,24 @@ class CruiseControl:
             except Exception:  # noqa: BLE001 — state must stay readable
                 out["meshShape"] = None
         return out
+
+    def _incremental_state(self) -> dict:
+        """AnalyzerState.incremental: the warm-start drift loop's config
+        + live placement-store stats (ccx.search.incremental)."""
+        from ccx.search import incremental as _inc
+
+        iopts = self._incremental_options()
+        return {
+            "enabled": bool(iopts.enabled),
+            "armed": bool(iopts.armed),
+            "warmSwapIters": iopts.warm_swap_iters,
+            "warmSteps": iopts.warm_steps,
+            "warmChunkSteps": iopts.warm_chunk_steps,
+            "warmChains": iopts.warm_chains,
+            "plateauWindow": iopts.plateau_window,
+            "warmT0": iopts.warm_t0,
+            "store": _inc.STORE.stats(),
+        }
 
     def _convergence_state(self) -> dict:
         """AnalyzerState.observability.convergenceTaps: taps armed + ring

@@ -56,19 +56,24 @@ class SidecarClient:
     def propose(self, model=None, session: str | None = None,
                 goals: tuple[str, ...] = (), on_progress=None,
                 columnar: bool = False, cluster_id: str | None = None,
-                priority: int | None = None, **options) -> dict:
+                priority: int | None = None, warm_start: bool = False,
+                base_generation: int | None = None, **options) -> dict:
         """``columnar=True`` requests the proposals as one raw-buffer
         arrays blob (``diff_columnar`` schema) instead of per-proposal
         maps — the fast path for B5-scale results; the returned dict then
         carries numpy arrays under ``proposalsColumnar``. ``cluster_id``
         names the fleet job on the sidecar's multi-job chunk scheduler
         (default: the session id); ``priority`` orders it in the run queue
-        (higher preempts at the next chunk boundary)."""
+        (higher preempts at the next chunk boundary). ``warm_start``
+        (round 14) asks the server to warm-start from the session's last
+        converged placement at ``base_generation`` — incremental
+        re-optimization with graceful cold-start fallback."""
         req = wire.propose_request(
             goals=goals, options=options,
             snapshot=_pack_model(model) if model is not None else None,
             session=session, columnar=columnar,
             cluster_id=cluster_id, priority=priority,
+            warm_start=warm_start, base_generation=base_generation,
         )
         result: dict | None = None
         for raw in self._propose(req):

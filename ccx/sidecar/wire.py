@@ -40,6 +40,16 @@ FIELD_CLUSTER_ID = "cluster_id"
 FIELD_PRIORITY = "priority"
 #: heartbeat-frame field naming the job a chunk belongs to
 FIELD_JOB = "job"
+#: incremental re-optimization (round 14, additive): a Propose with
+#: ``warm_start`` true asks the sidecar to warm-start from the session's
+#: last converged placement, resolved by (session, base_generation) —
+#: ``base_generation`` doubles as the delta base when a delta rides the
+#: same request (they are the same generation by construction: the
+#: placement being warmed from was computed on that base). Absent ⇒
+#: from-scratch, pre-round-14 semantics; an unresolvable warm base
+#: cold-starts gracefully (the result's ``incremental`` block names the
+#: reason), never fails the RPC.
+FIELD_WARM_START = "warm_start"
 
 # ----- structured error codes ----------------------------------------------
 
@@ -82,6 +92,11 @@ PROPOSE_OPTION_KEYS = frozenset({
     "swap_polish_iters", "swap_polish_post_iters",
     "swap_polish_candidates", "swap_polish_guarded",
     "swap_polish_chunk_iters",
+    # incremental re-optimization warm-path knobs (round 14; honored on
+    # warm-start Proposes, inert otherwise)
+    "warm_swap_iters", "warm_swap_patience", "warm_swap_candidates",
+    "warm_steps", "warm_chunk_steps", "warm_chains", "warm_moves",
+    "plateau_window", "warm_t0", "warm_leader_iters",
 })
 
 
@@ -193,8 +208,14 @@ def propose_request(goals: Iterable[str] = (), options: dict | None = None,
                     generation: int | None = None,
                     columnar: bool = False,
                     cluster_id: str | None = None,
-                    priority: int | None = None) -> bytes:
+                    priority: int | None = None,
+                    warm_start: bool = False) -> bytes:
     req: dict = {"goals": list(goals), "options": dict(options or {})}
+    if warm_start:
+        # incremental re-optimization (round 14, additive): warm-start
+        # from the session's last converged placement at base_generation
+        # (FIELD_WARM_START docstring); absent ⇒ from-scratch
+        req["warm_start"] = True
     if snapshot is not None:
         req["snapshot"] = snapshot
     if session is not None:

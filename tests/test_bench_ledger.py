@@ -411,3 +411,105 @@ def test_fleet_rides_cli_table_and_check(tmp_path, capsys):
 def test_fleet_rung_is_wired_into_campaign_script():
     sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
     assert "CCX_BENCH_FLEET=1" in sh
+
+
+# ----- steady (STEADY_r*.json — bench.py --steady) ---------------------------
+
+
+def _steady_line(p99=0.45, p50=0.38, verified=True, cores=2, drift=0.01,
+                 **extra):
+    return {
+        "metric": "B5 steady-state warm re-proposal wall through the "
+                  "sidecar (1% metrics drift per window, p99)",
+        "value": p99, "unit": "s", "vs_baseline": 80.0, "steady": True,
+        "config": "B5", "n_iters": 20, "drift_fraction": drift,
+        "backend": "cpu", "host_cores": cores, "verified": verified,
+        "cold_s": 31.2,
+        "warm": {"p50_s": p50, "p99_s": p99, "mean_s": p50,
+                 "walls": [p50, p99]},
+        "put_delta_s": 0.05, "diff_rows": 240,
+        "all_warm_started": verified,
+        "zero_warm_fresh_compiles": verified,
+        "effort": {"warm_swap_iters": 12, "plateau_window": 1,
+                   "cold": {"chains": 16, "steps": 250}},
+        **extra,
+    }
+
+
+def _bank_steady(tmp_path, n, line):
+    (tmp_path / f"STEADY_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_steady_rows_parse(tmp_path):
+    _bank_steady(tmp_path, 1, _steady_line())
+    rows, partials = bench_ledger.load_steady(str(tmp_path))
+    assert partials == []
+    (r,) = rows
+    assert r["p99"] == 0.45 and r["drift"] == 0.01 and r["verified"]
+    assert r["cold"] == 31.2 and r["all_warm"]
+
+
+def test_steady_p99_regression_fails(tmp_path):
+    _bank_steady(tmp_path, 1, _steady_line(p99=0.45))
+    _bank_steady(tmp_path, 2, _steady_line(p99=0.60))
+    rows, _ = bench_ledger.load_steady(str(tmp_path))
+    failures = bench_ledger.check_steady(rows)
+    assert failures and "p99" in failures[0]
+
+
+def test_steady_within_threshold_passes(tmp_path):
+    _bank_steady(tmp_path, 1, _steady_line(p99=0.45))
+    _bank_steady(tmp_path, 2, _steady_line(p99=0.47))
+    rows, _ = bench_ledger.load_steady(str(tmp_path))
+    assert bench_ledger.check_steady(rows) == []
+
+
+def test_steady_unverified_latest_fails(tmp_path):
+    # unverified = a window failed verification, cold-started, or the
+    # measured loop paid a fresh compile — all three collapse into the
+    # line's verified flag by construction (bench.py --steady)
+    _bank_steady(tmp_path, 1, _steady_line(verified=False))
+    rows, _ = bench_ledger.load_steady(str(tmp_path))
+    failures = bench_ledger.check_steady(rows)
+    assert failures and "UNVERIFIED" in failures[0]
+
+
+def test_steady_different_drift_or_host_not_comparable(tmp_path):
+    # a 0.1%-drift round must never gate a 1%-drift round, nor 8-core a
+    # 2-core one — warm wall scales with the drift set and the host
+    _bank_steady(tmp_path, 1, _steady_line(p99=0.10, drift=0.001))
+    _bank_steady(tmp_path, 2, _steady_line(p99=0.45, drift=0.01))
+    _bank_steady(tmp_path, 3, _steady_line(p99=0.80, cores=8))
+    rows, _ = bench_ledger.load_steady(str(tmp_path))
+    assert bench_ledger.check_steady(rows) == []
+
+
+def test_steady_partial_round_reported_not_failed(tmp_path):
+    (tmp_path / "STEADY_r03.json").write_text(
+        json.dumps({"n": 3, "rc": 124, "parsed": None})
+    )
+    rows, partials = bench_ledger.load_steady(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+    assert bench_ledger.check_steady(rows) == []
+
+
+def test_steady_gate_green_on_banked_artifacts():
+    """The repo's own STEADY artifacts must pass the gate."""
+    rows, _ = bench_ledger.load_steady(str(REPO))
+    assert bench_ledger.check_steady(rows) == []
+
+
+def test_steady_rides_cli_table_and_check(tmp_path, capsys):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_steady(tmp_path, 1, _steady_line())
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 0
+    bench_ledger.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "steady-state incremental" in out and "cold/p50" in out
+
+
+def test_steady_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_STEADY=1" in sh

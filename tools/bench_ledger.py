@@ -449,6 +449,142 @@ def render_fleet(frows: list[dict], partials: list[dict]) -> str:
     return "\n".join(out)
 
 
+# ----- steady (STEADY_r*.json) -----------------------------------------------
+
+
+def load_steady(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``STEADY_r*.json`` under ``root`` —
+    the ``bench.py --steady`` artifact: p50/p99 wall of repeat warm-start
+    re-proposals per metrics window through the sidecar (incremental
+    re-optimization, ISSUE 10), next to the cold from-scratch baseline
+    banked in the same round."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "STEADY_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("steady") \
+                or line.get("value") is None:
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed steady line (rc={wrapper.get('rc')})",
+            })
+            continue
+        warm = line.get("warm") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "config": line.get("config", "?"),
+            "n_iters": line.get("n_iters"),
+            "drift": line.get("drift_fraction"),
+            "backend": str(line.get("backend", "?")),
+            "host_cores": line.get("host_cores"),
+            "verified": bool(line.get("verified")),
+            "cold": line.get("cold_s"),
+            "p50": warm.get("p50_s"),
+            "p99": warm.get("p99_s", line.get("value")),
+            "speedup": line.get("vs_baseline"),
+            "diff_rows": line.get("diff_rows"),
+            "all_warm": bool(line.get("all_warm_started")),
+            "effort": line.get("effort") or {},
+        })
+    return rows, partials
+
+
+def steady_group_key(row: dict) -> str:
+    """Steady rows are only comparable at identical (config, drift,
+    backend, host_cores, effort) — warm wall depends on the drift size
+    and warm budget as much as on the code."""
+    return json.dumps(
+        [row["config"], row["drift"], row["backend"], row["host_cores"],
+         row["effort"]],
+        sort_keys=True,
+    )
+
+
+def check_steady(srows: list[dict]) -> list[str]:
+    """The steady gate: in the LATEST banked steady round, an unverified
+    line fails (unverified = a window failed verification, a window
+    cold-started, or the measured loop paid a fresh compile), and a
+    steady-p99 regression >10% vs the best banked comparable round
+    fails."""
+    failures: list[str] = []
+    if not srows:
+        return failures
+    latest_round = max(r["round"] for r in srows)
+    for r in (r for r in srows if r["round"] == latest_round):
+        if not r["verified"]:
+            failures.append(
+                f"steady round {r['round']} {r['config']}: UNVERIFIED "
+                "steady line banked (window verification failure, "
+                "cold-start fallback, or fresh compiles in the measured "
+                "loop)"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in srows:
+        groups.setdefault(steady_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"]
+            and r["p99"] is not None
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(p["p99"] for p in prior)
+        if r["p99"] is not None and best:
+            limit = best * (1 + WALL_REGRESSION)
+            if r["p99"] > limit:
+                failures.append(
+                    f"steady round {r['round']} {r['config']}: warm p99 "
+                    f"{r['p99'] * 1e3:.0f}ms regressed "
+                    f">{WALL_REGRESSION:.0%} vs best banked round "
+                    f"({best * 1e3:.0f}ms, limit {limit * 1e3:.0f}ms)"
+                )
+    return failures
+
+
+def render_steady(srows: list[dict], partials: list[dict]) -> str:
+    """The steady section of the trend table."""
+    if not srows and not partials:
+        return ""
+    out = ["", "steady-state incremental re-proposals (STEADY_r*.json):"]
+    headers = ["round", "config", "iters", "drift", "backend", "cold s",
+               "p50 ms", "p99 ms", "cold/p50", "diff", "ok"]
+    body = []
+    for r in sorted(srows, key=lambda r: r["round"]):
+        body.append([
+            _fmt(r["round"], 0), r["config"], _fmt(r["n_iters"], 0),
+            _fmt(None if r["drift"] is None else r["drift"] * 100, 0) + "%",
+            f"{r['backend']}/{r['host_cores']}c",
+            _fmt(r["cold"], 1),
+            _fmt(None if r["p50"] is None else r["p50"] * 1e3, 0),
+            _fmt(None if r["p99"] is None else r["p99"] * 1e3, 0),
+            _fmt(r["speedup"], 0) + "x",
+            _fmt(r["diff_rows"], 0),
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 # ----- trend table -----------------------------------------------------------
 
 
@@ -725,11 +861,13 @@ def main(argv=None) -> int:
     rows, partials = load_rows(root)
     mrows, mlegacy = load_multichip(root)
     frows, fpartials = load_fleet(root)
+    srows, spartials = load_steady(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
             "multichip": mrows, "multichipLegacy": mlegacy,
             "fleet": frows, "fleetPartials": fpartials,
+            "steady": srows, "steadyPartials": spartials,
         }, indent=1))
         return 0
     if args.roofline:
@@ -738,7 +876,7 @@ def main(argv=None) -> int:
     if args.check:
         failures = (
             check(rows, partials) + check_multichip(mrows)
-            + check_fleet(frows)
+            + check_fleet(frows) + check_steady(srows)
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
@@ -751,13 +889,15 @@ def main(argv=None) -> int:
         n = len([r for r in rows if r["round"] is not None])
         print(f"bench ledger green: {n} banked line(s), "
               f"{len(partials)} partial round(s), {len(mrows)} scaling "
-              f"curve(s), {len(frows)} fleet line(s), no regression vs "
-              f"the best banked rounds")
+              f"curve(s), {len(frows)} fleet line(s), {len(srows)} "
+              f"steady line(s), no regression vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
     mc = render_multichip(mrows, mlegacy)
     fl = render_fleet(frows, fpartials)
-    print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else ""))
+    st = render_steady(srows, spartials)
+    print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
+          + (("\n" + st) if st else ""))
     return 0
 
 

@@ -70,6 +70,10 @@ def _load_lines(root: str, paths: list[str]) -> list[dict]:
         paths = sorted(
             glob.glob(os.path.join(root, "BENCH_r*.json"))
             + glob.glob(os.path.join(root, "CONVERGENCE_*.json"))
+            # STEADY_r*.json (bench --steady, ISSUE 10): the last warm
+            # window's convergence block rides the line, so the advisor
+            # prices warm-start plateau budgets next to the cold rungs'
+            + glob.glob(os.path.join(root, "STEADY_r*.json"))
         )
     rows: list[dict] = []
     for path in paths:
@@ -94,9 +98,15 @@ def _load_lines(root: str, paths: list[str]) -> list[dict]:
         if isinstance(line, dict) and line.get("convergence"):
             rows.append({
                 "source": name,
-                "rung": line.get("rung", "?"),
+                "rung": line.get(
+                    "rung", "steady-warm" if line.get("steady") else "?"
+                ),
                 "backend": line.get("backend"),
-                "wall": line.get("value"),
+                "wall": (
+                    (line.get("warm") or {}).get("p50_s")
+                    if line.get("steady")
+                    else line.get("value")
+                ),
                 "convergence": line["convergence"],
             })
     return rows

@@ -47,10 +47,13 @@ _BENCH_KNOBS = ("CCX_BENCH_CHAINS", "CCX_BENCH_STEPS", "CCX_BENCH_MOVES",
 #: construction; mesh is the r11 mesh-sharded-run block — mesh shape and
 #: live sharded-program cache occupancy, absent on single-device runs and
 #: machine-dependent when present; convergence is the r13 telemetry block
-#: — per-chunk search-trajectory series, run-dependent by construction)
+#: — per-chunk search-trajectory series, run-dependent by construction;
+#: incremental is the r14 warm-start block — plateau/chunks-run
+#: trajectory data, run-dependent by construction, and absent on cold
+#: runs anyway)
 VOLATILE = (
     "wallSeconds", "phaseSeconds", "spanTree", "costModel", "mesh",
-    "convergence",
+    "convergence", "incremental",
 )
 
 #: the round-12 fleet envelopes (cluster_id / priority — additive fields,
@@ -58,7 +61,8 @@ VOLATILE = (
 #: byte-identical because the new fields are simply absent from them
 REQUEST_NAMES = ("ping_request.bin", "put_full_request.bin",
                  "put_delta_request.bin", "propose_request.bin",
-                 "put_full_request_fleet.bin", "propose_request_fleet.bin")
+                 "put_full_request_fleet.bin", "propose_request_fleet.bin",
+                 "propose_request_warm.bin")
 RESPONSE_NAMES = ("put_full_response.bin", "put_delta_response.bin",
                   "put_fleet_response.bin")
 RESULT_NAME = "propose_result.json"
@@ -136,6 +140,17 @@ def build_requests() -> dict[str, bytes]:
         "propose_request_fleet.bin": wire.propose_request(
             goals=goals, options=options, session=FLEET_SESSION,
             cluster_id=FLEET_CLUSTER, priority=FLEET_PRIORITY,
+        ),
+        # round 14 (incremental re-optimization): warm-start Propose —
+        # resolve the warm base by (session, base_generation); the wire
+        # fields are additive, so every legacy fixture stays byte-stable
+        "propose_request_warm.bin": wire.propose_request(
+            goals=goals,
+            options={**options, "warm_swap_iters": 12,
+                     "warm_swap_candidates": 32, "warm_steps": 100,
+                     "warm_chunk_steps": 25, "warm_chains": 2,
+                     "plateau_window": 1},
+            session=SESSION, warm_start=True, base_generation=2,
         ),
     }
 
