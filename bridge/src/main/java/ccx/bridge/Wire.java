@@ -43,6 +43,18 @@ public final class Wire {
   /** Heartbeat-frame field naming the job a streamed chunk belongs to. */
   public static final String FIELD_JOB = "job";
 
+  // Streamed columnar results (round 15, additive: absent fields keep the
+  // monolithic result frame — pre-round-15 clients are unaffected).
+  /** Propose field requesting the columnar blob as segment frames. */
+  public static final String FIELD_STREAM_RESULT = "stream_result";
+  /**
+   * Stream-frame field carrying a segment's 0-based sequence number
+   * ("of" = total segments, "data" = raw blob bytes); the terminal
+   * result frame's "proposalsColumnarSegments"/"proposalsColumnarBytes"
+   * let a client detect truncation before decoding.
+   */
+  public static final String FIELD_RESULT_SEGMENT = "resultSegment";
+
   // Structured error codes (error-frame "code" / INVALID_ARGUMENT prefix).
   public static final String ERR_UNSUPPORTED_VERSION = "unsupported-wire-version";
   public static final String ERR_MALFORMED = "malformed-request";

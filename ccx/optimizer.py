@@ -30,7 +30,7 @@ from ccx.goals.stack import (
 )
 from ccx.model.stats import ClusterModelStats, balancedness_score, cluster_model_stats
 from ccx.model.tensor_model import TensorClusterModel
-from ccx.proposals import ExecutionProposal, diff
+from ccx.proposals import ColumnarDiff, ExecutionProposal, columnar_diff
 from ccx.goals.stack import evaluate_stack
 from ccx.search.annealer import (
     AnnealOptions,
@@ -60,9 +60,18 @@ from ccx.verify import Verification, verify_optimization
 
 @dataclasses.dataclass
 class OptimizerResult:
-    """Parity: ``analyzer/OptimizerResult.java`` (SURVEY.md C20)."""
+    """Parity: ``analyzer/OptimizerResult.java`` (SURVEY.md C20).
 
-    proposals: list[ExecutionProposal]
+    Columnar-first since round 15: ``diff`` (a ``ccx.proposals.
+    ColumnarDiff``) is the canonical movement representation — flat int32
+    columns straight off the device diff program. The row
+    ``ExecutionProposal`` list is the LAZY ``proposals`` property, built
+    only when a consumer actually wants rows (executor hand-off, row-mode
+    wire results); movement counters are vectorized over the columns, so
+    an ``include_proposals=False`` serialization never walks ~62k Python
+    objects at B5."""
+
+    diff: ColumnarDiff
     stack_before: StackResult
     stack_after: StackResult
     verification: Verification
@@ -142,22 +151,58 @@ class OptimizerResult:
         return self._stats_after
 
     @property
+    def proposals(self) -> list[ExecutionProposal]:
+        """Row view of the diff — materialized on first access."""
+        return self.diff.rows
+
+    @property
     def num_replica_movements(self) -> int:
-        return sum(p.data_to_move for p in self.proposals)
+        # vectorized over the columns — include_proposals=False callers
+        # (warm minimal-diff windows) never materialize the row list
+        return self.diff.num_replica_movements
 
     @property
     def num_leadership_movements(self) -> int:
-        return sum(
-            1 for p in self.proposals if p.old_leader != p.new_leader
-        )
+        return self.diff.num_leadership_movements
 
     def violation_summary(self) -> dict[str, float]:
         return {n: v for n, (v, _) in self.stack_after.by_name().items() if v > 0}
+
+    def goal_summary_columnar(self) -> dict:
+        """``goalSummary`` as flat typed arrays (wire round 15): one
+        vector per column instead of G per-goal dict maps, so streamed
+        frame packing builds no per-goal Python objects. Values are f32
+        on the wire (like every load tensor); the goal names ride as a
+        plain list."""
+        import numpy as np
+
+        before = self.stack_before.by_name()
+        after = self.stack_after.by_name()
+        names = list(self.stack_after.names)
+        return {
+            "goal": names,
+            "hard": np.array(
+                [bool(GOAL_REGISTRY[n].hard) for n in names], np.uint8
+            ),
+            "violationsBefore": np.array(
+                [before[n][0] for n in names], np.float32
+            ),
+            "violationsAfter": np.array(
+                [after[n][0] for n in names], np.float32
+            ),
+            "costBefore": np.array(
+                [before[n][1] for n in names], np.float32
+            ),
+            "costAfter": np.array(
+                [after[n][1] for n in names], np.float32
+            ),
+        }
 
     def to_json(
         self,
         include_proposals: bool = True,
         include_stats: bool = True,
+        include_goal_summary: bool = True,
     ) -> dict:
         """``include_stats=False`` omits the ClusterModelStats blocks —
         they cost one full aggregate pass + bulk device->host transfer
@@ -173,23 +218,32 @@ class OptimizerResult:
             # columnar consumers (sidecar columnar_proposals) skip the 60k+
             # per-proposal dict materialization entirely
             **(
-                {"proposals": [p.to_json() for p in self.proposals]}
+                {"proposals": self.diff.rows_json()}
                 if include_proposals
                 else {}
             ),
             "numReplicaMovements": self.num_replica_movements,
             "numLeadershipMovements": self.num_leadership_movements,
-            "goalSummary": [
+            # streamed columnar results (wire round 15) ship the summary
+            # as flat typed arrays instead — include_goal_summary=False
+            # skips building the per-goal dicts only to discard them
+            **(
                 {
-                    "goal": n,
-                    "hard": GOAL_REGISTRY[n].hard,
-                    "violationsBefore": before[n][0],
-                    "violationsAfter": after[n][0],
-                    "costBefore": before[n][1],
-                    "costAfter": after[n][1],
+                    "goalSummary": [
+                        {
+                            "goal": n,
+                            "hard": GOAL_REGISTRY[n].hard,
+                            "violationsBefore": before[n][0],
+                            "violationsAfter": after[n][0],
+                            "costBefore": before[n][1],
+                            "costAfter": after[n][1],
+                        }
+                        for n in self.stack_after.names
+                    ]
                 }
-                for n in self.stack_after.names
-            ],
+                if include_goal_summary
+                else {}
+            ),
             "verified": self.verification.ok,
             "verificationFailures": self.verification.failures,
             "optimizationFailures": self.verification.infeasible,
@@ -933,14 +987,18 @@ def _optimize(
             model, cfg, goal_names, stack_after
         )
     with _phase("diff"):
-        proposals = diff(m, model)
+        # compiled device diff (ccx.proposals.columnar_diff): mask +
+        # bucketed compaction, only the changed rows cross device->host;
+        # the columns ARE the result's canonical representation — rows
+        # derive lazily if a consumer asks
+        dcols = columnar_diff(m, model)
     with _phase("verify"):
         verification = verify_optimization(
             m,
             model,
             cfg,
             goal_names,
-            proposals=proposals,
+            proposals=dcols,
             require_hard_zero=opts.require_hard_zero,
             check_evacuation=opts.check_evacuation,
             stack_before=stack_before,
@@ -1001,7 +1059,7 @@ def _optimize(
             "shardedPrograms": program_cache_stats(),
         }
     return OptimizerResult(
-        proposals=proposals,
+        diff=dcols,
         stack_before=stack_before,
         stack_after=stack_after,
         verification=verification,
@@ -1094,14 +1152,14 @@ def _optimize_warm(
         n_engine_moves = 0  # the engines' moves are not in the output
         info["reverted"] = "lex"
     with _phase("diff"):
-        proposals = diff(m, model)
+        dcols = columnar_diff(m, model)
     with _phase("verify"):
         verification = verify_optimization(
             m,
             model,
             cfg,
             goal_names,
-            proposals=proposals,
+            proposals=dcols,
             require_hard_zero=opts.require_hard_zero,
             check_evacuation=opts.check_evacuation,
             stack_before=stack_before,
@@ -1114,13 +1172,13 @@ def _optimize_warm(
             # proposal": fall back to the (repaired) warm base — its diff
             # is the no-op/repair-only plan, trivially self-consistent —
             # and let the next metrics window try again.
-            base_proposals = diff(m, base_model)
+            base_diff = columnar_diff(m, base_model)
             base_verification = verify_optimization(
                 m,
                 base_model,
                 cfg,
                 goal_names,
-                proposals=base_proposals,
+                proposals=base_diff,
                 require_hard_zero=opts.require_hard_zero,
                 check_evacuation=opts.check_evacuation,
                 stack_before=stack_before,
@@ -1129,7 +1187,7 @@ def _optimize_warm(
             if base_verification.ok:
                 model = base_model
                 stack_after = stack_before
-                proposals = base_proposals
+                dcols = base_diff
                 verification = base_verification
                 bank_press = None  # scanned off the unshipped model
                 n_engine_moves = 0  # moves not in the output
@@ -1149,9 +1207,9 @@ def _optimize_warm(
     convergence = None
     if conv_phases:
         convergence = {"goals": list(goal_names), "phases": conv_phases}
-    info["diffSize"] = len(proposals)
+    info["diffSize"] = dcols.n
     return OptimizerResult(
-        proposals=proposals,
+        diff=dcols,
         stack_before=stack_before,
         stack_after=stack_after,
         verification=verification,

@@ -1,17 +1,35 @@
-"""Execution proposals — diffing pre/post placements.
+"""Execution proposals — diffing pre/post placements, columnar-first.
 
 Parity: ``analyzer/AnalyzerUtils.getDiff`` turns the optimizer's mutated
 ClusterModel into a set of ``executor/ExecutionProposal`` records (old/new
 replica lists + leaders) that the Executor converts into AdminClient
-reassignment calls (SURVEY.md C20/C24, call stack 3.2->3.3). Here the diff
-is a vectorized numpy comparison of the placement arrays of two
-TensorClusterModels.
+reassignment calls (SURVEY.md C20/C24, call stack 3.2->3.3).
+
+Since round 15 the CANONICAL diff representation is columnar
+(``ColumnarDiff``): flat int32 arrays, one row per changed partition, in
+the exact ``diff_columnar`` wire schema. The row ``ExecutionProposal``
+list is a lazy view derived from the columns only when a consumer
+actually asks for rows (executor hand-off, row-mode wire results) — a
+warm steady-state window, the columnar sidecar path and the movement
+counters never materialize ~62k Python dataclasses at B5.
+
+The diff itself runs as a compiled ON-DEVICE program by default
+(``columnar_diff``): a changed-partition mask + count (one scalar sync),
+then a prefix-sum compaction that gathers only the changed rows into a
+shape-bucketed capacity (one "small" bucket for warm drift windows, one
+full-P bucket for cold results — warm and cold each reuse ONE compiled
+program per model shape) so only ~N rows cross device→host instead of
+eight full [P]-sized arrays. ``CCX_DEVICE_DIFF=0`` (or
+``backend="numpy"``) restores the host numpy diff, which stays the
+parity reference.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
+import os
 
 import numpy as np
 
@@ -134,7 +152,9 @@ def diff_columnar(
     B5 (~0.9 s of per-proposal msgpack maps for ~60k proposals,
     docs/perf-notes.md "Sidecar-inclusive T1"); columnar rows pack as raw
     little-endian buffers instead. Semantically identical to ``diff`` —
-    tests assert row/column agreement.
+    tests assert row/column agreement. This is the HOST numpy form; the
+    default production path is the compiled device program behind
+    ``columnar_diff`` (bit-identical, test-pinned).
     """
     a0 = np.asarray(before.assignment)
     a1 = np.asarray(after.assignment)
@@ -149,7 +169,6 @@ def diff_columnar(
         np.any(a0 != a1, axis=1) | (l0 != l1) | np.any(d0 != d1, axis=1)
     )
     ps = np.nonzero(changed)[0]
-    n = ps.size
     old_lead = np.where(
         (a0[ps] >= 0).any(axis=1), a0[ps, np.clip(l0[ps], 0, a0.shape[1] - 1)], -1
     )
@@ -166,3 +185,246 @@ def diff_columnar(
         "oldDisks": np.where(a0[ps] >= 0, d0[ps], -1).astype(np.int32),
         "newDisks": np.where(a1[ps] >= 0, d1[ps], -1).astype(np.int32),
     }
+
+
+# ----- columnar-canonical diff (round 15) -----------------------------------
+
+#: env override: ``CCX_DEVICE_DIFF=0`` routes every ``columnar_diff``
+#: through the host numpy reference; ``=1`` forces the compiled device
+#: program regardless of model size; unset applies the size gate below
+ENV_DEVICE_DIFF = "CCX_DEVICE_DIFF"
+
+#: padded-P floor for the device diff by default: below it the host
+#: numpy diff finishes in well under a millisecond, so compiling two
+#: programs per model shape is pure loss (a test suite touches dozens
+#: of tiny fixture shapes; serving fleets bucket to a handful of big
+#: ones). At and above it — the B5/B6 serving regime — the device path
+#: transfers only the changed rows instead of eight full [P] arrays.
+DEVICE_DIFF_MIN_P = 8192
+
+#: floor of the "small" compaction bucket (rows). Two capacity buckets per
+#: model shape — small for warm drift windows, full-P for cold results —
+#: so repeat warm windows and repeat cold solves each reuse ONE compiled
+#: compaction program: a fluctuating drift size must never recompile
+#: mid-steady-loop (the zero-warm-fresh-compile tripwires ride on this).
+SMALL_DIFF_FLOOR = 1024
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def _small_cap(P: int) -> int:
+    """Small-bucket row capacity for a P-partition model: pow2 of
+    max(floor, P/16), clamped to P — covers ~6% of partitions changing,
+    an order of magnitude above the steady-state drift contract."""
+    return min(_pow2_ceil(max(SMALL_DIFF_FLOOR, P // 16)), P)
+
+
+def _device_programs():
+    """Lazy jit-program pair (mask+count, bucketed compaction) — jax is
+    imported on first device diff so row-only consumers stay light."""
+    global _DIFF_MASK, _DIFF_COMPACT
+    if _DIFF_MASK is not None:
+        return _DIFF_MASK, _DIFF_COMPACT
+    import jax
+    import jax.numpy as jnp
+
+    from ccx.common import costmodel
+
+    @costmodel.instrument("device-diff-mask")
+    @jax.jit
+    def _mask(pvalid, a0, a1, l0, l1, d0, d1):
+        changed = pvalid & (
+            jnp.any(a0 != a1, axis=1)
+            | (l0 != l1)
+            | jnp.any(d0 != d1, axis=1)
+        )
+        return changed, jnp.sum(changed, dtype=jnp.int32)
+
+    @costmodel.instrument("device-diff-compact")
+    @functools.partial(jax.jit, static_argnames=("cap",))
+    def _compact(changed, topics, a0, a1, l0, l1, d0, d1, *, cap):
+        # prefix-sum compaction: indices of the first `cap` changed rows
+        # (ascending partition order, matching np.nonzero); rows past the
+        # true count gather partition 0's data and are sliced off on host
+        idx = jnp.nonzero(changed, size=cap, fill_value=0)[0]
+        g0 = a0[idx]
+        g1 = a1[idx]
+        R = a0.shape[1]
+        s0 = jnp.clip(l0[idx], 0, R - 1)[:, None]
+        s1 = jnp.clip(l1[idx], 0, R - 1)[:, None]
+        old_lead = jnp.where(
+            (g0 >= 0).any(axis=1),
+            jnp.take_along_axis(g0, s0, axis=1)[:, 0], -1,
+        )
+        new_lead = jnp.where(
+            (g1 >= 0).any(axis=1),
+            jnp.take_along_axis(g1, s1, axis=1)[:, 0], -1,
+        )
+        return {
+            "partition": idx.astype(jnp.int32),
+            "topic": topics[idx],
+            "oldReplicas": g0,
+            "newReplicas": g1,
+            "oldLeader": old_lead.astype(jnp.int32),
+            "newLeader": new_lead.astype(jnp.int32),
+            "oldDisks": jnp.where(g0 >= 0, d0[idx], -1),
+            "newDisks": jnp.where(g1 >= 0, d1[idx], -1),
+        }
+
+    _DIFF_MASK, _DIFF_COMPACT = _mask, _compact
+    return _mask, _compact
+
+
+_DIFF_MASK = None
+_DIFF_COMPACT = None
+
+
+#: columnar schema field order (the wire blob and every consumer iterate
+#: in this order; scalars first, then the [N, R] slot arrays)
+COLUMNS = (
+    "partition", "topic", "oldReplicas", "newReplicas",
+    "oldLeader", "newLeader", "oldDisks", "newDisks",
+)
+
+
+class ColumnarDiff:
+    """The canonical diff: one ``diff_columnar``-schema column set, with
+    the row ``ExecutionProposal`` list derived lazily (and cached) only
+    when a consumer actually wants rows. Movement counters are vectorized
+    over the columns, so ``include_proposals=False`` results never touch
+    a Python row object."""
+
+    __slots__ = ("cols", "_rows")
+
+    def __init__(self, cols: dict[str, np.ndarray]) -> None:
+        self.cols = cols
+        self._rows = None
+
+    def __repr__(self) -> str:  # dataclass-embedded: keep it one line
+        return f"ColumnarDiff(n={self.n})"
+
+    @property
+    def n(self) -> int:
+        return int(self.cols["partition"].shape[0])
+
+    def __len__(self) -> int:
+        return self.n
+
+    @property
+    def num_replica_movements(self) -> int:
+        """Sum of per-row ``data_to_move`` (replicas changing broker),
+        vectorized: a new replica counts when its broker is absent from
+        the row's old set (brokers are distinct within a set, so count
+        equals set difference size)."""
+        new = self.cols["newReplicas"]
+        old = self.cols["oldReplicas"]
+        if new.size == 0:
+            return 0
+        member = (new[:, :, None] == old[:, None, :]).any(axis=2)
+        return int(((new >= 0) & ~member).sum())
+
+    @property
+    def num_leadership_movements(self) -> int:
+        return int((self.cols["oldLeader"] != self.cols["newLeader"]).sum())
+
+    @property
+    def rows(self) -> list[ExecutionProposal]:
+        """The row view, materialized on first access (bulk ``tolist``
+        conversion — per-element numpy indexing is ~100x slower at
+        B5-scale diffs)."""
+        if self._rows is None:
+            c = self.cols
+            out: list[ExecutionProposal] = []
+            for p, t, r0, r1, s0, s1, k0, k1 in zip(
+                c["partition"].tolist(),
+                c["topic"].tolist(),
+                c["oldReplicas"].tolist(),
+                c["newReplicas"].tolist(),
+                c["oldLeader"].tolist(),
+                c["newLeader"].tolist(),
+                c["oldDisks"].tolist(),
+                c["newDisks"].tolist(),
+            ):
+                out.append(
+                    ExecutionProposal(
+                        partition=p,
+                        topic=t,
+                        old_replicas=tuple(b for b in r0 if b >= 0),
+                        new_replicas=tuple(b for b in r1 if b >= 0),
+                        old_leader=s0,
+                        new_leader=s1,
+                        old_disks=tuple(
+                            d for d, b in zip(k0, r0) if b >= 0
+                        ),
+                        new_disks=tuple(
+                            d for d, b in zip(k1, r1) if b >= 0
+                        ),
+                    )
+                )
+            self._rows = out
+        return self._rows
+
+    def rows_json(self) -> list[dict]:
+        return [p.to_json() for p in self.rows]
+
+
+def columnar_diff(
+    before: TensorClusterModel,
+    after: TensorClusterModel,
+    backend: str | None = None,
+) -> ColumnarDiff:
+    """The one diff source of the result path (round 15): compiled
+    on-device mask + bucketed compaction for serving-scale models
+    (``DEVICE_DIFF_MIN_P``), transferring only the changed rows; small
+    models (and ``backend="numpy"`` / env ``CCX_DEVICE_DIFF=0``) run the
+    host reference, which is cheaper than any compile at that scale.
+    Any device-path surprise degrades to the numpy reference — a diff
+    must never fail a proposal."""
+    if backend is None:
+        env = os.environ.get(ENV_DEVICE_DIFF)
+        if env == "0":
+            backend = "numpy"
+        elif env == "1":
+            backend = "device"
+        else:
+            backend = (
+                "device" if int(before.P) >= DEVICE_DIFF_MIN_P else "numpy"
+            )
+    if backend == "device":
+        try:
+            return ColumnarDiff(_device_diff(before, after))
+        except Exception:  # noqa: BLE001 — degrade to the host reference
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "device diff failed; falling back to numpy"
+            )
+    return ColumnarDiff(diff_columnar(before, after))
+
+
+def _device_diff(
+    before: TensorClusterModel, after: TensorClusterModel
+) -> dict[str, np.ndarray]:
+    mask, compact = _device_programs()
+    changed, n_dev = mask(
+        before.partition_valid,
+        before.assignment, after.assignment,
+        before.leader_slot, after.leader_slot,
+        before.replica_disk, after.replica_disk,
+    )
+    n = int(n_dev)  # the path's single scalar sync (picks the bucket)
+    P = int(before.P)
+    small = _small_cap(P)
+    cap = small if n <= small else P
+    dev = compact(
+        changed, before.partition_topic,
+        before.assignment, after.assignment,
+        before.leader_slot, after.leader_slot,
+        before.replica_disk, after.replica_disk,
+        cap=cap,
+    )
+    # one bulk device->host transfer per column, cap rows each; the rows
+    # past n gathered partition 0 as filler and are sliced off here
+    return {k: np.asarray(dev[k])[:n] for k in COLUMNS}

@@ -28,8 +28,10 @@ cluster session, and on a metrics window
    improving (``ccx.common.convergence`` tolerances). The window is host
    data: retuning it never recompiles anything;
 4. **emit a minimal diff** — the proposal is the placement delta against
-   the warm base (``ccx.proposals.diff``/``diff_columnar``), which at a
-   1 % metrics drift is a few hundred rows, not a 60k full plan.
+   the warm base (``ccx.proposals.columnar_diff``, the compiled device
+   diff since round 15 — only the changed rows cross device→host),
+   which at a 1 % metrics drift is a few hundred rows, not a 60k full
+   plan.
 
 Gating: the whole subsystem is OFF unless armed — config
 ``optimizer.incremental.enabled`` (REST-overridable) or an explicit

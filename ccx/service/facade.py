@@ -42,7 +42,7 @@ from ccx.monitor.metricdef import BROKER_METRIC_DEF
 from ccx.optimizer import OptimizeOptions, OptimizerResult, optimize
 from ccx.search.annealer import AnnealOptions
 from ccx.search.greedy import GreedyOptions, greedy_optimize
-from ccx.proposals import diff
+from ccx.proposals import columnar_diff
 
 
 class CruiseControl:
@@ -404,18 +404,18 @@ class CruiseControl:
             out_model, stack_after, _ = finalize_preferred_leaders(
                 g.model, self.goal_config, goal_names, g.stack_after
             )
-            proposals = diff(model, out_model)
+            dcols = columnar_diff(model, out_model)
             stack_before = evaluate_stack(model, self.goal_config, goal_names)
             verification = verify_optimization(
                 model, out_model, self.goal_config, goal_names,
-                proposals=proposals,
+                proposals=dcols,
                 require_hard_zero=opts.require_hard_zero,
                 check_evacuation=opts.check_evacuation,
                 stack_before=stack_before,
                 stack_after=stack_after,
             )
             return OptimizerResult(
-                proposals=proposals,
+                diff=dcols,
                 stack_before=stack_before,
                 stack_after=stack_after,
                 verification=verification,

@@ -57,7 +57,9 @@ class SidecarClient:
                 goals: tuple[str, ...] = (), on_progress=None,
                 columnar: bool = False, cluster_id: str | None = None,
                 priority: int | None = None, warm_start: bool = False,
-                base_generation: int | None = None, **options) -> dict:
+                base_generation: int | None = None,
+                stream_result: bool | None = None,
+                timings: dict | None = None, **options) -> dict:
         """``columnar=True`` requests the proposals as one raw-buffer
         arrays blob (``diff_columnar`` schema) instead of per-proposal
         maps — the fast path for B5-scale results; the returned dict then
@@ -67,29 +69,88 @@ class SidecarClient:
         (higher preempts at the next chunk boundary). ``warm_start``
         (round 14) asks the server to warm-start from the session's last
         converged placement at ``base_generation`` — incremental
-        re-optimization with graceful cold-start fallback."""
+        re-optimization with graceful cold-start fallback.
+
+        ``stream_result`` (round 15; default: follows ``columnar``) asks
+        the server to ship the columnar blob as incremental
+        ``resultSegment`` frames — this client reassembles them and
+        returns the same dict shape as the monolithic form (including the
+        ``goalSummary`` list, reconstructed from the streamed flat-array
+        form). ``timings`` (optional dict) receives client-side decode
+        seconds and frame counts — the ``bench.py --wire`` split."""
+        import time as _time
+
+        if stream_result is None:
+            stream_result = columnar
         req = wire.propose_request(
             goals=goals, options=options,
             snapshot=_pack_model(model) if model is not None else None,
             session=session, columnar=columnar,
             cluster_id=cluster_id, priority=priority,
             warm_start=warm_start, base_generation=base_generation,
+            stream_result=bool(stream_result and columnar),
         )
         result: dict | None = None
+        segments: list[bytes] = []
+        n_frames = 0
         for raw in self._propose(req):
             update = wire.decode_frame(raw)  # raises SidecarError on error
+            n_frames += 1
+            if wire.FIELD_RESULT_SEGMENT in update:
+                segments.append(update["data"])
+                continue
             if "progress" in update and on_progress:
                 on_progress(update["progress"])
             if "result" in update:
                 result = update["result"]
         if result is None:
             raise wire.SidecarError("stream ended without a result")
+        t0 = _time.monotonic()
+        expected = result.get("proposalsColumnarSegments")
+        if expected is not None:
+            if len(segments) != int(expected):
+                raise wire.SidecarError(
+                    f"result stream truncated: {len(segments)} of "
+                    f"{expected} segments received"
+                )
+            blob = b"".join(segments)
+            want = result.get("proposalsColumnarBytes")
+            if want is not None and len(blob) != int(want):
+                raise wire.SidecarError(
+                    f"result stream corrupt: {len(blob)} joined bytes, "
+                    f"server sent {want}"
+                )
+            result["proposalsColumnar"] = blob
         if isinstance(result.get("proposalsColumnar"), (bytes, bytearray)):
             from ccx.model.snapshot import decode_msgpack
 
             result["proposalsColumnar"] = decode_msgpack(
                 result["proposalsColumnar"]
             )
+        if isinstance(result.get("goalSummaryColumnar"), (bytes, bytearray)):
+            # streamed terminal frames carry the goal summary as flat
+            # typed arrays — reconstruct the per-goal dict list so every
+            # consumer sees one result shape regardless of transport
+            from ccx.model.snapshot import decode_msgpack
+
+            gs = decode_msgpack(result.pop("goalSummaryColumnar"))
+            result["goalSummary"] = [
+                {
+                    "goal": g, "hard": bool(h),
+                    "violationsBefore": float(vb),
+                    "violationsAfter": float(va),
+                    "costBefore": float(cb), "costAfter": float(ca),
+                }
+                for g, h, vb, va, cb, ca in zip(
+                    gs["goal"], gs["hard"],
+                    gs["violationsBefore"], gs["violationsAfter"],
+                    gs["costBefore"], gs["costAfter"],
+                )
+            ]
+        if timings is not None:
+            timings["decode_s"] = _time.monotonic() - t0
+            timings["frames"] = n_frames
+            timings["segments"] = len(segments)
         return result
 
     def close(self) -> None:

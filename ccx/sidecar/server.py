@@ -18,6 +18,7 @@ mitigation).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from concurrent import futures
 
@@ -38,6 +39,15 @@ from ccx.search.greedy import GreedyOptions
 from ccx.sidecar import SERVICE, identity as _identity, wire
 
 log = logging.getLogger(__name__)
+
+#: streamed-result segment size (round 15): the columnar proposals blob
+#: is sliced into chunks of this many bytes, each riding one
+#: ``resultSegment`` frame. 1 MB keeps every frame far under the gRPC
+#: message ceiling while a B5 cold result (~5 MB of columns) still ships
+#: in a handful of frames. Env-overridable for tests / tuning.
+RESULT_SEGMENT_BYTES = int(
+    os.environ.get("CCX_RESULT_SEGMENT_BYTES", str(1 << 20))
+)
 
 
 class SnapshotRegistry:
@@ -124,7 +134,15 @@ class SnapshotRegistry:
     @staticmethod
     def _graft_metrics(model, arrays: dict, changed: set):
         """The new load tensors padded and replaced on the device model
-        (None on any surprise — the caller falls back to a rebuild)."""
+        (None on any surprise — the caller falls back to a rebuild).
+
+        Zero-copy ingest (round 15): the decoded delta arrays are
+        ``np.frombuffer`` views straight into the msgpack payload —
+        they transfer to the device AS-IS (one host→device copy of the
+        dense bytes, no intermediate host pad buffer) and the padding to
+        the model's bucketed [RES, Pp] shape happens on device. At fleet
+        rates this is the difference between one memcpy per delta put
+        and three."""
         try:
             import jax.numpy as jnp
             import numpy as np
@@ -137,11 +155,13 @@ class SnapshotRegistry:
                 dense = np.asarray(arrays[k], np.float32).reshape(
                     NUM_RESOURCES, -1
                 )
-                if dense.shape[1] > Pp:
+                n = dense.shape[1]
+                if n > Pp:
                     return None
-                padded = np.zeros((NUM_RESOURCES, Pp), np.float32)
-                padded[:, : dense.shape[1]] = dense
-                reps[k] = jnp.asarray(padded)
+                dev = jnp.asarray(dense)  # the view's one host->device copy
+                if n < Pp:
+                    dev = jnp.pad(dev, ((0, 0), (0, Pp - n)))
+                reps[k] = dev
             return model.replace(**reps)
         except Exception:  # noqa: BLE001 — fast path only, rebuild covers
             return None
@@ -222,6 +242,14 @@ class OptimizerSidecar:
         self.goal_config = goal_config or GoalConfig()
         self.registry = SnapshotRegistry(snapshot_hbm_budget_bytes)
         self._lock = threading.Lock()
+        #: session -> (generation, ClusterModelStats) — the INPUT-side
+        #: stats block of the session's current snapshot. The registry
+        #: already caches the built device model per generation; its
+        #: distribution stats are just as immutable, so a repeat Propose
+        #: of the same generation must not re-pay the aggregate pass +
+        #: host transfer (~130 ms at B5) that prices them. One entry per
+        #: session (latest generation wins).
+        self._input_stats: dict[str, tuple[int, object]] = {}
 
     # ----- PutSnapshot ------------------------------------------------------
 
@@ -533,12 +561,14 @@ class OptimizerSidecar:
         # cache) — the steady-state loop: cold Propose banks, every later
         # warm_start Propose resolves. Gated on the env kill-switch so
         # CCX_INCREMENTAL=0 keeps today's exact behavior (and programs).
+        bank_s = 0.0
         if (
             session is not None
             and cur_gen is not None
             and incr.env_enabled()
             and res.verification.ok
         ):
+            t_bank = _time.monotonic()
             # a warm result carries its pressure bank precomputed (the
             # fused warm_finish program) — the bank costs nothing extra
             incr.remember(session, cur_gen, res.model, self.goal_config,
@@ -553,7 +583,12 @@ class OptimizerSidecar:
 
             if _cm.capture_enabled() and _cm.pending_count():
                 _cm.capture_pending()
+            # priced separately (wireSeconds.bank): session bookkeeping
+            # for the NEXT warm window, not part of the proposals-down
+            # leg this response's consumer is waiting on
+            bank_s = _time.monotonic() - t_bank
         columnar = bool(req.get("columnar_proposals"))
+        stream = columnar and bool(req.get(wire.FIELD_STREAM_RESULT))
         # warm-started results omit the ClusterModelStats blocks: two
         # full aggregate passes + bulk host transfers (~260 ms at B5)
         # have no place in a <500 ms steady-state window — the
@@ -561,26 +596,79 @@ class OptimizerSidecar:
         warm_applied = bool(
             res.incremental is not None and res.incremental.get("warmStart")
         )
+        if session is not None and cur_gen is not None and not warm_applied:
+            # input-side stats memo: the session's snapshot at this
+            # generation is immutable, so its ClusterModelStats block is
+            # too — seed the result's lazy cache from the memo (repeat
+            # proposes skip the aggregate pass), bank the computed block
+            # after serialization otherwise
+            with self._lock:
+                memo = self._input_stats.get(session)
+            if memo is not None and memo[0] == cur_gen:
+                res._stats_before = memo[1]
+        t_asm = _time.monotonic()
         result = res.to_json(
-            include_proposals=not columnar, include_stats=not warm_applied
+            include_proposals=not columnar, include_stats=not warm_applied,
+            # streamed results ship the goal summary as flat typed arrays
+            # below — never build the per-goal dicts just to discard them
+            # (and never bill them to the wireSeconds.assembly leg)
+            include_goal_summary=not stream,
         )
+        asm_s = _time.monotonic() - t_asm
+        if (
+            session is not None and cur_gen is not None and not warm_applied
+            and res.stats_before is not None
+        ):
+            with self._lock:
+                self._input_stats[session] = (cur_gen, res.stats_before)
         if warm_req and cold_reason is not None and "incremental" not in result:
             # requested warm but cold-started: say so (and why) on the
             # result, in the same block a warm run reports through
             result["incremental"] = {
                 "warmStart": False, "coldStart": True, "reason": cold_reason,
             }
-        if columnar:
-            # proposals-down dominated the hop's wire cost at B5 (~0.9 s of
-            # per-proposal maps for ~60k proposals — perf-notes "Sidecar-
-            # inclusive T1"); columnar mode replaces the row list with one
-            # raw-buffer arrays blob (ccx.proposals.diff_columnar schema)
-            from ccx.model.snapshot import pack_arrays
-            from ccx.proposals import diff_columnar
+        if not columnar:
+            yield wire.result_frame(result)
+            return
+        # columnar result path (round 15): the optimizer's device-diff
+        # columns ARE the result — no second diff pass here (the round-14
+        # server paid ccx.proposals.diff inside optimize() AND
+        # diff_columnar here; one columnar source now serves both views)
+        from ccx.model.snapshot import pack_arrays
 
-            cols = diff_columnar(res.input_model, res.model)
-            result["numProposals"] = int(cols["partition"].shape[0])
-            result["proposalsColumnar"] = pack_arrays(cols)
+        result["numProposals"] = res.diff.n
+        t_pack = _time.monotonic()
+        blob = pack_arrays(res.diff.cols)
+        pack_s = _time.monotonic() - t_pack
+        # wire-path self-pricing (bench.py --wire reads these): host
+        # result assembly vs columnar blob packing, in seconds. Additive
+        # and columnar-only — row-mode results (and the golden fixtures)
+        # are untouched.
+        result["wireSeconds"] = {
+            "assembly": round(asm_s, 6), "pack": round(pack_s, 6),
+            "bank": round(bank_s, 6),
+        }
+        if not stream:
+            # legacy columnar client (pre-round-15): one monolithic blob
+            result["proposalsColumnar"] = blob
+            yield wire.result_frame(result)
+            return
+        # streamed columnar result (round 15): the blob rides the
+        # progress stream as incremental segment frames; the terminal
+        # frame carries only scalar blocks, with the goal summary as flat
+        # typed arrays — packing it walks no per-goal (let alone per-row)
+        # Python objects
+        result["goalSummaryColumnar"] = pack_arrays(
+            res.goal_summary_columnar()
+        )
+        seg_bytes = max(int(RESULT_SEGMENT_BYTES), 1)
+        total = max((len(blob) + seg_bytes - 1) // seg_bytes, 1)
+        result["proposalsColumnarSegments"] = total
+        result["proposalsColumnarBytes"] = len(blob)
+        for i in range(total):
+            yield wire.result_segment_frame(
+                i, total, blob[i * seg_bytes: (i + 1) * seg_bytes]
+            )
         yield wire.result_frame(result)
 
     def ping(self, request: bytes) -> bytes:

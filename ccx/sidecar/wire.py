@@ -50,6 +50,18 @@ FIELD_JOB = "job"
 #: cold-starts gracefully (the result's ``incremental`` block names the
 #: reason), never fails the RPC.
 FIELD_WARM_START = "warm_start"
+#: streamed columnar results (round 15, additive): a Propose carrying
+#: ``stream_result`` true (meaningful only with ``columnar_proposals``)
+#: asks the sidecar to ship the columnar proposals blob as incremental
+#: ``resultSegment`` frames riding the progress stream, with the terminal
+#: ``result`` frame carrying only the scalar blocks (goal summary as flat
+#: typed arrays, counters, verification) — frame packing never holds the
+#: whole blob in one envelope. Absent ⇒ the monolithic result frame,
+#: pre-round-15 semantics (the legacy-client compatibility pin).
+FIELD_STREAM_RESULT = "stream_result"
+#: segment-frame field: the 0-based sequence number of this segment
+#: (``of`` carries the total, ``data`` the raw blob bytes)
+FIELD_RESULT_SEGMENT = "resultSegment"
 
 # ----- structured error codes ----------------------------------------------
 
@@ -209,7 +221,8 @@ def propose_request(goals: Iterable[str] = (), options: dict | None = None,
                     columnar: bool = False,
                     cluster_id: str | None = None,
                     priority: int | None = None,
-                    warm_start: bool = False) -> bytes:
+                    warm_start: bool = False,
+                    stream_result: bool = False) -> bytes:
     req: dict = {"goals": list(goals), "options": dict(options or {})}
     if warm_start:
         # incremental re-optimization (round 14, additive): warm-start
@@ -228,6 +241,10 @@ def propose_request(goals: Iterable[str] = (), options: dict | None = None,
         req["generation"] = int(generation)
     if columnar:
         req["columnar_proposals"] = True
+    if stream_result:
+        # streamed columnar result (round 15, additive): segment frames +
+        # a scalar terminal frame; absent ⇒ one monolithic result frame
+        req["stream_result"] = True
     if cluster_id is not None:
         # fleet serving (round 12, additive): the job id this Propose runs
         # under on the multi-job chunk scheduler; absent ⇒ session id
@@ -288,6 +305,19 @@ def heartbeat_frame(text: str, span: str | None = None,
 
 def result_frame(result: dict) -> dict:
     return _stamped({"result": result})
+
+
+def result_segment_frame(seq: int, total: int, data: bytes) -> dict:
+    """One incremental columnar-result segment (round 15): ``data`` is a
+    raw slice of the ``proposalsColumnar`` arrays blob; the client
+    concatenates segments in ``resultSegment`` order and decodes the
+    joined bytes exactly like a monolithic blob. The terminal ``result``
+    frame follows the last segment and carries
+    ``proposalsColumnarSegments``/``proposalsColumnarBytes`` so a
+    truncated stream is detectable, never silently short."""
+    return _stamped({
+        FIELD_RESULT_SEGMENT: int(seq), "of": int(total), "data": data,
+    })
 
 
 def error_frame(message: str, code: str = ERR_INVALID) -> dict:

@@ -513,3 +513,101 @@ def test_steady_rides_cli_table_and_check(tmp_path, capsys):
 def test_steady_rung_is_wired_into_campaign_script():
     sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
     assert "CCX_BENCH_STEADY=1" in sh
+
+
+# ----- wire (WIRE_r*.json — bench.py --wire) ---------------------------------
+
+
+def _wire_line(p50=42.0, verified=True, cores=2, drift=0.01, **extra):
+    return {
+        "metric": "B5 warm end-to-end sidecar round-trip, optimizer "
+                  "excluded (1% drift windows, streamed columnar, p50)",
+        "value": p50, "unit": "ms", "vs_baseline": 4.0, "wire": True,
+        "config": "B5", "n_iters": 20, "drift_fraction": drift,
+        "backend": "cpu", "host_cores": cores, "verified": verified,
+        "warm_ms": {"p50": p50, "p99": p50 * 1.3, "values": [p50]},
+        "split_ms": {"put": 3.0, "optimize": 380.0, "diff": 4.0,
+                     "assembly": 2.0, "pack": 1.0, "decode": 1.5,
+                     "transport": 10.0},
+        "cold": {"rtt_s": 31.0, "down_s": 0.15, "rows": 62000},
+        "cold_down_s": 0.15, "diff_rows": 1500, "segments": 1,
+        "all_warm_started": verified,
+        "zero_warm_fresh_compiles": verified,
+        "effort": {"warm_swap_iters": 8, "plateau_window": 1,
+                   "cold": {"chains": 16, "steps": 250}},
+        **extra,
+    }
+
+
+def _bank_wire(tmp_path, n, line):
+    (tmp_path / f"WIRE_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_wire_rows_parse(tmp_path):
+    _bank_wire(tmp_path, 1, _wire_line())
+    rows, partials = bench_ledger.load_wire(str(tmp_path))
+    assert partials == []
+    (r,) = rows
+    assert r["p50_ms"] == 42.0 and r["verified"]
+    assert r["cold_down_s"] == 0.15 and r["split_ms"]["diff"] == 4.0
+
+
+def test_wire_p50_regression_fails(tmp_path):
+    _bank_wire(tmp_path, 1, _wire_line(p50=42.0))
+    _bank_wire(tmp_path, 2, _wire_line(p50=60.0))
+    rows, _ = bench_ledger.load_wire(str(tmp_path))
+    failures = bench_ledger.check_wire(rows)
+    assert failures and "p50" in failures[0]
+
+
+def test_wire_within_threshold_passes(tmp_path):
+    _bank_wire(tmp_path, 1, _wire_line(p50=42.0))
+    _bank_wire(tmp_path, 2, _wire_line(p50=45.0))
+    rows, _ = bench_ledger.load_wire(str(tmp_path))
+    assert bench_ledger.check_wire(rows) == []
+
+
+def test_wire_unverified_latest_fails(tmp_path):
+    _bank_wire(tmp_path, 1, _wire_line(verified=False))
+    rows, _ = bench_ledger.load_wire(str(tmp_path))
+    failures = bench_ledger.check_wire(rows)
+    assert failures and "UNVERIFIED" in failures[0]
+
+
+def test_wire_different_drift_or_host_not_comparable(tmp_path):
+    _bank_wire(tmp_path, 1, _wire_line(p50=10.0, drift=0.001))
+    _bank_wire(tmp_path, 2, _wire_line(p50=42.0, drift=0.01))
+    _bank_wire(tmp_path, 3, _wire_line(p50=90.0, cores=8))
+    rows, _ = bench_ledger.load_wire(str(tmp_path))
+    assert bench_ledger.check_wire(rows) == []
+
+
+def test_wire_partial_round_reported_not_failed(tmp_path):
+    (tmp_path / "WIRE_r03.json").write_text(
+        json.dumps({"n": 3, "rc": 124, "parsed": None})
+    )
+    rows, partials = bench_ledger.load_wire(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+    assert bench_ledger.check_wire(rows) == []
+
+
+def test_wire_gate_green_on_banked_artifacts():
+    """The repo's own WIRE artifacts must pass the gate."""
+    rows, _ = bench_ledger.load_wire(str(REPO))
+    assert bench_ledger.check_wire(rows) == []
+
+
+def test_wire_rides_cli_table_and_check(tmp_path, capsys):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_wire(tmp_path, 1, _wire_line())
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 0
+    bench_ledger.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "result path / wire split" in out and "cold dn s" in out
+
+
+def test_wire_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_WIRE=1" in sh

@@ -121,6 +121,8 @@ def test_java_wire_constants_match_python():
         "FIELD_CLUSTER_ID": wire.FIELD_CLUSTER_ID,
         "FIELD_PRIORITY": wire.FIELD_PRIORITY,
         "FIELD_JOB": wire.FIELD_JOB,
+        "FIELD_STREAM_RESULT": wire.FIELD_STREAM_RESULT,
+        "FIELD_RESULT_SEGMENT": wire.FIELD_RESULT_SEGMENT,
         "ERR_UNSUPPORTED_VERSION": wire.ERR_UNSUPPORTED_VERSION,
         "ERR_MALFORMED": wire.ERR_MALFORMED,
         "ERR_BAD_SNAPSHOT": wire.ERR_BAD_SNAPSHOT,

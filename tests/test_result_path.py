@@ -1,0 +1,471 @@
+"""Columnar zero-copy result path (ISSUE 11): device diff parity, lazy
+row derivation, vectorized movement counters, streamed result segments,
+legacy-client compatibility, and the compile-stability tripwire.
+
+The contract under test: ``ccx.proposals.ColumnarDiff`` is the CANONICAL
+diff representation (flat int32 columns off a compiled device program),
+the row ``ExecutionProposal`` list is a lazy view, and the sidecar ships
+large columnar results as incremental ``resultSegment`` frames (wire
+round 15) — while every pre-round-15 client shape (row mode, monolithic
+columnar) stays bit-for-bit compatible.
+"""
+
+import msgpack
+import numpy as np
+import pytest
+
+from ccx.goals.base import GoalConfig
+from ccx.model.fixtures import RandomClusterSpec, random_cluster, small_deterministic
+from ccx.model.snapshot import decode_msgpack, model_to_arrays, pack_arrays, to_msgpack
+from ccx.proposals import (
+    ColumnarDiff,
+    _small_cap,
+    columnar_diff,
+    diff,
+    diff_columnar,
+)
+from ccx.sidecar import wire
+from ccx.sidecar.server import OptimizerSidecar, make_grpc_server
+
+GOALS_3 = (
+    "RackAwareGoal",
+    "ReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+)
+#: minimal engine budgets — these tests pin result-path plumbing, not
+#: search quality. Iteration budgets are traced loop DATA (free to
+#: floor); chains/candidate counts are program SHAPE and deliberately
+#: match tests/test_sidecar.py's lean proposes, so across the tier-1
+#: run both modules share one compiled program set (the suite rides
+#: close to the 870 s wall).
+FAST = {
+    "chains": 4, "steps": 50, "polish_max_iters": 4,
+    "polish_patience": 2, "run_cold_greedy": False,
+    "topic_rebalance_rounds": 0, "max_repair_rounds": 1,
+}
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """A (before, after) model pair with every diff row flavor: replica
+    moves, leadership-only moves, disk (intra-broker) moves, and a
+    dead-broker evacuation — the cases the device diff must compact
+    identically to the numpy reference."""
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=8, n_racks=4, n_topics=4, n_partitions=64, n_disks=2,
+        seed=11,
+    ))
+    a = np.asarray(m.assignment).copy()
+    ls = np.asarray(m.leader_slot).copy()
+    dk = np.asarray(m.replica_disk).copy()
+    alive = np.asarray(m.broker_alive).copy()
+    pvalid = np.asarray(m.partition_valid)
+
+    # dead broker 0: evacuate every replica it holds to broker 1 (or 2
+    # when 1 is already in the replica set) — the self-healing row shape
+    alive_after = alive.copy()
+    alive_after[0] = False
+    for p in range(a.shape[0]):
+        if not pvalid[p]:
+            continue
+        row = a[p]
+        if 0 in row[row >= 0]:
+            dst = 1 if 1 not in row else 2
+            a[p, np.nonzero(row == 0)[0][0]] = dst
+    # leadership-only move on partition 3, replica move on 5, disk move
+    # on 7 (valid fixture partitions by construction)
+    if (a[3] >= 0).sum() > 1:
+        ls[3] = (ls[3] + 1) % int((a[3] >= 0).sum())
+    a[5, 0], a[5, 1] = a[5, 1], a[5, 0]
+    dk[7, 0] = (dk[7, 0] + 1) % 2
+    after = m.replace(
+        assignment=np.asarray(a), leader_slot=np.asarray(ls),
+        replica_disk=np.asarray(dk), broker_alive=np.asarray(alive_after),
+    )
+    return m, after
+
+
+# ----- device diff parity ----------------------------------------------------
+
+
+def test_device_diff_matches_numpy_columnar(pair):
+    before, after = pair
+    dev = columnar_diff(before, after, backend="device")
+    ref = diff_columnar(before, after)
+    assert dev.n == ref["partition"].shape[0] > 0
+    for k in ref:
+        np.testing.assert_array_equal(
+            dev.cols[k], ref[k], err_msg=f"column {k}"
+        )
+
+
+def test_device_diff_rows_match_row_reference(pair):
+    before, after = pair
+    dev = columnar_diff(before, after, backend="device")
+    assert dev.rows == diff(before, after)
+
+
+def test_numpy_backend_and_env_killswitch(pair, monkeypatch):
+    before, after = pair
+    ref = diff_columnar(before, after)
+    via_backend = columnar_diff(before, after, backend="numpy")
+    monkeypatch.setenv("CCX_DEVICE_DIFF", "0")
+    via_env = columnar_diff(before, after)
+    for k in ref:
+        np.testing.assert_array_equal(via_backend.cols[k], ref[k])
+        np.testing.assert_array_equal(via_env.cols[k], ref[k])
+
+
+def test_empty_diff(pair):
+    before, _ = pair
+    d = columnar_diff(before, before, backend="device")
+    assert d.n == 0 and d.rows == []
+    assert d.num_replica_movements == 0
+    assert d.num_leadership_movements == 0
+
+
+def test_small_models_default_to_the_numpy_diff(pair, monkeypatch):
+    """Size gate: below DEVICE_DIFF_MIN_P the default path must never
+    touch the device programs — compiling two programs per tiny fixture
+    shape is pure loss (and would tax the whole test suite)."""
+    import ccx.proposals as props
+
+    before, after = pair
+    monkeypatch.delenv("CCX_DEVICE_DIFF", raising=False)
+
+    calls = []
+    real = props._device_diff
+    monkeypatch.setattr(
+        props, "_device_diff",
+        lambda *a, **k: (calls.append(1), real(*a, **k))[1],
+    )
+    assert int(before.P) < props.DEVICE_DIFF_MIN_P
+    d = props.columnar_diff(before, after)
+    assert d.n > 0 and calls == []  # served by the numpy reference
+    monkeypatch.setenv("CCX_DEVICE_DIFF", "1")  # forced-on override
+    props.columnar_diff(before, after)
+    assert calls  # the override reaches the device path
+
+
+def test_verifier_rejects_non_left_packed_columnar_rows(pair):
+    """The columnar verify leg must keep the row path's left-packed-slot
+    invariant: a valid broker after a -1 hole (a malformed placement an
+    engine bug could produce) fails verification before the executor."""
+    from ccx.verify import _verify_proposals
+
+    before, after = pair
+    d = columnar_diff(before, after)
+    assert _verify_proposals(before, after, d) == []
+    bad = {k: v.copy() for k, v in d.cols.items()}
+    # malform row 0: a -1 hole at slot 0 with a valid broker after it
+    row = np.full(bad["newReplicas"].shape[1], -1, np.int32)
+    row[1] = np.max(bad["newReplicas"][0])
+    bad["newReplicas"][0] = row
+    failures = _verify_proposals(before, after, ColumnarDiff(bad))
+    assert any("left-packed" in f for f in failures)
+
+
+def test_small_cap_bucketing():
+    # two buckets per shape: pow2(max(1024, P/16)) clamped to P, else P —
+    # warm drift windows and cold results each reuse ONE compiled program
+    assert _small_cap(65536) == 4096
+    assert _small_cap(100000) == 8192
+    assert _small_cap(512) == 512  # clamp: small models use one bucket
+    assert _small_cap(20000) == 2048
+
+
+def test_movement_counters_vectorized_match_rows(pair):
+    before, after = pair
+    d = columnar_diff(before, after)
+    rows = diff(before, after)
+    assert d.num_replica_movements == sum(p.data_to_move for p in rows)
+    assert d.num_leadership_movements == sum(
+        1 for p in rows if p.old_leader != p.new_leader
+    )
+
+
+def test_counters_do_not_materialize_rows(pair):
+    before, after = pair
+    d = columnar_diff(before, after)
+    _ = d.num_replica_movements
+    _ = d.num_leadership_movements
+    assert d._rows is None  # lazy view untouched by the counters
+    _ = d.rows
+    assert d._rows is not None
+
+
+def test_device_diff_warm_recall_compiles_nothing(pair):
+    """Zero-warm-fresh-compile tripwire with the device diff armed: a
+    repeat diff of the same model shape (same capacity bucket) must hit
+    the jit cache — a steady-state loop can never recompile mid-flight."""
+    from ccx.common import compilestats
+
+    before, after = pair
+    columnar_diff(before, after, backend="device")  # compiles here
+    cs0 = compilestats.snapshot()
+    d = columnar_diff(before, after, backend="device")
+    fresh = compilestats.delta(cs0, compilestats.snapshot())
+    assert d.n > 0
+    assert fresh.get("backend_compiles", 0) == 0, fresh
+
+
+def test_optimizer_result_diff_is_columnar_and_lazy():
+    import dataclasses
+
+    from ccx.optimizer import OptimizeOptions, optimize
+    from ccx.search.annealer import AnnealOptions
+
+    m = small_deterministic()
+    base = OptimizeOptions()
+    res = optimize(
+        m, GoalConfig(), GOALS_3,
+        # shared program shapes (see FAST): default polish candidate
+        # count, the suite's 4-chain anneal; only traced budgets floored
+        dataclasses.replace(
+            base,
+            anneal=AnnealOptions(n_chains=4, n_steps=50),
+            polish=dataclasses.replace(
+                base.polish, max_iters=4, patience=2
+            ),
+            run_cold_greedy=False, topic_rebalance_rounds=0,
+            max_repair_rounds=1,
+        ),
+    )
+    assert isinstance(res.diff, ColumnarDiff)
+    # include_proposals=False serialization never touches the row view
+    j = res.to_json(include_proposals=False)
+    assert "proposals" not in j and res.diff._rows is None
+    assert j["numReplicaMovements"] == res.diff.num_replica_movements
+    # the row property materializes on demand and agrees with the columns
+    assert len(res.proposals) == res.diff.n
+    assert res.proposals == diff(m, res.model)
+
+
+# ----- wire round 15: streamed result frames ---------------------------------
+
+
+def _propose_frames(sidecar, req: dict) -> list[dict]:
+    return list(sidecar.propose(msgpack.packb(req)))
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One solved Propose in all three transports against one sidecar
+    (row, monolithic columnar, streamed columnar), plus the raw frames."""
+    sidecar = OptimizerSidecar()
+    base = {
+        "snapshot": to_msgpack(small_deterministic()),
+        "goals": list(GOALS_3), "options": dict(FAST),
+    }
+    rows = [f["result"] for f in _propose_frames(sidecar, base)
+            if "result" in f][0]
+    mono = [f["result"] for f in _propose_frames(
+        sidecar, {**base, "columnar_proposals": True})
+        if "result" in f][0]
+    streamed = _propose_frames(
+        sidecar, {**base, "columnar_proposals": True, "stream_result": True}
+    )
+    return rows, mono, streamed
+
+
+def test_row_mode_unchanged_by_round_15(served):
+    rows, _, _ = served
+    # the legacy row-mode result shape is untouched: per-proposal maps,
+    # per-goal dict summary, and NO round-15 keys
+    assert "proposals" in rows and "goalSummary" in rows
+    for k in ("wireSeconds", "proposalsColumnarSegments",
+              "goalSummaryColumnar", "proposalsColumnar"):
+        assert k not in rows
+
+
+def test_monolithic_columnar_is_legacy_compatible(served):
+    _, mono, _ = served
+    # a pre-round-15 columnar client (no stream_result) still gets ONE
+    # result frame with the whole blob — the compatibility pin
+    assert "proposalsColumnar" in mono
+    assert "proposalsColumnarSegments" not in mono
+    assert "goalSummary" in mono and "goalSummaryColumnar" not in mono
+
+
+def test_single_diff_source_no_second_pass(served):
+    rows, mono, _ = served
+    cols = decode_msgpack(mono["proposalsColumnar"])
+    assert mono["numProposals"] == cols["partition"].shape[0]
+    assert mono["numProposals"] == len(rows["proposals"])
+    # row and columnar transports describe the same movements
+    by_part = {p["topicPartition"]["partition"]: p
+               for p in rows["proposals"]}
+    for i in range(mono["numProposals"]):
+        p = by_part[int(cols["partition"][i])]
+        assert sorted(b for b in cols["newReplicas"][i] if b >= 0) \
+            == sorted(p["newReplicas"])
+        assert int(cols["newLeader"][i]) == p["newLeader"]
+
+
+def test_streamed_segments_reassemble_to_the_blob(served):
+    _, mono, streamed = served
+    segs = [f for f in streamed if wire.FIELD_RESULT_SEGMENT in f]
+    term = [f["result"] for f in streamed if "result" in f][0]
+    assert term["proposalsColumnarSegments"] == len(segs) >= 1
+    # segment frames precede the terminal frame, in sequence order
+    assert [f[wire.FIELD_RESULT_SEGMENT] for f in segs] \
+        == list(range(len(segs)))
+    blob = b"".join(f["data"] for f in segs)
+    assert len(blob) == term["proposalsColumnarBytes"]
+    got = decode_msgpack(blob)
+    want = decode_msgpack(mono["proposalsColumnar"])
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+def test_streamed_terminal_frame_is_scalar_only(served):
+    _, mono, streamed = served
+    term = [f["result"] for f in streamed if "result" in f][0]
+    assert "proposalsColumnar" not in term and "proposals" not in term
+    # flat typed goal summary replaces the per-goal dict maps
+    assert "goalSummary" not in term
+    gs = decode_msgpack(term["goalSummaryColumnar"])
+    ref = mono["goalSummary"]
+    assert list(gs["goal"]) == [g["goal"] for g in ref]
+    np.testing.assert_array_equal(
+        gs["hard"].astype(bool), [g["hard"] for g in ref]
+    )
+    np.testing.assert_allclose(
+        gs["violationsAfter"],
+        [g["violationsAfter"] for g in ref], rtol=1e-6,
+    )
+    assert "wireSeconds" in term  # the bench --wire split's server legs
+
+
+def test_client_reassembles_streamed_result_over_grpc(monkeypatch):
+    """The full client path: tiny segments force a multi-frame stream;
+    the client returns the SAME result shape as the monolithic form
+    (goalSummary reconstructed, columns decoded)."""
+    from ccx.sidecar import server as server_mod
+    from ccx.sidecar.client import SidecarClient
+
+    monkeypatch.setattr(server_mod, "RESULT_SEGMENT_BYTES", 64)
+    server, port = make_grpc_server(address="127.0.0.1:0")
+    server.start()
+    client = SidecarClient(f"127.0.0.1:{port}")
+    try:
+        m = small_deterministic()
+        t = {}
+        res = client.propose(model=m, goals=GOALS_3, columnar=True,
+                             timings=t, **FAST)
+        assert t["segments"] > 1  # 64-byte segments => multiple frames
+        assert "decode_s" in t and t["frames"] > t["segments"]
+        ref = client.propose(model=m, goals=GOALS_3, columnar=True,
+                             stream_result=False, **FAST)
+        for k in ref["proposalsColumnar"]:
+            np.testing.assert_array_equal(
+                res["proposalsColumnar"][k], ref["proposalsColumnar"][k]
+            )
+        assert [g["goal"] for g in res["goalSummary"]] \
+            == [g["goal"] for g in ref["goalSummary"]]
+        assert res["numProposals"] == ref["numProposals"]
+    finally:
+        client.close()
+        server.stop(0)
+
+
+def test_client_detects_truncated_segment_stream():
+    """A dropped segment frame must fail loudly (SidecarError), never
+    return a silently short proposal set."""
+
+    class DroppingSidecar(OptimizerSidecar):
+        def propose(self, request):
+            dropped = False
+            for f in super().propose(request):
+                if wire.FIELD_RESULT_SEGMENT in f and not dropped:
+                    dropped = True
+                    continue  # swallow the first segment
+                yield f
+
+    from ccx.sidecar import server as server_mod
+    from ccx.sidecar.client import SidecarClient
+
+    import unittest.mock as mock
+
+    with mock.patch.object(server_mod, "RESULT_SEGMENT_BYTES", 64):
+        server, port = make_grpc_server(
+            DroppingSidecar(), address="127.0.0.1:0"
+        )
+        server.start()
+        client = SidecarClient(f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(wire.SidecarError, match="truncated"):
+                client.propose(model=small_deterministic(), goals=GOALS_3,
+                               columnar=True, **FAST)
+        finally:
+            client.close()
+            server.stop(0)
+
+
+# ----- pack_arrays hot path --------------------------------------------------
+
+
+def test_pack_arrays_bytes_identical_to_canonicalize_path():
+    """The round-15 fast pack (canonical-by-construction, no recursive
+    deep copy) must emit byte-identical msgpack to the old
+    wire.canonicalize route — the golden snapshot fixtures ride on it."""
+    from ccx.model.snapshot import _BOOL_FIELDS
+
+    arrs = model_to_arrays(small_deterministic())
+
+    def old_pack(d):  # the pre-round-15 implementation, verbatim
+        enc = {}
+        for k, v in d.items():
+            if isinstance(v, np.ndarray):
+                a = np.ascontiguousarray(v)
+                if a.dtype == np.bool_:
+                    a = a.astype(np.uint8)
+                if a.dtype == np.int64:
+                    a = a.astype(np.int32)
+                if a.dtype == np.float64:
+                    a = a.astype(np.float32)
+                p = {"d": a.dtype.str, "s": list(a.shape),
+                     "b": a.tobytes()}
+                if k in _BOOL_FIELDS:
+                    p["bool"] = True
+                enc[k] = p
+            else:
+                enc[k] = v
+        return wire.packb(enc)
+
+    assert pack_arrays(arrs) == old_pack(arrs)
+    # columnar diff blobs too (the result-path hot case)
+    m = small_deterministic()
+    a = np.asarray(m.assignment).copy()
+    a[1, 0], a[1, 1] = a[1, 1], a[1, 0]
+    cols = diff_columnar(m, m.replace(assignment=np.asarray(a)))
+    assert pack_arrays(cols) == old_pack(cols)
+
+
+def test_zero_copy_metric_graft_matches_rebuild():
+    """The device-padded metric graft (round 15) must produce the same
+    model tensors as a full rebuild of the updated arrays."""
+    from ccx.model.snapshot import arrays_to_model
+    from ccx.sidecar.server import SnapshotRegistry
+
+    m = small_deterministic()
+    arrays = model_to_arrays(m)
+    reg = SnapshotRegistry()
+    reg.put("s", 1, arrays)
+    built = reg.model("s")
+    new = dict(arrays)
+    ll = np.asarray(arrays["leader_load"], np.float32).copy()
+    ll[:, : ll.shape[1] // 2] *= 1.25
+    new["leader_load"] = ll
+    reg.put("s", 2, new, changed={"leader_load"})
+    assert reg.delta_grafts == 1
+    grafted = reg.model("s")
+    rebuilt = arrays_to_model(new)
+    np.testing.assert_allclose(
+        np.asarray(grafted.leader_load), np.asarray(rebuilt.leader_load),
+        rtol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(grafted.follower_load),
+        np.asarray(built.follower_load),
+    )

@@ -34,6 +34,14 @@ This tool is the ledger and the tripwire:
   (config, backend, effort) round fails, as does an unverified curve.
   Rounds 1-5 carry the old driver dryrun-probe wrapper (no walls) — they
   are listed as legacy, reported but never gated.
+* fleet/steady/wire: ``FLEET_r*.json`` (concurrent Propose streams),
+  ``STEADY_r*.json`` (warm re-proposals per metrics window) and
+  ``WIRE_r*.json`` (the result-path split: warm sidecar round-trip with
+  the optimizer excluded, per-leg medians, cold columnar proposals-down
+  leg — ``bench.py --wire``) each get a trend section; ``--check`` fails
+  an unverified latest line and a >10% regression of the family's
+  headline (fleet p99, steady p99, wire round-trip p50) vs the best
+  banked comparable round.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -585,6 +593,139 @@ def render_steady(srows: list[dict], partials: list[dict]) -> str:
     return "\n".join(out)
 
 
+# ----- wire (WIRE_r*.json) ---------------------------------------------------
+
+
+def load_wire(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``WIRE_r*.json`` under ``root`` — the
+    ``bench.py --wire`` artifact: the result-path split (warm sidecar
+    round-trip with the optimizer excluded, per-leg medians, cold
+    columnar proposals-down leg)."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "WIRE_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("wire") \
+                or line.get("value") is None:
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed wire line (rc={wrapper.get('rc')})",
+            })
+            continue
+        warm = line.get("warm_ms") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "config": line.get("config", "?"),
+            "n_iters": line.get("n_iters"),
+            "drift": line.get("drift_fraction"),
+            "backend": str(line.get("backend", "?")),
+            "host_cores": line.get("host_cores"),
+            "verified": bool(line.get("verified")),
+            "p50_ms": warm.get("p50", line.get("value")),
+            "p99_ms": warm.get("p99"),
+            "cold_down_s": line.get("cold_down_s"),
+            "diff_rows": line.get("diff_rows"),
+            "split_ms": line.get("split_ms") or {},
+            "effort": line.get("effort") or {},
+        })
+    return rows, partials
+
+
+def wire_group_key(row: dict) -> str:
+    """Wire rows compare at identical (config, drift, backend,
+    host_cores, effort) — the hop cost depends on the drift size and
+    warm budget as much as on the wire code."""
+    return json.dumps(
+        [row["config"], row["drift"], row["backend"], row["host_cores"],
+         row["effort"]],
+        sort_keys=True,
+    )
+
+
+def check_wire(wrows: list[dict]) -> list[str]:
+    """The wire gate: in the LATEST banked wire round an unverified line
+    fails (a window failed verification, cold-started, or the measured
+    loop paid a fresh compile), and a warm-round-trip p50 regression
+    >10% vs the best banked comparable round fails."""
+    failures: list[str] = []
+    if not wrows:
+        return failures
+    latest_round = max(r["round"] for r in wrows)
+    for r in (r for r in wrows if r["round"] == latest_round):
+        if not r["verified"]:
+            failures.append(
+                f"wire round {r['round']} {r['config']}: UNVERIFIED wire "
+                "line banked (window verification failure, cold-start "
+                "fallback, or fresh compiles in the measured loop)"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in wrows:
+        groups.setdefault(wire_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"]
+            and r["p50_ms"] is not None
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(p["p50_ms"] for p in prior)
+        if r["p50_ms"] is not None and best:
+            limit = best * (1 + WALL_REGRESSION)
+            if r["p50_ms"] > limit:
+                failures.append(
+                    f"wire round {r['round']} {r['config']}: warm "
+                    f"round-trip p50 {r['p50_ms']:.1f}ms regressed "
+                    f">{WALL_REGRESSION:.0%} vs best banked round "
+                    f"({best:.1f}ms, limit {limit:.1f}ms)"
+                )
+    return failures
+
+
+def render_wire(wrows: list[dict], partials: list[dict]) -> str:
+    """The wire section of the trend table."""
+    if not wrows and not partials:
+        return ""
+    out = ["", "result path / wire split (WIRE_r*.json):"]
+    headers = ["round", "config", "iters", "backend", "p50 ms", "p99 ms",
+               "put", "diff", "asm", "pack", "dec", "tspt", "cold dn s",
+               "ok"]
+    body = []
+    for r in sorted(wrows, key=lambda r: r["round"]):
+        s = r["split_ms"]
+        body.append([
+            _fmt(r["round"], 0), r["config"], _fmt(r["n_iters"], 0),
+            f"{r['backend']}/{r['host_cores']}c",
+            _fmt(r["p50_ms"], 1), _fmt(r["p99_ms"], 1),
+            _fmt(s.get("put"), 1), _fmt(s.get("diff"), 1),
+            _fmt(s.get("assembly"), 1), _fmt(s.get("pack"), 1),
+            _fmt(s.get("decode"), 1), _fmt(s.get("transport"), 1),
+            _fmt(r["cold_down_s"], 3),
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 # ----- trend table -----------------------------------------------------------
 
 
@@ -862,12 +1003,14 @@ def main(argv=None) -> int:
     mrows, mlegacy = load_multichip(root)
     frows, fpartials = load_fleet(root)
     srows, spartials = load_steady(root)
+    wrows, wpartials = load_wire(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
             "multichip": mrows, "multichipLegacy": mlegacy,
             "fleet": frows, "fleetPartials": fpartials,
             "steady": srows, "steadyPartials": spartials,
+            "wire": wrows, "wirePartials": wpartials,
         }, indent=1))
         return 0
     if args.roofline:
@@ -877,6 +1020,7 @@ def main(argv=None) -> int:
         failures = (
             check(rows, partials) + check_multichip(mrows)
             + check_fleet(frows) + check_steady(srows)
+            + check_wire(wrows)
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
@@ -890,14 +1034,16 @@ def main(argv=None) -> int:
         print(f"bench ledger green: {n} banked line(s), "
               f"{len(partials)} partial round(s), {len(mrows)} scaling "
               f"curve(s), {len(frows)} fleet line(s), {len(srows)} "
-              f"steady line(s), no regression vs the best banked rounds")
+              f"steady line(s), {len(wrows)} wire line(s), no regression "
+              f"vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
     mc = render_multichip(mrows, mlegacy)
     fl = render_fleet(frows, fpartials)
     st = render_steady(srows, spartials)
+    wi = render_wire(wrows, wpartials)
     print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
-          + (("\n" + st) if st else ""))
+          + (("\n" + st) if st else "") + (("\n" + wi) if wi else ""))
     return 0
 
 

@@ -140,6 +140,15 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # anneal phases ride the same per-chunk heartbeat/tap machinery).
   CCX_BENCH_STEADY=1 timeout -k 60 2400 python bench.py
   echo "steady rc=$?"
+  echo "--- wire / result-path rung (streamed columnar warm round-trips; WIRE artifact) ---"
+  # the result-path split (ISSUE 11): warm end-to-end sidecar round-trip
+  # with the optimizer excluded — snapshot-up / diff / assembly /
+  # frame-pack / client-decode priced per leg through the real gRPC
+  # sidecar with streamed columnar results and the device diff armed.
+  # On TPU this is the number that decides whether the wire keeps up
+  # once warm re-proposal drops to tens of ms.
+  CCX_BENCH_WIRE=1 timeout -k 60 2400 python bench.py
+  echo "wire rc=$?"
   echo "--- remaining BASELINE configs on hardware (B1-B4, lean effort) ---"
   # pin all four effort knobs to the lean values: bench collapses to ONE
   # honestly-labeled "custom" rung per config instead of climbing
