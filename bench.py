@@ -66,6 +66,13 @@ kernel) that runs on a healthy TPU before the ladder.
 gRPC, split snapshot-up / optimize / diff / assembly / frame-pack /
 client-decode, headline = warm round-trip with the optimizer excluded
 (CCX_BENCH_WIRE_ITERS windows, default 20).
+``--chaos`` / CCX_BENCH_CHAOS runs the steady drift loop under a SEEDED
+fault schedule (CHAOS_r*.json artifact; ccx.common.faults): one seam
+class killed/severed/corrupted per window across the whole warm serving
+path, gated on 100% recovered-and-verified windows, zero stuck
+scheduler jobs, zero leaked registry/placement entries, bounded
+recovery latency, and a zero-fresh-compile disarmed epilogue
+(CCX_BENCH_CHAOS_ITERS windows, default 14; CCX_FAULTS_SEED).
 
 Observability: ``--samples N`` (or CCX_BENCH_SAMPLES) runs N warm samples
 per rung and puts min/median/max PLUS the raw "walls" sample list on the
@@ -1571,6 +1578,369 @@ def run_wire(name: str, n_iters: int, drift: float = 0.01) -> None:
     print(_state["final_json"], flush=True)
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (.jax_cache/), shared by every
+    bench mode and rerun: cold compile of a B5 program is minutes and
+    must be paid once. Must go through jax.config (not env vars): the
+    axon sitecustomize preloads jax at interpreter start, so env set
+    here is never read."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+            ),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+#: the chaos rung's per-window fault scenarios (ccx.common.faults spec
+#: grammar), cycled in order — every seam CLASS of the warm serving path
+#: is killed/severed/corrupted at least once per cycle. Ordering is load-
+#: bearing in one place: ``placement.bank`` sits immediately before
+#: ``compile`` so the window after a killed bank (which must COLD-start —
+#: the store no longer has its base) exercises the cold-pipeline kill +
+#: client retry on the very next window.
+CHAOS_SCENARIOS = (
+    ("rpc.frame:sever@3", "sever the stream mid-flight"),
+    ("rpc.frame:corrupt@2", "corrupt a stream frame"),
+    ("scheduler.grant:raise@1", "kill the engine mid-wave"),
+    ("registry.graft:raise@1;snapshot.transfer:exhaust@1",
+     "kill the delta graft, then HBM-pressure the rebuild"),
+    ("device.diff:raise@1", "kill the compiled device diff"),
+    ("placement.bank:raise@1", "kill the warm-base bank"),
+    ("compile:raise@1", "kill the cold pipeline entry"),
+)
+
+
+def run_chaos(name: str, n_iters: int, drift: float = 0.01) -> None:
+    """``--chaos`` / CCX_BENCH_CHAOS: the steady drift loop under a seeded
+    fault schedule (ISSUE 12; ROADMAP "Scenario corpus" — before warm
+    self-healing can be a headline, the warm substrate itself must
+    provably survive faults).
+
+    Drives the round-14 steady-state serving loop through a REAL gRPC
+    sidecar while ``ccx.common.faults`` kills/severs/corrupts one seam
+    class per measured window (:data:`CHAOS_SCENARIOS`, cycled; seed
+    ``CCX_FAULTS_SEED``):
+
+    1. full snapshot up + one COLD Propose (no faults) — baseline wall,
+       first warm base, every compile paid;
+    2. two prewarm windows + three CLEAN measured windows — the un-faulted
+       steady p50 the recovery bound is priced against;
+    3. N fault-injected windows: arm scenario ``i % len``, run one drift
+       window (delta put + warm Propose) through the retrying client,
+       disarm, verify the sidecar recovered: result verified, zero stuck
+       scheduler jobs, zero leaked registry/placement entries;
+    4. disarmed epilogue: one un-gated re-warm window (re-banks when the
+       last scenario killed the bank), then three clean windows that
+       must pay ZERO fresh compiles and verify warm — the
+       bit-exactness/zero-overhead tripwire against today's programs
+       (the STEADY/WIRE ledger gates keep the disarmed numbers honest
+       across rounds).
+
+    The JSON line is the CHAOS_r*.json artifact ``tools/bench_ledger.py``
+    trends and gates (unrecovered windows fail; recovery-p99 regression
+    >10% fails). ``verified`` is the conjunction of every gate above plus
+    bounded recovery latency (a warm-recovered window within
+    ``10×`` clean p50, a cold-fallback window within ``2× cold + 10 s``).
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from ccx.common import compilestats, costmodel, faults
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.model.snapshot import (
+        delta_encode,
+        model_to_arrays,
+        pack_arrays,
+        to_msgpack,
+    )
+    from ccx.search import incremental as incr
+    from ccx.search.scheduler import FLEET
+    from ccx.sidecar.client import SidecarClient
+    from ccx.sidecar.server import OptimizerSidecar, make_grpc_server
+
+    if os.environ.get("CCX_COST_CAPTURE") != "0":
+        costmodel.set_capture(True)
+    seed = int(os.environ.get("CCX_FAULTS_SEED", "42"))
+    session = f"chaos-{name}"
+    warm_opts = _steady_options()
+
+    enter_phase(f"chaos:{name}:model")
+    spec = bench_spec(name)
+    m0 = random_cluster(spec)
+    goal_names, cold_opts, cold_effort = build_opts(name, "target")
+    cold_wire = _wire_options(cold_opts)
+
+    sidecar = OptimizerSidecar()
+    server, port = make_grpc_server(sidecar, address="127.0.0.1:0")
+    server.start()
+    client = SidecarClient(
+        f"127.0.0.1:{port}", retries=4, backoff_s=0.05, backoff_max_s=1.0,
+        deadline_s=120.0, retry_seed=seed,
+    )
+    log(f"[chaos] sidecar on port {port} ({jax.default_backend()}), "
+        f"fault seed {seed}")
+
+    enter_phase(f"chaos:{name}:cold")
+    client.put_snapshot(None, session=session, generation=1,
+                        packed=to_msgpack(m0))
+    t0 = time.monotonic()
+    cold_res = client.propose(
+        session=session, goals=goal_names, columnar=True,
+        on_progress=lambda p: enter_phase(f"chaos:{name}:{p}"),
+        **cold_wire,
+    )
+    cold_s = time.monotonic() - t0
+    log(f"[chaos] cold propose {cold_s:.1f}s "
+        f"verified={cold_res['verified']}")
+
+    warm_base = incr.STORE.get(session)
+    if warm_base is None:
+        raise SystemExit("[chaos] sidecar banked no warm base — is "
+                         "CCX_INCREMENTAL=0 set?")
+    m_applied = m0.replace(
+        assignment=warm_base.assignment,
+        leader_slot=warm_base.leader_slot,
+        replica_disk=warm_base.replica_disk,
+    )
+    arrays = model_to_arrays(m_applied)
+    client.put_snapshot(None, session=session, generation=2,
+                        packed=to_msgpack(m_applied))
+    base_gen = 1
+    gen = 2
+
+    rng = np.random.default_rng(seed)
+    p_real = int(np.asarray(m0.partition_valid).sum())
+    n_drift = max(int(p_real * drift), 1)
+
+    def put_drift() -> None:
+        nonlocal arrays, gen
+        new = dict(arrays)
+        idx = rng.choice(p_real, n_drift, replace=False)
+        for field in ("leader_load", "follower_load"):
+            a = np.asarray(arrays[field], np.float32).copy()
+            a[:, idx] *= rng.uniform(0.5, 1.5, size=(1, n_drift)).astype(
+                np.float32
+            )
+            new[field] = a
+        delta = delta_encode(arrays, new)
+        client.put_snapshot(None, session=session, generation=gen + 1,
+                            packed=pack_arrays(delta), is_delta=True,
+                            base_generation=gen)
+        gen += 1
+        arrays = new
+
+    def window() -> dict:
+        """One drift window END TO END through the retrying client: the
+        wall includes every retry/backoff — the recovery latency."""
+        nonlocal base_gen
+        r0 = dict(client.stats)
+        t0 = time.monotonic()
+        put_drift()
+        res = client.propose(
+            session=session, goals=goal_names, columnar=True,
+            warm_start=True, base_generation=base_gen,
+            **{**cold_wire, **warm_opts},
+        )
+        wall = time.monotonic() - t0
+        base_gen = gen
+        inc = res.get("incremental") or {}
+        return {
+            "wall_s": round(wall, 3),
+            "verified": bool(res["verified"]),
+            "warm": bool(inc.get("warmStart")),
+            "cold_fallback": bool(inc.get("coldStart")),
+            "rows": int(res["numProposals"]),
+            "retries": client.stats["retries"] - r0["retries"],
+            "restarts": (
+                client.stats["stream_restarts"] - r0["stream_restarts"]
+            ),
+        }
+
+    # prewarm (same two-window contract as the steady rung: the second
+    # window exercises the zero-copy graft's device-pad program)
+    enter_phase(f"chaos:{name}:prewarm")
+    for _ in range(2):
+        window()
+
+    enter_phase(f"chaos:{name}:clean-baseline")
+    from ccx.sidecar.server import freeze_gc_steady_state
+
+    freeze_gc_steady_state()
+    clean = [window() for _ in range(3)]
+    clean_p50 = statistics.median(w["wall_s"] for w in clean)
+    log(f"[chaos] clean steady p50 {clean_p50 * 1e3:.0f}ms")
+
+    enter_phase(f"chaos:{name}:faulted")
+    windows: list = []
+    fired: dict = {}
+    for i in range(max(n_iters, 1)):
+        spec_s, what = CHAOS_SCENARIOS[i % len(CHAOS_SCENARIOS)]
+        faults.FAULTS.arm(spec_s, seed=seed + i)
+        try:
+            w = window()
+            w["recovered"] = w["verified"]
+        except Exception as e:  # noqa: BLE001 — an unrecovered window is
+            # a FAILED gate, not a dead bench: record it and continue
+            w = {
+                "wall_s": None, "verified": False, "warm": False,
+                "cold_fallback": False, "rows": 0, "recovered": False,
+                "error": f"{type(e).__name__}: {e}",
+                "retries": 0, "restarts": 0,
+            }
+            # the failed window may have left the client/server
+            # generations out of step — resync with a full snapshot put
+            # (what a real JVM client does after exhausting retries)
+            try:
+                client.put_snapshot(
+                    None, session=session, generation=gen + 1,
+                    packed=pack_arrays(arrays),
+                )
+                gen += 1
+                base_gen = gen
+            except Exception:  # noqa: BLE001 — next window will surface it
+                pass
+        st = faults.FAULTS.stats()
+        for k, v in st["fired"].items():
+            fired[k] = fired.get(k, 0) + v
+        faults.FAULTS.disarm()
+        w["scenario"] = spec_s
+        w["injected"] = what
+        windows.append(w)
+        active = FLEET.stats()["activeJobs"]
+        log(f"[chaos] window {i + 1}/{n_iters} [{what}]: "
+            f"wall={w['wall_s']}s recovered={w['recovered']} "
+            f"warm={w['warm']} retries={w['retries']} "
+            f"fired={st['fired']} activeJobs={len(active)}")
+
+    # settle: cancelled workers unwind at their next chunk boundary —
+    # give stragglers a moment before the stuck-job gate reads the queue
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and FLEET.stats()["activeJobs"]:
+        time.sleep(0.1)
+    stuck = FLEET.stats()["activeJobs"]
+
+    enter_phase(f"chaos:{name}:disarmed")
+    assert not faults.FAULTS.armed
+    # un-gated re-warm window FIRST: when the last faulted scenario was
+    # the bank kill, this window legitimately cold-starts (the documented
+    # degradation) and re-banks — the gated epilogue below must measure
+    # the steady path, not fail the round for a recovery that already
+    # happened
+    window()
+    cs0 = compilestats.snapshot()
+    disarmed = [window() for _ in range(3)]
+    warm_compiles = compilestats.delta(cs0, compilestats.snapshot())
+    zero_disarmed = warm_compiles.get("backend_compiles", 0) == 0
+    disarmed_ok = (
+        all(w["verified"] and w["warm"] for w in disarmed) and zero_disarmed
+    )
+
+    recovered = [w for w in windows if w["recovered"]]
+    n_warm = sum(1 for w in recovered if w["warm"])
+    n_cold = sum(1 for w in recovered if w["cold_fallback"])
+    walls = sorted(w["wall_s"] for w in recovered if w["wall_s"] is not None)
+    p50 = statistics.median(walls) if walls else None
+    p99 = (
+        walls[min(int(round(0.99 * (len(walls) - 1))), len(walls) - 1)]
+        if walls else None
+    )
+    # bounded recovery latency: warm recovery within 10x the clean steady
+    # p50; a cold fallback (lost bank) within 2x the cold wall + slack
+    warm_limit = max(10.0 * clean_p50, 5.0)
+    cold_limit = 2.0 * cold_s + 10.0
+    bounded = all(
+        (w["wall_s"] is not None)
+        and (w["wall_s"] <= (cold_limit if w["cold_fallback"]
+                             else warm_limit))
+        for w in recovered
+    )
+    reg_stats = sidecar.registry.stats()
+    store_stats = incr.STORE.stats()
+    no_leaks = (
+        reg_stats["sessions"] == 1
+        and reg_stats["deviceResident"] <= 1
+        and store_stats["sessions"] == 1
+    )
+    all_recovered = len(recovered) == len(windows)
+    out = {
+        "metric": (
+            f"{name} chaos recovery: fault-injected drift windows through "
+            f"the sidecar ({drift:.0%} drift, one seam class killed per "
+            f"window, p99 recovery wall)"
+        ),
+        "value": round(p99, 3) if p99 is not None else None,
+        "unit": "s",
+        # recovery overhead: warm-recovered p50 over the clean steady p50
+        # (1.0 = faults recovered at steady-state latency)
+        "vs_baseline": (
+            round(p50 / max(clean_p50, 1e-9), 2) if p50 is not None
+            else None
+        ),
+        "chaos": True,
+        "config": name,
+        "n_iters": len(windows),
+        "drift_fraction": drift,
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count(),
+        "fault_seed": seed,
+        "verified": bool(
+            all_recovered and not stuck and no_leaks and bounded
+            and disarmed_ok and bool(cold_res["verified"])
+        ),
+        "cold_s": round(cold_s, 2),
+        "clean": {
+            "p50_s": round(clean_p50, 3),
+            "walls": [w["wall_s"] for w in clean],
+        },
+        "recovery": {
+            "p50_s": round(p50, 3) if p50 is not None else None,
+            "p99_s": round(p99, 3) if p99 is not None else None,
+            "max_s": max(walls) if walls else None,
+            "walls": walls,
+            "bounded": bounded,
+            "warm_limit_s": round(warm_limit, 2),
+            "cold_limit_s": round(cold_limit, 2),
+        },
+        "recovered": {
+            "windows": len(windows),
+            "recovered": len(recovered),
+            "warm": n_warm,
+            "cold_fallback": n_cold,
+        },
+        "windows": windows,
+        "faults_fired": fired,
+        "client": dict(client.stats),
+        "scheduler": {"stuckJobs": len(stuck), "activeJobs": stuck},
+        "registry": reg_stats,
+        "store": store_stats,
+        "leaks_ok": no_leaks,
+        "disarmed": {
+            "ok": disarmed_ok,
+            "zero_fresh_compiles": zero_disarmed,
+            "walls": [w["wall_s"] for w in disarmed],
+            "compile_cache": warm_compiles,
+        },
+        "effort": {**warm_opts, "cold": cold_effort,
+                   "n_iters": len(windows), "drift": drift,
+                   "scenarios": len(CHAOS_SCENARIOS)},
+    }
+    client.close()
+    server.stop(0)
+    _state["done"] = True
+    _state["final_json"] = json.dumps(out)
+    print(_state["final_json"], flush=True)
+
+
 def run_mesh_bench(name: str) -> None:
     """CCX_BENCH_MESH=1: partition-axis-sharded anneal step slope at the
     config's shape over every visible device (SURVEY.md §5.7 — the
@@ -1671,27 +2041,33 @@ def main() -> None:
         "--wire-iters", type=int,
         default=int(os.environ.get("CCX_BENCH_WIRE_ITERS", "20")),
     )
+    ap.add_argument("--chaos", action="store_true",
+                    default=os.environ.get("CCX_BENCH_CHAOS") not in
+                    (None, "", "0"))
+    ap.add_argument(
+        "--chaos-iters", type=int,
+        default=int(os.environ.get("CCX_BENCH_CHAOS_ITERS", "14")),
+    )
     cli, _unknown = ap.parse_known_args()
     samples = max(cli.samples, 1)
+
+    if cli.chaos:
+        # chaos mode (CHAOS_r*.json artifact): the steady drift loop
+        # under a seeded fault schedule — one seam class killed per
+        # window, recovery gated. Persistent compile cache like the
+        # ladder.
+        enable_compile_cache()
+        name = os.environ.get("CCX_BENCH", "B5")
+        _state["name"] = name
+        run_chaos(name, n_iters=max(cli.chaos_iters, 1))
+        return
 
     if cli.wire:
         # wire/result-path mode (WIRE_r*.json artifact): the sidecar
         # round-trip split with the optimizer excluded — streamed
         # columnar warm windows through real gRPC. Persistent compile
         # cache like the ladder.
-        import jax
-
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get(
-                "JAX_COMPILATION_CACHE_DIR",
-                os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-                ),
-            ),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        enable_compile_cache()
         name = os.environ.get("CCX_BENCH", "B5")
         _state["name"] = name
         run_wire(name, n_iters=max(cli.wire_iters, 1))
@@ -1701,19 +2077,7 @@ def main() -> None:
         # steady-state incremental re-proposal mode (STEADY_r*.json
         # artifact): repeat warm_start Proposes per metrics window
         # through the sidecar. Persistent compile cache like the ladder.
-        import jax
-
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get(
-                "JAX_COMPILATION_CACHE_DIR",
-                os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-                ),
-            ),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        enable_compile_cache()
         name = os.environ.get("CCX_BENCH", "B5")
         _state["name"] = name
         run_steady(name, n_iters=max(cli.steady_iters, 1))
@@ -1723,19 +2087,7 @@ def main() -> None:
         # fleet serving mode (FLEET_r*.json artifact): concurrent Propose
         # streams through the sidecar, interleaved by the multi-job chunk
         # scheduler. Persistent compile cache like the main ladder.
-        import jax
-
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get(
-                "JAX_COMPILATION_CACHE_DIR",
-                os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-                ),
-            ),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        enable_compile_cache()
         name = os.environ.get("CCX_BENCH", "B3")
         _state["name"] = name
         run_fleet(name, n_jobs=max(cli.fleet_jobs, 2))
@@ -1750,19 +2102,7 @@ def main() -> None:
         from ccx.common.vmesh import ensure_host_devices
 
         ensure_host_devices(int(os.environ.get("CCX_BENCH_DEVICES", "8")))
-        import jax
-
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get(
-                "JAX_COMPILATION_CACHE_DIR",
-                os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-                ),
-            ),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        enable_compile_cache()
         name = os.environ.get("CCX_BENCH", "B6")
         _state["name"] = name
         run_scaling(name, samples=samples)
@@ -1991,21 +2331,7 @@ def main() -> None:
     if backend_forced:
         jax.config.update("jax_platforms", "cpu")
 
-    # Persistent XLA compilation cache: cold compile of the B5 program is
-    # minutes; repeated bench runs (driver reruns, tuning) should pay it once.
-    # Must go through jax.config (not env vars): the axon sitecustomize
-    # preloads jax at interpreter start, so env set here is never read.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-            ),
-        ),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    enable_compile_cache()
 
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
 

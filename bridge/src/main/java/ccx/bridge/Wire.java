@@ -61,6 +61,10 @@ public final class Wire {
   public static final String ERR_BAD_SNAPSHOT = "bad-snapshot";
   public static final String ERR_INVALID = "invalid-argument";
   public static final String ERR_INTERNAL = "internal";
+  // Round 16: the server cancelled the propose worker after a client
+  // disconnect (chunk-boundary cancellation) — only ever seen by a peer
+  // racing its own reconnect; retry-safe (nothing was banked).
+  public static final String ERR_CANCELLED = "cancelled";
 
   // Array-blob encoding field names (snapshot tensor schema, see
   // docs/sidecar-wire.md "Array encoding" and SnapshotCodec).

@@ -535,6 +535,26 @@ def observability_config_def() -> ConfigDef:
              "stable (budget retunes never recompile). False restores "
              "today's compiled programs bit-exactly (env override: "
              "CCX_CONVERGENCE=0).")
+    d.define("observability.faults.spec", Type.STRING, "",
+             Importance.LOW,
+             "Deterministic fault injection (ccx.common.faults, the chaos "
+             "layer): a ;-separated schedule of "
+             "seam:action@N rules armed at startup — seams "
+             "snapshot.transfer / registry.graft / placement.bank / "
+             "device.diff / rpc.frame / scheduler.grant / compile, "
+             "actions raise / exhaust / sever / delay / corrupt, fired "
+             "on the Nth hit (N+ from the Nth on, N/M every Mth, * "
+             "always). Empty (the default) leaves the registry DISARMED: "
+             "every seam is a single no-op attribute read and the "
+             "serving path is bit-exact vs a tree without the chaos "
+             "layer. Env twin for bench/standalone entry points: "
+             "CCX_FAULTS.")
+    d.define("observability.faults.seed", Type.INT, 0,
+             Importance.LOW,
+             "Seed of the fault registry's corrupt-action RNG (keyed "
+             "(seed, seam, hit) — same spec + seed replays the same "
+             "faults byte-identically). Env twin: CCX_FAULTS_SEED.",
+             at_least(0))
     d.define("observability.convergence.max.chunks", Type.INT, 256,
              Importance.LOW,
              "Ring-buffer depth of the convergence taps, in chunk rows. "

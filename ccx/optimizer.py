@@ -558,6 +558,7 @@ def optimize(
     progress_cb=None,
     job: tuple[str, int] | str | None = None,
     warm_start: WarmStart | None = None,
+    cancel: threading.Event | None = None,
 ) -> OptimizerResult:
     """Full-stack proposal computation (reference call stack 3.2, L3a part).
 
@@ -600,7 +601,13 @@ def optimize(
         cluster_id, priority = (
             job if isinstance(job, tuple) else (job, 0)
         )
-        with FLEET.job(str(cluster_id), int(priority)):
+        # ``cancel`` (a threading.Event the transport sets on client
+        # disconnect — ccx.sidecar.server wires gRPC context.add_callback
+        # to it) cancels the job at the next chunk-boundary grant
+        # (scheduler.JobCancelled); the job context's exit then frees the
+        # grant and residency slot on the way out.
+        with FLEET.job(str(cluster_id), int(priority),
+                       cancel_event=cancel):
             return optimize(
                 m, cfg, goal_names, opts, progress_cb,
                 warm_start=warm_start,
@@ -652,6 +659,13 @@ def _optimize(
     opts: OptimizeOptions,
     progress_cb,
 ) -> OptimizerResult:
+    # chaos seam (ccx.common.faults): a cold pipeline entry stands in for
+    # a failed/wedged XLA compile — the RPC fails structured, the client
+    # retries, the sidecar's state is untouched (nothing banked yet)
+    from ccx.common.faults import FAULTS as _FAULTS
+
+    if _FAULTS.armed:
+        _FAULTS.hit("compile")
     t0 = time.monotonic()
     phases: dict[str, float] = {}
     kind_prop = [0, 0, 0]

@@ -394,6 +394,13 @@ def columnar_diff(
             )
     if backend == "device":
         try:
+            # chaos seam (ccx.common.faults): an injected device-diff
+            # failure exercises exactly this degrade path — the numpy
+            # reference below stays the correctness pin
+            from ccx.common.faults import FAULTS
+
+            if FAULTS.armed:
+                FAULTS.hit("device.diff")
             return ColumnarDiff(_device_diff(before, after))
         except Exception:  # noqa: BLE001 — degrade to the host reference
             import logging

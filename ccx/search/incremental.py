@@ -282,6 +282,16 @@ def remember(
         pressure=pressure,
         cost_vec=cost,
     )
+    # BANK-LAST (ISSUE 12): the store write is the final, atomic step —
+    # any failure up to here (including the injected one) leaves the
+    # session's PREVIOUS base intact and generation-consistent, so the
+    # next warm Propose either resolves the old base or cold-starts; a
+    # partially-built warm base is never visible. The chaos seam sits
+    # exactly at the commit point.
+    from ccx.common.faults import FAULTS
+
+    if FAULTS.armed:
+        FAULTS.hit("placement.bank")
     STORE.put(warm)
     return warm
 

@@ -124,6 +124,25 @@ def _sweep_impl(
             & over_b[safe_b]
             & (u_cap < 1.5 * absorb * exc_frac[safe_b])
         )
+        # Deterministic floor: the lowest-draw replica on EVERY over-
+        # capacity broker is always selected. The probabilistic thinning
+        # above sheds roughly the overflow, but at a small excess fraction
+        # (or tight absorb) it can select NOTHING — the sweep then reports
+        # n_moved == 0 and the repair loop declares a fixpoint while
+        # over-capacity brokers remain (the round-10..15 seed failure:
+        # hard_repair "converged" with NetworkOutbound violations left).
+        # Forcing one replica per over broker keeps every sweep making
+        # progress until either the overload clears or the oscillation
+        # break fires.
+        u_rank = jnp.where(valid & over_b[safe_b], u_cap, jnp.inf)
+        min_u = (
+            jnp.full((B,), jnp.inf, u_cap.dtype)
+            .at[safe_b]
+            .min(u_rank, mode="drop")
+        )
+        on_over = on_over | (
+            valid & over_b[safe_b] & (u_rank <= min_u[safe_b])
+        )
     else:
         over_b = jnp.zeros_like(alive_b)
         on_over = jnp.zeros_like(valid)

@@ -67,6 +67,21 @@ import contextlib
 import threading
 import time
 
+from ccx.common.faults import FAULTS
+
+
+class JobCancelled(Exception):
+    """The job's cancel event fired (client disconnected mid-Propose):
+    raised at the next chunk-boundary grant acquisition so the worker
+    unwinds, its ``FLEET.job`` context releases the grant and the
+    residency slot, and nothing is left on the run queue. Cancellation is
+    cooperative and chunk-granular — an in-flight compiled chunk always
+    finishes; the NEXT dispatch raises."""
+
+    def __init__(self, job_id: str) -> None:
+        super().__init__(f"job {job_id!r} cancelled at chunk boundary")
+        self.job_id = job_id
+
 
 class JobHandle:
     """One registered optimization job. Mutable scheduling fields are
@@ -76,7 +91,7 @@ class JobHandle:
     __slots__ = (
         "job_id", "priority", "seq", "resident", "waiting", "granted",
         "chunks", "wait_s", "t_registered", "t_first_chunk", "last_grant",
-        "drives",
+        "drives", "cancel_event",
     )
 
     def __init__(self, job_id: str, priority: int, seq: int) -> None:
@@ -95,6 +110,14 @@ class JobHandle:
         self.last_grant = -1
         #: nesting depth of drive_chunks loops currently running this job
         self.drives = 0
+        #: optional threading.Event a transport sets on client disconnect
+        #: (ccx.sidecar.server wires gRPC context.add_callback to it);
+        #: checked at every grant acquisition — see JobCancelled
+        self.cancel_event: threading.Event | None = None
+
+    def cancelled(self) -> bool:
+        ev = self.cancel_event
+        return ev is not None and ev.is_set()
 
     def to_json(self) -> dict:
         return {
@@ -145,30 +168,43 @@ class ChunkScheduler:
 
     # ----- registration -----------------------------------------------------
 
-    def register(self, job_id: str, priority: int = 0) -> JobHandle:
+    def register(self, job_id: str, priority: int = 0,
+                 cancel_event: threading.Event | None = None) -> JobHandle:
         """Register a job; BLOCKS while the residency cap is reached (the
         admission queue, highest-priority / earliest-arrival first).
         Priority > 0 jobs bypass the cap — preemption must never wait for
-        a dryrun slot to free."""
+        a dryrun slot to free. A set ``cancel_event`` raises
+        :class:`JobCancelled` instead of admitting (and at every later
+        grant acquisition) — the job leaves no queue entry behind."""
         with self._cond:
             self._seq += 1
             h = JobHandle(job_id, priority, self._seq)
+            h.cancel_event = cancel_event
             self._jobs.append(h)
-            if self.max_concurrent <= 0 or h.priority > 0:
-                h.resident = True
-            else:
-                while not h.resident:
-                    free = self.max_concurrent - sum(
-                        1 for j in self._jobs if j.resident
-                    )
-                    queued = sorted(
-                        (j for j in self._jobs if not j.resident),
-                        key=lambda j: (-j.priority, j.seq),
-                    )
-                    if free > 0 and h in queued[:free]:
-                        h.resident = True
-                        break
-                    self._cond.wait()
+            try:
+                if self.max_concurrent <= 0 or h.priority > 0:
+                    h.resident = True
+                else:
+                    while not h.resident:
+                        if h.cancelled():
+                            raise JobCancelled(h.job_id)
+                        free = self.max_concurrent - sum(
+                            1 for j in self._jobs if j.resident
+                        )
+                        queued = sorted(
+                            (j for j in self._jobs if not j.resident),
+                            key=lambda j: (-j.priority, j.seq),
+                        )
+                        if free > 0 and h in queued[:free]:
+                            h.resident = True
+                            break
+                        self._cond.wait()
+            except JobCancelled:
+                # a cancelled admission must free its queue entry HERE —
+                # no FLEET.job finally will ever run for it
+                self._jobs.remove(h)
+                self._cond.notify_all()
+                raise
             self._cond.notify_all()
             return h
 
@@ -182,21 +218,26 @@ class ChunkScheduler:
             self._cond.notify_all()
 
     @contextlib.contextmanager
-    def job(self, job_id: str, priority: int = 0):
+    def job(self, job_id: str, priority: int = 0,
+            cancel_event: threading.Event | None = None):
         """Register a job and make it THIS thread's ambient job for the
         duration: every ``drive_chunks`` loop on the thread routes its
         chunk dispatches through the run queue, and the flight recorder
         labels the thread's spans/heartbeats with ``job=<cluster-id>``
         (ccx.common.tracing). Reentrant registration (a nested pipeline
         running under an outer job) keeps the OUTER job — one Propose is
-        one job, however many phases it runs."""
+        one job, however many phases it runs. ``cancel_event`` (set by a
+        transport on client disconnect, plus :meth:`kick`) cancels the
+        job at the next chunk boundary (:class:`JobCancelled`); exit via
+        ANY path — completion, cancellation, engine error — unregisters
+        the job and frees its grant/residency."""
         outer = getattr(self._tl, "job", None)
         if outer is not None:
             yield outer
             return
         from ccx.common.tracing import TRACER
 
-        h = self.register(job_id, priority)
+        h = self.register(job_id, priority, cancel_event=cancel_event)
         self._tl.job = h
         prev_label = TRACER.set_job(h.job_id)
         try:
@@ -228,21 +269,37 @@ class ChunkScheduler:
                 best = j
         return best
 
+    def kick(self) -> None:
+        """Wake every waiter so it re-checks its cancel event — the one
+        call a canceller (another thread: the gRPC disconnect callback)
+        must make after setting a job's cancel_event."""
+        with self._cond:
+            self._cond.notify_all()
+
     @contextlib.contextmanager
     def chunk(self, h: JobHandle):
         """One chunk dispatch under a grant. Blocks until ``h`` wins the
         run queue; the caller dispatches its chunk program inside the
         ``with`` and must NOT block on device results there (syncs belong
-        outside, so the next job can dispatch meanwhile)."""
+        outside, so the next job can dispatch meanwhile). Raises
+        :class:`JobCancelled` when the job's cancel event is set — BEFORE
+        dispatching, so "cancel mid-wave" frees the grant within one
+        chunk: the in-flight chunk finishes, the next never starts."""
         t0 = time.monotonic()
         with self._cond:
             h.waiting = True
-            while not (
-                len(self._granted) < self.dispatch_width
-                and self._pick() is h
-            ):
-                self._cond.wait()
-            h.waiting = False
+            try:
+                while not (
+                    len(self._granted) < self.dispatch_width
+                    and self._pick() is h
+                ):
+                    if h.cancelled():
+                        raise JobCancelled(h.job_id)
+                    self._cond.wait()
+                if h.cancelled():
+                    raise JobCancelled(h.job_id)
+            finally:
+                h.waiting = False
             self._granted.add(h)
             self._grant_seq += 1
             h.last_grant = self._grant_seq
@@ -257,6 +314,12 @@ class ChunkScheduler:
             # micro-benchmark)
             self._cond.notify_all()
         try:
+            # chaos seam (ccx.common.faults): an injected grant failure
+            # exercises the "engine died mid-wave" path — the finally
+            # below releases the grant, FLEET.job's exit unregisters, so
+            # no fault here can strand a queue entry
+            if FAULTS.armed:
+                FAULTS.hit("scheduler.grant")
             yield
         finally:
             with self._cond:

@@ -148,6 +148,17 @@ class CruiseControl:
             max_sessions=config["optimizer.incremental.max.sessions"]
         )
         self._incremental_gen: dict[str, int] = {}
+        # fault injection (ccx.common.faults, ISSUE 12): armed ONLY by an
+        # explicit spec — config here, CCX_FAULTS for bench/standalone
+        # entry points; an empty spec leaves the registry disarmed (the
+        # zero-overhead default)
+        from ccx.common import faults as _faults
+
+        fault_spec = config["observability.faults.spec"]
+        if fault_spec:
+            _faults.FAULTS.arm(
+                fault_spec, seed=config["observability.faults.seed"]
+            )
 
     # ----- lifecycle (ref startUp order: monitor -> detector -> servlet) ----
 

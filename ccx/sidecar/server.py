@@ -23,6 +23,7 @@ import threading
 from concurrent import futures
 
 from ccx import __version__
+from ccx.common import faults
 from ccx.common.tracing import TRACER
 from ccx.sidecar import GRPC_MESSAGE_OPTIONS
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
@@ -91,6 +92,14 @@ class SnapshotRegistry:
         #: (the steady-state fast path: no arrays_to_model, no full
         #: host→device transfer — two load tensors replaced in place)
         self.delta_grafts = 0
+        #: grafts that failed (device surprise / injected fault) and
+        #: degraded to the rebuild path — the resident model was DROPPED
+        #: first, so a failed graft can never serve a torn model
+        self.graft_failures = 0
+        #: device-model builds that hit allocation pressure
+        #: (RESOURCE_EXHAUSTED — organic or injected), evicted every
+        #: resident and retried cold instead of failing the RPC
+        self.pressure_evictions = 0
 
     def budget_bytes(self) -> int:
         if self._explicit_budget is not None and self._explicit_budget > 0:
@@ -122,14 +131,29 @@ class SnapshotRegistry:
             and cached is not None
             and set(changed) <= self.METRIC_FIELDS
         ):
+            # The resident model was POPPED above, so from here on every
+            # failure mode is consistent by construction: a failed graft
+            # (None below) simply leaves no device copy and the next
+            # Propose rebuilds from the host arrays — a torn graft can
+            # never be served.
             grafted = self._graft_metrics(cached[1], arrays, changed)
-            if grafted is not None:
-                with self._lock:
-                    self._seq += 1
-                    self._models[session] = (
-                        int(generation), grafted, cached[2], self._seq
-                    )
-                    self.delta_grafts += 1
+            if grafted is None:
+                self.graft_failures += 1
+                return
+            with self._lock:
+                cur = self._snapshots.get(session)
+                if cur is None or cur[0] != int(generation):
+                    # a newer put landed while we grafted — installing
+                    # this graft would pin a STALE device model under a
+                    # fresh LRU stamp; drop it (the winner's own graft or
+                    # the next Propose's rebuild serves the new state)
+                    return
+                self._seq += 1
+                self._models[session] = (
+                    int(generation), grafted, cached[2], self._seq
+                )
+                self.delta_grafts += 1
+                self._evict_over_budget()
 
     @staticmethod
     def _graft_metrics(model, arrays: dict, changed: set):
@@ -144,6 +168,11 @@ class SnapshotRegistry:
         rates this is the difference between one memcpy per delta put
         and three."""
         try:
+            # chaos seam (ccx.common.faults): an injected graft failure
+            # must land in THIS except — the caller counts it and
+            # degrades to a rebuild, never serves a torn model
+            if faults.FAULTS.armed:
+                faults.FAULTS.hit("registry.graft")
             import jax.numpy as jnp
             import numpy as np
 
@@ -168,7 +197,15 @@ class SnapshotRegistry:
 
     def model(self, session: str):
         """The device model for a session's CURRENT snapshot — cache hit
-        when resident, else built and admitted under the HBM budget."""
+        when resident, else built and admitted under the HBM budget.
+
+        Crash-consistent against the two organic failure modes: an
+        allocation failure (RESOURCE_EXHAUSTED — HBM pressure) evicts
+        every device resident and retries the build cold instead of
+        failing the RPC, and a build that raced a concurrent put is
+        served but never INSTALLED over the newer generation (the install
+        is generation-checked, so a stale device model cannot shadow a
+        fresh snapshot)."""
         with self._lock:
             entry = self._snapshots.get(session)
             if entry is None:
@@ -184,13 +221,47 @@ class SnapshotRegistry:
                 return cached[1]
             arrays = entry[1]
             self.misses += 1
-        m = arrays_to_model(arrays)
+        try:
+            m = self._build(arrays)
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not faults.is_resource_exhausted(e):
+                raise
+            # HBM pressure: degrade by evicting the whole device-resident
+            # set and retrying the one build that must succeed (the
+            # registry's admission contract: one job can always run).
+            # A second failure is a real capacity problem and raises.
+            self.pressure_evictions += 1
+            self.evict_device()
+            m = self._build(arrays)
         nbytes = model_device_bytes(m)
         with self._lock:
-            self._seq += 1
-            self._models[session] = (gen, m, nbytes, self._seq)
-            self._evict_over_budget()
+            cur = self._snapshots.get(session)
+            if cur is not None and cur[0] == gen:
+                self._seq += 1
+                self._models[session] = (gen, m, nbytes, self._seq)
+                self._evict_over_budget()
         return m
+
+    def _build(self, arrays):
+        # chaos seam (ccx.common.faults): the host→device build/transfer
+        # — ``exhaust`` rules exercise the pressure-evict-retry path
+        if faults.FAULTS.armed:
+            faults.FAULTS.hit("snapshot.transfer")
+        return arrays_to_model(arrays)
+
+    def evict_device(self, session: str | None = None) -> int:
+        """Drop device-resident models (the host arrays always stay, so
+        the next Propose rebuilds — eviction is never an error).
+        ``session=None`` drops ALL residents: the HBM-pressure
+        degradation path. Returns the number evicted."""
+        with self._lock:
+            if session is not None:
+                n = 1 if self._models.pop(session, None) is not None else 0
+            else:
+                n = len(self._models)
+                self._models.clear()
+            self.evictions += n
+            return n
 
     def _evict_over_budget(self) -> None:
         """LRU eviction of device models over the HBM budget (lock held).
@@ -218,6 +289,8 @@ class SnapshotRegistry:
                 "hits": self.hits,
                 "misses": self.misses,
                 "deltaGrafts": self.delta_grafts,
+                "graftFailures": self.graft_failures,
+                "pressureEvictions": self.pressure_evictions,
             }
 
 
@@ -250,6 +323,12 @@ class OptimizerSidecar:
         #: host transfer (~130 ms at B5) that prices them. One entry per
         #: session (latest generation wins).
         self._input_stats: dict[str, tuple[int, object]] = {}
+        #: session -> (generation, crc32 of the last PutSnapshot payload)
+        #: — distinguishes a TRUE duplicate delivery (retried put whose
+        #: ack was lost: same generation, same bytes → idempotent ACK)
+        #: from a desynced writer reusing the current generation with
+        #: NEW content (must fail loudly, never silently drop data)
+        self._put_crc: dict[str, tuple[int, int]] = {}
 
     # ----- PutSnapshot ------------------------------------------------------
 
@@ -263,11 +342,31 @@ class OptimizerSidecar:
                 wire.ERR_MALFORMED, "PutSnapshot request missing 'packed'"
             )
         arrays = _decode_snapshot(req["packed"], what="packed snapshot")
+        import zlib
+
+        crc = zlib.crc32(req["packed"]) & 0xFFFFFFFF
         with self._lock:
             if req.get("is_delta"):
                 base = self.registry.get(session)
                 if base is None:
                     raise ValueError(f"no base snapshot for session {session!r}")
+                if generation == base[0]:
+                    # the registry is already AT this generation. Same
+                    # payload bytes ⇒ duplicate delivery (a retried client
+                    # put whose ack was lost): ACK — PutSnapshot is
+                    # idempotent by (session, generation), the client
+                    # retry contract (docs/sidecar-wire.md Retryability).
+                    # DIFFERENT bytes ⇒ a desynced writer labeling fresh
+                    # data with the current generation: fail loudly (the
+                    # old wrong-base error silently dropping the data
+                    # would be worse — stale loads forever, no error).
+                    if self._put_crc.get(session) == (generation, crc):
+                        return wire.ack_response(generation)
+                    raise ValueError(
+                        f"delta for session {session!r} reuses current "
+                        f"generation {generation} with different content "
+                        "— writer desynced; re-send a full snapshot"
+                    )
                 base_gen = req.get("base_generation")
                 if base_gen is not None and int(base_gen) != base[0]:
                     # A delta against the wrong base would build a cluster
@@ -288,12 +387,27 @@ class OptimizerSidecar:
                                   changed=changed)
             else:
                 self.registry.put(session, generation, arrays)
+            self._put_crc[session] = (generation, crc)
         return wire.ack_response(generation)
 
     # ----- Propose ----------------------------------------------------------
 
-    def propose(self, request: bytes):
-        """Generator: progress dicts, then the final result dict."""
+    def propose(self, request: bytes, cancel=None):
+        """Generator: progress dicts, then the final result dict.
+
+        ``cancel`` (an optional ``threading.Event``) is the transport's
+        disconnect signal: the gRPC edge sets it from
+        ``context.add_callback`` when the client goes away, and the
+        optimize worker — registered on the fleet scheduler with the
+        event — unwinds with ``JobCancelled`` at its next chunk-boundary
+        grant, freeing the grant and residency slot instead of computing
+        to completion for a dead peer. A consumer that stops iterating
+        THIS generator (in-process embedders) cancels the same way via
+        the ``GeneratorExit`` handler below — the event is created HERE
+        when the transport passed none, so the in-process path is never
+        a silent no-op."""
+        if cancel is None:
+            cancel = threading.Event()
         req = wire.unpackb(request)
         wire.check_version(req)
         yield wire.progress_frame("Decoding snapshot")
@@ -502,6 +616,7 @@ class OptimizerSidecar:
                     progress_cb=lambda p: q.put(("phase", p)),
                     job=(cluster, priority),
                     warm_start=warm,
+                    cancel=cancel,
                 )
             except BaseException as e:  # re-raised below, at the RPC edge
                 box["err"] = e
@@ -549,6 +664,17 @@ class OptimizerSidecar:
                         # live quality on the progress stream
                         energy=payload.get("energy"),
                     )
+        except GeneratorExit:
+            # the consumer stopped iterating (gRPC closed the response
+            # stream / an in-process embedder bailed): cancel the worker
+            # so it exits at its next chunk boundary instead of computing
+            # to completion with its scheduler registration live
+            if cancel is not None:
+                cancel.set()
+                from ccx.search.scheduler import FLEET
+
+                FLEET.kick()
+            raise
         finally:
             TRACER.remove_listener(_tap)
         worker.join()
@@ -569,20 +695,32 @@ class OptimizerSidecar:
             and res.verification.ok
         ):
             t_bank = _time.monotonic()
-            # a warm result carries its pressure bank precomputed (the
-            # fused warm_finish program) — the bank costs nothing extra
-            incr.remember(session, cur_gen, res.model, self.goal_config,
-                          pressure=res.warm_pressure)
-            # the bank's pressure-scan program is a NEW shape on a
-            # session's first cold propose, dispatched AFTER optimize()'s
-            # cost-capture phase already flushed — capture it HERE, still
-            # inside this (cold) RPC, so the NEXT propose's cost-capture
-            # phase has nothing left to compile (the ladder's warm run
-            # must pay zero fresh compiles; test_bench_contract pins it)
-            from ccx.common import costmodel as _cm
+            try:
+                # a warm result carries its pressure bank precomputed (the
+                # fused warm_finish program) — the bank costs nothing extra
+                incr.remember(session, cur_gen, res.model, self.goal_config,
+                              pressure=res.warm_pressure)
+                # the bank's pressure-scan program is a NEW shape on a
+                # session's first cold propose, dispatched AFTER optimize()'s
+                # cost-capture phase already flushed — capture it HERE, still
+                # inside this (cold) RPC, so the NEXT propose's cost-capture
+                # phase has nothing left to compile (the ladder's warm run
+                # must pay zero fresh compiles; test_bench_contract pins it)
+                from ccx.common import costmodel as _cm
 
-            if _cm.capture_enabled() and _cm.pending_count():
-                _cm.capture_pending()
+                if _cm.capture_enabled() and _cm.pending_count():
+                    _cm.capture_pending()
+            except Exception:  # noqa: BLE001 — banking is bookkeeping for
+                # the NEXT window, never this response's correctness: the
+                # bank-last store (incremental.remember) kept the previous
+                # base intact and generation-consistent, so the next warm
+                # Propose resolves the old base or cold-starts gracefully.
+                # The RPC itself succeeds with the verified result.
+                log.warning(
+                    "warm-base banking failed for session %r gen %s — "
+                    "the next warm Propose will cold-start", session,
+                    cur_gen, exc_info=True,
+                )
             # priced separately (wireSeconds.bank): session bookkeeping
             # for the NEXT warm window, not part of the proposals-down
             # leg this response's consumer is waiting on
@@ -640,6 +778,14 @@ class OptimizerSidecar:
         t_pack = _time.monotonic()
         blob = pack_arrays(res.diff.cols)
         pack_s = _time.monotonic() - t_pack
+        # integrity (round 16, additive, BOTH columnar forms): byte flips
+        # inside a bin payload decode cleanly and preserve length — only
+        # a checksum catches them. crc32 runs at GB/s, sub-ms even for a
+        # cold B5 blob; clients verify when the key is present (older
+        # servers omit it, older clients ignore it).
+        import zlib
+
+        result["proposalsColumnarCrc32"] = zlib.crc32(blob) & 0xFFFFFFFF
         # wire-path self-pricing (bench.py --wire reads these): host
         # result assembly vs columnar blob packing, in seconds. Additive
         # and columnar-only — row-mode results (and the golden fixtures)
@@ -658,9 +804,9 @@ class OptimizerSidecar:
         # frame carries only scalar blocks, with the goal summary as flat
         # typed arrays — packing it walks no per-goal (let alone per-row)
         # Python objects
-        result["goalSummaryColumnar"] = pack_arrays(
-            res.goal_summary_columnar()
-        )
+        gs_blob = pack_arrays(res.goal_summary_columnar())
+        result["goalSummaryColumnar"] = gs_blob
+        result["goalSummaryColumnarCrc32"] = zlib.crc32(gs_blob) & 0xFFFFFFFF
         seg_bytes = max(int(RESULT_SEGMENT_BYTES), 1)
         total = max((len(blob) + seg_bytes - 1) // seg_bytes, 1)
         result["proposalsColumnarSegments"] = total
@@ -742,11 +888,51 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
         return handler
 
     def propose_stream(request: bytes, context):
+        from ccx.search.scheduler import FLEET, JobCancelled
+
+        # disconnect → cancel: gRPC fires the callback when the RPC
+        # terminates for ANY reason (client disconnect, cancellation,
+        # normal completion — where setting the event is a no-op). The
+        # propose worker holds the event via its fleet-job registration
+        # and unwinds at its next chunk-boundary grant, releasing the
+        # grant and residency slot instead of computing to completion
+        # for a dead peer.
+        cancel = threading.Event()
+
+        def _on_rpc_done():
+            cancel.set()
+            FLEET.kick()
+
+        context.add_callback(_on_rpc_done)
         try:
             with TRACER.span("Propose", kind="rpc",
                              bytes=len(request or b"")):
-                for update in sidecar.propose(request):
-                    yield wire.pack_frame(update)
+                for update in sidecar.propose(request, cancel=cancel):
+                    buf = wire.pack_frame(update)
+                    if faults.FAULTS.armed:
+                        # chaos seam: per-frame transport faults —
+                        # ``corrupt`` ships flipped bytes (the client
+                        # detects and restarts the stream), ``sever``
+                        # raises and ends the stream abruptly below
+                        buf = faults.FAULTS.hit("rpc.frame", buf)
+                    yield buf
+        except JobCancelled as e:
+            # the peer is (almost certainly) gone; the frame is only ever
+            # seen by a client racing its own disconnect — retry-safe
+            log.info("propose cancelled: %s", e)
+            yield wire.pack_frame(
+                wire.error_frame(str(e), wire.ERR_CANCELLED)
+            )
+        except faults.InjectedFault as e:
+            if e.kind == "sever":
+                # injected transport death: end the stream with NO
+                # terminal frame — the client's StreamTruncated path
+                log.warning("injected stream sever: %s", e)
+                return
+            log.exception("propose failed (injected)")
+            yield wire.pack_frame(
+                wire.error_frame(str(e), wire.ERR_INTERNAL)
+            )
         except Exception as e:  # noqa: BLE001
             log.exception("propose failed")
             yield wire.pack_frame(wire.error_frame(str(e), wire.code_of(e)))
@@ -837,6 +1023,10 @@ def main(argv=None) -> int:
 
     if _os.environ.get(costmodel.ENV_CAPTURE) != "0":
         costmodel.set_capture(True)
+    # chaos arming (ccx.common.faults): CCX_FAULTS injects deterministic
+    # faults at the named seams — never armed implicitly
+    if faults.FAULTS.arm_from_env():
+        log.warning("fault injection ARMED: %s", faults.FAULTS.stats())
     # fleet scheduler residency cap (0/unset = unlimited interleave)
     from ccx.search import scheduler as fleet
 

@@ -75,6 +75,11 @@ ERR_BAD_SNAPSHOT = "bad-snapshot"
 ERR_INVALID = "invalid-argument"
 #: the optimizer itself failed — not the caller's fault
 ERR_INTERNAL = "internal"
+#: the server cancelled the work (round 16, additive): the client
+#: disconnected mid-Propose and the worker was cancelled at the next
+#: chunk boundary. Only ever seen by a peer that raced its own
+#: disconnect; retry-safe (the cancelled run banked nothing).
+ERR_CANCELLED = "cancelled"
 
 
 #: Every ``Propose`` ``options`` key the sidecar understands — the single
@@ -132,6 +137,36 @@ class SidecarError(RuntimeError):
     def __init__(self, message: str, code: str | None = None) -> None:
         super().__init__(message)
         self.code = code
+
+
+class StreamTruncated(SidecarError):
+    """A Propose stream died without a (complete) result — the server
+    crashed mid-stream, the transport severed, or segment frames went
+    missing (round 16; replaces the bare "stream ended without a result").
+    Carries the context an operator (and the retry loop) needs: which
+    session/cluster, how many frames arrived, how many result segments of
+    how many expected. RETRY-SAFE by the Propose contract
+    (docs/sidecar-wire.md "Retryability"): the client restarts the whole
+    stream — never resumes mid-blob — and a rerun recomputes from the
+    sidecar's own consistent state."""
+
+    def __init__(self, message: str, session: str | None = None,
+                 cluster_id: str | None = None, frames: int = 0,
+                 segments: int = 0,
+                 segments_expected: int | None = None) -> None:
+        ctx = (
+            f" (session={session!r}, cluster={cluster_id!r}, "
+            f"frames={frames}, segments={segments}"
+            + (f"/{segments_expected}" if segments_expected is not None
+               else "")
+            + ")"
+        )
+        super().__init__(message + ctx, code=None)
+        self.session = session
+        self.cluster_id = cluster_id
+        self.frames = frames
+        self.segments = segments
+        self.segments_expected = segments_expected
 
 
 # ----- canonical msgpack ----------------------------------------------------

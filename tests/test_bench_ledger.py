@@ -611,3 +611,138 @@ def test_wire_rides_cli_table_and_check(tmp_path, capsys):
 def test_wire_rung_is_wired_into_campaign_script():
     sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
     assert "CCX_BENCH_WIRE=1" in sh
+
+
+# ----- chaos (CHAOS_r*.json — bench.py --chaos) ------------------------------
+
+
+def _chaos_line(p99=0.6, verified=True, cores=2, drift=0.01,
+                recovered=14, windows=14, stuck=0, leaks_ok=True,
+                bounded=True, disarmed_ok=True, **extra):
+    return {
+        "metric": "B5 chaos recovery: fault-injected drift windows "
+                  "through the sidecar (1% drift, one seam class killed "
+                  "per window, p99 recovery wall)",
+        "value": p99, "unit": "s", "vs_baseline": 1.2, "chaos": True,
+        "config": "B5", "n_iters": windows, "drift_fraction": drift,
+        "backend": "cpu", "host_cores": cores, "fault_seed": 42,
+        "verified": verified, "cold_s": 31.0,
+        "clean": {"p50_s": 0.45, "walls": [0.44, 0.45, 0.46]},
+        "recovery": {"p50_s": p99 * 0.8, "p99_s": p99, "max_s": p99,
+                     "walls": [p99], "bounded": bounded,
+                     "warm_limit_s": 4.5, "cold_limit_s": 72.0},
+        "recovered": {"windows": windows, "recovered": recovered,
+                      "warm": recovered - 2, "cold_fallback": 2},
+        "windows": [], "faults_fired": {"rpc.frame:sever": 2},
+        "client": {"attempts": 40, "retries": 5, "stream_restarts": 4},
+        "scheduler": {"stuckJobs": stuck, "activeJobs": []},
+        "leaks_ok": leaks_ok,
+        "disarmed": {"ok": disarmed_ok, "zero_fresh_compiles": disarmed_ok,
+                     "walls": [0.45, 0.44, 0.45]},
+        "effort": {"warm_swap_iters": 8, "plateau_window": 1,
+                   "cold": {"chains": 16, "steps": 250}, "scenarios": 7},
+        **extra,
+    }
+
+
+def _bank_chaos(tmp_path, n, line):
+    (tmp_path / f"CHAOS_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_chaos_rows_parse(tmp_path):
+    _bank_chaos(tmp_path, 1, _chaos_line())
+    rows, partials = bench_ledger.load_chaos(str(tmp_path))
+    assert partials == []
+    (r,) = rows
+    assert r["p99"] == 0.6 and r["verified"] and r["leaks_ok"]
+    assert r["recovered"] == 14 and r["windows"] == 14
+    assert r["disarmed_ok"] and r["bounded"]
+
+
+def test_chaos_unrecovered_window_fails(tmp_path):
+    _bank_chaos(tmp_path, 1, _chaos_line(recovered=12, verified=False))
+    rows, _ = bench_ledger.load_chaos(str(tmp_path))
+    failures = bench_ledger.check_chaos(rows)
+    assert any("did NOT recover" in f for f in failures)
+    assert any("UNVERIFIED" in f for f in failures)
+
+
+def test_chaos_stuck_job_and_leak_fail(tmp_path):
+    _bank_chaos(tmp_path, 1, _chaos_line(stuck=1, leaks_ok=False))
+    rows, _ = bench_ledger.load_chaos(str(tmp_path))
+    failures = bench_ledger.check_chaos(rows)
+    assert any("stuck" in f for f in failures)
+    assert any("leaked" in f for f in failures)
+
+
+def test_chaos_unbounded_or_broken_disarmed_fails(tmp_path):
+    _bank_chaos(tmp_path, 1, _chaos_line(bounded=False, disarmed_ok=False))
+    rows, _ = bench_ledger.load_chaos(str(tmp_path))
+    failures = bench_ledger.check_chaos(rows)
+    assert any("bound" in f for f in failures)
+    assert any("disarmed" in f for f in failures)
+
+
+def test_chaos_p99_regression_fails_within_threshold_passes(tmp_path):
+    _bank_chaos(tmp_path, 1, _chaos_line(p99=0.6))
+    _bank_chaos(tmp_path, 2, _chaos_line(p99=0.9))
+    rows, _ = bench_ledger.load_chaos(str(tmp_path))
+    failures = bench_ledger.check_chaos(rows)
+    assert any("regressed" in f for f in failures)
+    _bank_chaos(tmp_path, 2, _chaos_line(p99=0.64))
+    rows, _ = bench_ledger.load_chaos(str(tmp_path))
+    assert bench_ledger.check_chaos(rows) == []
+
+
+def test_chaos_different_host_or_drift_not_comparable(tmp_path):
+    _bank_chaos(tmp_path, 1, _chaos_line(p99=0.6, cores=2))
+    _bank_chaos(tmp_path, 2, _chaos_line(p99=2.0, cores=16))
+    rows, _ = bench_ledger.load_chaos(str(tmp_path))
+    assert bench_ledger.check_chaos(rows) == []
+
+
+def test_chaos_partial_round_reported_not_failed(tmp_path):
+    (tmp_path / "CHAOS_r03.json").write_text(
+        json.dumps({"n": 3, "rc": 124, "parsed": None})
+    )
+    rows, partials = bench_ledger.load_chaos(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+    assert bench_ledger.check_chaos(rows) == []
+
+
+def test_chaos_gate_green_on_banked_artifacts():
+    """The repo's own CHAOS artifacts must pass the gate."""
+    rows, _ = bench_ledger.load_chaos(str(REPO))
+    assert rows, "CHAOS_r01.json missing — the chaos rung never banked"
+    assert bench_ledger.check_chaos(rows) == []
+
+
+def test_chaos_rides_cli_table_and_check(tmp_path, capsys):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_chaos(tmp_path, 1, _chaos_line())
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 0
+    bench_ledger.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "chaos recovery" in out and "warm/cold" in out
+
+
+def test_chaos_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_CHAOS=1" in sh
+
+
+def test_chaos_total_failure_is_gated_not_partial(tmp_path):
+    """A chaos round where NOTHING recovered completes with value=None —
+    it must be a gated ROW (fails --check), never a reported-only
+    partial: robustness is a gate even at total failure."""
+    line = _chaos_line(recovered=0, verified=False)
+    line["value"] = None
+    line["recovery"] = {"p50_s": None, "p99_s": None, "max_s": None,
+                        "walls": [], "bounded": False}
+    _bank_chaos(tmp_path, 1, line)
+    rows, partials = bench_ledger.load_chaos(str(tmp_path))
+    assert partials == [] and len(rows) == 1
+    failures = bench_ledger.check_chaos(rows)
+    assert any("did NOT recover" in f for f in failures)

@@ -128,6 +128,7 @@ def test_java_wire_constants_match_python():
         "ERR_BAD_SNAPSHOT": wire.ERR_BAD_SNAPSHOT,
         "ERR_INVALID": wire.ERR_INVALID,
         "ERR_INTERNAL": wire.ERR_INTERNAL,
+        "ERR_CANCELLED": wire.ERR_CANCELLED,
         "ARRAY_DTYPE": "d",
         "ARRAY_SHAPE": "s",
         "ARRAY_BYTES": "b",

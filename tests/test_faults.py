@@ -1,0 +1,275 @@
+"""Fault-injection layer tests (ISSUE 12): spec grammar, deterministic
+schedules, the disarmed zero-overhead/bit-exactness tripwire, and the
+recovery semantics of every seam that degrades in-process (graft →
+rebuild, HBM pressure → evict-and-retry, device diff → numpy reference,
+bank → bank-last, scheduler grant → no stuck jobs)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ccx.common import faults
+from ccx.common.faults import FAULTS, FaultRegistry, InjectedFault, parse_spec
+from ccx.goals.base import GoalConfig
+from ccx.model.fixtures import RandomClusterSpec, random_cluster
+from ccx.model.snapshot import model_to_arrays
+
+GOALS = (
+    "StructuralFeasibility",
+    "RackAwareGoal",
+    "ReplicaDistributionGoal",
+)
+
+SMALL = RandomClusterSpec(
+    n_brokers=6, n_racks=3, n_topics=3, n_partitions=32, seed=5
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process-wide registry disarmed."""
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+# ----- spec grammar / schedules ----------------------------------------------
+
+
+def test_parse_spec_forms():
+    rules = parse_spec(
+        "rpc.frame:sever@3;snapshot.transfer:exhaust@1;"
+        "registry.graft:raise@2/3;device.diff:delay@2+:delay=0.001;"
+        "compile:corrupt@*"
+    )
+    assert [r.describe() for r in rules] == [
+        "rpc.frame:sever@3", "snapshot.transfer:exhaust@1",
+        "registry.graft:raise@2/3", "device.diff:delay@2+",
+        "compile:corrupt@*",
+    ]
+    assert rules[3].delay_s == 0.001
+
+
+def test_parse_spec_rejects_unknowns():
+    with pytest.raises(ValueError, match="unknown fault seam"):
+        parse_spec("no.such.seam:raise@1")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        parse_spec("rpc.frame:explode@1")
+    with pytest.raises(ValueError, match="1-based"):
+        parse_spec("rpc.frame:raise@0")
+    with pytest.raises(ValueError, match="unknown fault param"):
+        parse_spec("rpc.frame:delay@1:bogus=2")
+
+
+def test_schedule_nth_every_and_star():
+    r = FaultRegistry()
+    r.arm("compile:raise@2")
+    r.hit("compile")
+    with pytest.raises(InjectedFault):
+        r.hit("compile")
+    r.hit("compile")  # single-shot: the 3rd hit passes
+
+    r.arm("compile:raise@2/3")
+    fired = []
+    for i in range(1, 9):
+        try:
+            r.hit("compile")
+            fired.append(False)
+        except InjectedFault:
+            fired.append(True)
+    assert fired == [False, True, False, False, True, False, False, True]
+
+    r.arm("compile:raise@*")
+    with pytest.raises(InjectedFault):
+        r.hit("compile")
+
+
+def test_injected_kinds_and_resource_exhausted_classifier():
+    r = FaultRegistry()
+    r.arm("snapshot.transfer:exhaust@1;rpc.frame:sever@1")
+    with pytest.raises(InjectedFault) as e1:
+        r.hit("snapshot.transfer")
+    assert faults.is_resource_exhausted(e1.value)
+    with pytest.raises(InjectedFault) as e2:
+        r.hit("rpc.frame")
+    assert e2.value.kind == "sever"
+    assert not faults.is_resource_exhausted(e2.value)
+    # the organic form classifies too
+    assert faults.is_resource_exhausted(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating ...")
+    )
+
+
+def test_corrupt_is_deterministic_and_never_a_noop():
+    r = FaultRegistry()
+    payload = bytes(range(256)) * 4
+    r.arm("rpc.frame:corrupt@1", seed=7)
+    a = r.hit("rpc.frame", payload)
+    r.arm("rpc.frame:corrupt@1", seed=7)
+    b = r.hit("rpc.frame", payload)
+    assert a == b and a != payload
+    r.arm("rpc.frame:corrupt@1", seed=8)
+    c = r.hit("rpc.frame", payload)
+    assert c != a and c != payload
+    # a corrupt rule with nothing to corrupt is a plain failure
+    r.arm("compile:corrupt@1")
+    with pytest.raises(InjectedFault):
+        r.hit("compile")
+
+
+# ----- the disarmed tripwire -------------------------------------------------
+
+
+def test_disarmed_is_zero_hits_and_bit_exact():
+    """The CCX_CONVERGENCE=0 contract: disarmed, no seam ever reaches the
+    registry (zero-overhead attribute guard at every call site), and an
+    armed-but-empty schedule changes nothing — optimize() is bit-exact
+    armed-empty vs disarmed."""
+    from ccx.optimizer import optimize
+    from tests.test_scheduler import small_opts
+
+    m = random_cluster(SMALL)
+    assert not FAULTS.armed
+    r1 = optimize(m, GoalConfig(), GOALS, small_opts())
+    assert FAULTS.hits_total() == 0, (
+        "a seam called FAULTS.hit() while disarmed — the zero-overhead "
+        "guard is broken somewhere"
+    )
+    FAULTS.arm("")  # armed, empty schedule: seams count but never fire
+    r2 = optimize(m, GoalConfig(), GOALS, small_opts())
+    FAULTS.disarm()
+    assert FAULTS.fired_total() == 0
+    for field in ("assignment", "leader_slot", "replica_disk"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(r1.model, field)),
+            np.asarray(getattr(r2.model, field)),
+            err_msg=field,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(r1.stack_after.costs), np.asarray(r2.stack_after.costs)
+    )
+
+
+# ----- in-process seam recovery ----------------------------------------------
+
+
+def _registry_with_session(session="s"):
+    from ccx.sidecar.server import SnapshotRegistry
+
+    m = random_cluster(SMALL)
+    reg = SnapshotRegistry()
+    reg.put(session, 1, model_to_arrays(m))
+    return reg, m
+
+
+def test_graft_fault_degrades_to_rebuild_never_torn():
+    """An injected graft failure drops the resident device model; the next
+    model() rebuilds from the (already-updated) host arrays — the rebuilt
+    model carries the NEW metrics, never a torn mix."""
+    reg, m = _registry_with_session()
+    base = reg.model("s")
+    assert base is not None
+    arrays = model_to_arrays(m)
+    new = dict(arrays)
+    new["leader_load"] = (
+        np.asarray(arrays["leader_load"], np.float32) * 2.0
+    )
+    FAULTS.arm("registry.graft:raise@1")
+    reg.put("s", 2, new, changed={"leader_load"})
+    FAULTS.disarm()
+    assert reg.graft_failures == 1
+    assert reg.delta_grafts == 0
+    rebuilt = reg.model("s")
+    dense = np.asarray(new["leader_load"], np.float32).reshape(4, -1)
+    np.testing.assert_allclose(
+        np.asarray(rebuilt.leader_load)[:, : dense.shape[1]], dense,
+        rtol=1e-6,
+    )
+
+
+def test_transfer_pressure_evicts_and_retries_cold():
+    """RESOURCE_EXHAUSTED on the host→device build evicts every resident
+    and retries — the call succeeds, the registry records the pressure."""
+    reg, m = _registry_with_session()
+    assert reg.model("s") is not None  # resident
+    reg.put("s", 2, model_to_arrays(m))  # invalidate → next model rebuilds
+    FAULTS.arm("snapshot.transfer:exhaust@1")
+    out = reg.model("s")
+    FAULTS.disarm()
+    assert out is not None
+    assert reg.pressure_evictions == 1
+    # a double failure is a real capacity problem and surfaces
+    reg.put("s", 3, model_to_arrays(m))
+    FAULTS.arm("snapshot.transfer:exhaust@1+")
+    with pytest.raises(InjectedFault):
+        reg.model("s")
+    FAULTS.disarm()
+
+
+def test_device_diff_fault_degrades_to_numpy_reference():
+    from ccx.proposals import columnar_diff, diff_columnar
+
+    m = random_cluster(SMALL)
+    a = np.asarray(m.assignment).copy()
+    i = int(np.nonzero(np.asarray(m.partition_valid))[0][0])
+    a[i, 0] = (a[i, 0] + 1) % m.B
+    import jax.numpy as jnp
+
+    m2 = m.replace(assignment=jnp.asarray(a))
+    FAULTS.arm("device.diff:raise@1")
+    got = columnar_diff(m, m2, backend="device")
+    FAULTS.disarm()
+    ref = diff_columnar(m, m2)
+    assert got.n == len(ref["partition"])
+    np.testing.assert_array_equal(got.cols["partition"], ref["partition"])
+
+
+def test_bank_fault_is_bank_last_previous_base_survives():
+    """A failed bank leaves the session's previous generation intact and
+    generation-consistent — never a partial WarmStart."""
+    from ccx.search import incremental as incr
+
+    m = random_cluster(SMALL)
+    incr.STORE.drop("chaos-bank")
+    incr.remember("chaos-bank", 1, m, GoalConfig())
+    FAULTS.arm("placement.bank:raise@1")
+    with pytest.raises(InjectedFault):
+        incr.remember("chaos-bank", 2, m, GoalConfig())
+    FAULTS.disarm()
+    assert incr.STORE.generation("chaos-bank") == 1
+    assert incr.STORE.get("chaos-bank", 2) is None
+    assert incr.STORE.get("chaos-bank", 1) is not None
+    incr.STORE.drop("chaos-bank")
+
+
+def test_scheduler_grant_fault_leaves_no_stuck_job():
+    """An injected grant failure mid-wave unwinds through FLEET.job — the
+    grant is released and the run queue is left empty (the zero-stuck-jobs
+    chaos gate)."""
+    from ccx.search.scheduler import ChunkScheduler
+
+    s = ChunkScheduler()
+    FAULTS.arm("scheduler.grant:raise@3")
+    done = {}
+
+    def run():
+        try:
+            with s.job("chaos", 0) as h:
+                for _ in range(10):
+                    with s.chunk(h):
+                        pass
+        except InjectedFault as e:
+            done["err"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout=10)
+    FAULTS.disarm()
+    assert done["err"].seam == "scheduler.grant"
+    st = s.stats()
+    assert st["activeJobs"] == []
+    # two clean chunks + the faulted third (its grant was released by the
+    # finally, so it still counts as granted)
+    assert st["chunksGranted"] == 3
+    assert len(s._granted) == 0

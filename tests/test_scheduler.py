@@ -423,3 +423,148 @@ def test_sixteen_shape_bucketed_jobs_zero_fresh_compiles():
         f"{warm['backend_compiles']} fresh compiles — a per-snapshot "
         f"static leaked into a jit key: {warm}"
     )
+
+
+# ----- cancellation (ISSUE 12: disconnect mid-wave) --------------------------
+
+
+def test_cancel_mid_wave_frees_grant_within_one_chunk():
+    """Setting a job's cancel event mid-run cancels it at the NEXT chunk
+    boundary: at most one more grant is issued after the set (the
+    in-flight chunk finishes; the next acquisition raises JobCancelled),
+    and the unwound job leaves no queue entry or held grant behind."""
+    from ccx.search.scheduler import JobCancelled
+
+    s = ChunkScheduler(dispatch_width=1)
+    cancel = threading.Event()
+    grants: list = []
+    at_cancel: list = []
+    outcome: dict = {}
+
+    def run():
+        try:
+            with s.job("doomed", 0, cancel_event=cancel) as h:
+                for i in range(200):
+                    with s.chunk(h):
+                        grants.append(i)
+                        if i == 4:
+                            # "the client disconnects" while chunk 4 is
+                            # mid-dispatch — the canceller's view of how
+                            # many grants had been issued at set time
+                            cancel.set()
+                            at_cancel.append(len(grants))
+                            s.kick()
+                        time.sleep(0.001)
+        except JobCancelled as e:
+            outcome["err"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert outcome["err"].job_id == "doomed"
+    # the in-flight chunk (the 5th) completed; NO further grant was issued
+    assert len(grants) <= at_cancel[0] + 1, grants
+    st = s.stats()
+    assert st["activeJobs"] == []
+    assert len(s._granted) == 0
+
+
+def test_cancelled_admission_leaves_no_queue_entry():
+    """A job cancelled while BLOCKED in the admission queue (residency cap
+    reached) unwinds without ever becoming resident and leaves the queue
+    clean — the holder job is unaffected."""
+    from ccx.search.scheduler import JobCancelled
+
+    s = ChunkScheduler(max_concurrent=1, dispatch_width=1)
+    cancel = threading.Event()
+    holder_in = threading.Event()
+    release_holder = threading.Event()
+    outcome: dict = {}
+
+    def holder():
+        with s.job("holder", 0) as h:
+            with s.chunk(h):
+                holder_in.set()
+                release_holder.wait(timeout=10)
+
+    def blocked():
+        holder_in.wait(timeout=10)
+        try:
+            with s.job("blocked", 0, cancel_event=cancel):
+                outcome["admitted"] = True
+        except JobCancelled as e:
+            outcome["err"] = e
+
+    t1 = threading.Thread(target=holder)
+    t2 = threading.Thread(target=blocked)
+    t1.start()
+    t2.start()
+    holder_in.wait(timeout=10)
+    time.sleep(0.05)  # let "blocked" reach the admission wait
+    cancel.set()
+    s.kick()
+    t2.join(timeout=10)
+    release_holder.set()
+    t1.join(timeout=10)
+    assert "admitted" not in outcome
+    assert outcome["err"].job_id == "blocked"
+    assert s.stats()["activeJobs"] == []
+
+
+def test_grpc_disconnect_cancels_propose_worker_and_frees_grant():
+    """End to end (the ISSUE 12 satellite): a gRPC client that disconnects
+    mid-Propose must NOT leave the server's propose worker computing to
+    completion — the disconnect callback cancels it at the next chunk
+    boundary and its scheduler registration (grant + residency) is freed
+    promptly."""
+    from ccx.model.snapshot import to_msgpack
+    from ccx.sidecar import wire
+    from ccx.sidecar.client import SidecarClient
+    from ccx.sidecar.server import make_grpc_server
+
+    m = random_cluster(SMALL)
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        c = SidecarClient(f"127.0.0.1:{port}", retries=0)
+        # a LONG budget in small chunks: the worker would run for many
+        # seconds if the disconnect were ignored
+        req = wire.propose_request(
+            goals=GOALS,
+            options={
+                "chains": 4, "steps": 200_000, "moves_per_step": 2,
+                "chunk_steps": 50, "run_polish": False,
+                "run_leader_pass": False, "run_cold_greedy": False,
+                "topic_rebalance_rounds": 0, "swap_polish_iters": 0,
+                "swap_polish_post_iters": 0,
+            },
+            snapshot=to_msgpack(m), cluster_id="disconnect-me",
+        )
+        stream = c._propose(req)
+        next(stream)  # the stream (and the worker) is live
+        # wait until the job is actually registered and chunking
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            jobs = [j["job"] for j in FLEET.stats()["activeJobs"]]
+            if "disconnect-me" in jobs:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("propose job never registered")
+        stream.cancel()  # the client disconnects mid-wave
+        c.close()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            jobs = [j["job"] for j in FLEET.stats()["activeJobs"]]
+            if "disconnect-me" not in jobs:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                "disconnected propose worker still registered after 20s: "
+                f"{FLEET.stats()['activeJobs']}"
+            )
+        assert len(FLEET._granted) == 0
+    finally:
+        server.stop(0)

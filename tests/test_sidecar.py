@@ -273,3 +273,424 @@ def test_sidecar_columnar_proposals_agree_with_rows():
         )
         assert int(cols["newLeader"][i]) == p["newLeader"]
         assert int(cols["oldLeader"][i]) == p["oldLeader"]
+
+
+# ----- client retry / structured stream errors (ISSUE 12) --------------------
+
+
+def test_client_unary_retry_on_transient_and_permanent_classification():
+    """Transient gRPC failures (UNAVAILABLE) retry with backoff; permanent
+    codes (INVALID_ARGUMENT) surface immediately."""
+    grpc = pytest.importorskip("grpc")
+    from ccx.sidecar.client import SidecarClient
+
+    class _Rpc(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        c = SidecarClient(f"127.0.0.1:{port}", retries=3, backoff_s=0.001,
+                          retry_seed=1)
+        real = c._ping
+        calls = {"n": 0}
+
+        def flaky(req, timeout=None):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise _Rpc(grpc.StatusCode.UNAVAILABLE)
+            return real(req, timeout=timeout)
+
+        c._ping = flaky
+        assert c.ping()["version"]
+        assert calls["n"] == 3
+        assert c.stats["retries"] == 2
+
+        calls["n"] = 0
+
+        def permanent(req, timeout=None):
+            calls["n"] += 1
+            raise _Rpc(grpc.StatusCode.INVALID_ARGUMENT)
+
+        c._ping = permanent
+        with pytest.raises(grpc.RpcError):
+            c.ping()
+        assert calls["n"] == 1, "permanent errors must not retry"
+        c.close()
+    finally:
+        server.stop(0)
+
+
+def test_put_snapshot_delta_retry_is_idempotent(model):
+    """The PutSnapshot retry contract: a duplicate delivery of an
+    already-applied delta (the client retried a put whose ack was lost)
+    is ACKed by generation match instead of failing the base-generation
+    guard — and the registry state is unchanged by the duplicate."""
+    import msgpack
+
+    from ccx.model.snapshot import delta_encode, model_to_arrays, pack_arrays
+
+    sidecar = OptimizerSidecar()
+    arrays = model_to_arrays(model)
+    sidecar.put_snapshot(msgpack.packb({
+        "session": "retry-put", "generation": 1,
+        "packed": to_msgpack(model),
+    }))
+    new = dict(arrays)
+    new["leader_load"] = np.asarray(arrays["leader_load"], np.float32) * 1.5
+    delta = pack_arrays(delta_encode(arrays, new))
+    req = msgpack.packb({
+        "session": "retry-put", "generation": 2, "packed": delta,
+        "is_delta": True, "base_generation": 1,
+    })
+    ack1 = msgpack.unpackb(sidecar.put_snapshot(req), raw=False)
+    assert ack1["generation"] == 2
+    # the retry: same (session, generation) — ACK, not a base mismatch
+    ack2 = msgpack.unpackb(sidecar.put_snapshot(req), raw=False)
+    assert ack2["generation"] == 2
+    assert sidecar.registry.get("retry-put")[0] == 2
+    # a genuinely NEW delta against a stale base still fails loudly
+    bad = msgpack.packb({
+        "session": "retry-put", "generation": 3, "packed": delta,
+        "is_delta": True, "base_generation": 1,
+    })
+    with pytest.raises(ValueError, match="does not match"):
+        sidecar.put_snapshot(bad)
+    # a DESYNCED writer labeling DIFFERENT content with the current
+    # generation is not a duplicate — it must fail loudly, never be
+    # silently ACK-dropped (the payload checksum distinguishes them)
+    other = dict(arrays)
+    other["leader_load"] = np.asarray(arrays["leader_load"], np.float32) * 9
+    desync = msgpack.packb({
+        "session": "retry-put", "generation": 2,
+        "packed": pack_arrays(delta_encode(arrays, other)),
+        "is_delta": True, "base_generation": 1,
+    })
+    with pytest.raises(ValueError, match="desynced"):
+        sidecar.put_snapshot(desync)
+
+
+def test_propose_restarts_on_severed_stream():
+    """An injected mid-stream sever ends the stream with no terminal
+    frame; the client classifies it StreamTruncated and RESTARTS the whole
+    request — the retry succeeds against the sidecar's consistent state."""
+    pytest.importorskip("grpc")
+    from ccx.common.faults import FAULTS
+    from ccx.sidecar.client import SidecarClient
+
+    m = small_deterministic()
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        c = SidecarClient(f"127.0.0.1:{port}", retries=2, backoff_s=0.001,
+                          retry_seed=3)
+        FAULTS.arm("rpc.frame:sever@2")
+        try:
+            out = c.propose(
+                m,
+                goals=("RackAwareGoal", "ReplicaDistributionGoal",
+                       "LeaderReplicaDistributionGoal"),
+                chains=4, steps=50, **LEAN,
+            )
+        finally:
+            FAULTS.disarm()
+        assert "proposals" in out
+        assert c.stats["stream_restarts"] == 1
+        c.close()
+    finally:
+        server.stop(0)
+
+
+def test_propose_restarts_on_corrupted_frame():
+    """A corrupted frame fails to decode locally — the client restarts the
+    stream (the server state is fine), never surfaces garbage."""
+    pytest.importorskip("grpc")
+    from ccx.common.faults import FAULTS
+    from ccx.sidecar.client import SidecarClient
+
+    m = small_deterministic()
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        c = SidecarClient(f"127.0.0.1:{port}", retries=2, backoff_s=0.001,
+                          retry_seed=4)
+        FAULTS.arm("rpc.frame:corrupt@1", seed=11)
+        try:
+            out = c.propose(
+                m,
+                goals=("RackAwareGoal", "ReplicaDistributionGoal",
+                       "LeaderReplicaDistributionGoal"),
+                chains=4, steps=50, **LEAN,
+            )
+        finally:
+            FAULTS.disarm()
+        assert "proposals" in out
+        assert c.stats["stream_restarts"] >= 1
+        c.close()
+    finally:
+        server.stop(0)
+
+
+def test_stream_truncated_carries_context_and_no_silent_retry_off():
+    """retries=0 restores fail-fast: the structured StreamTruncated
+    surfaces with session/cluster/frame context (the ISSUE 12 satellite —
+    no more bare 'stream ended without a result')."""
+    pytest.importorskip("grpc")
+    from ccx.common.faults import FAULTS
+    from ccx.sidecar import wire
+    from ccx.sidecar.client import SidecarClient
+
+    m = small_deterministic()
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        with SidecarClient(f"127.0.0.1:{port}", retries=0) as c:
+            c.put_snapshot(m, session="trunc", generation=1)
+            FAULTS.arm("rpc.frame:sever@1")
+            try:
+                with pytest.raises(wire.StreamTruncated) as e:
+                    c.propose(
+                        session="trunc", cluster_id="trunc-cluster",
+                        goals=("RackAwareGoal", "ReplicaDistributionGoal",
+                               "LeaderReplicaDistributionGoal"),
+                        chains=4, steps=50, **LEAN,
+                    )
+            finally:
+                FAULTS.disarm()
+        assert e.value.session == "trunc"
+        assert e.value.cluster_id == "trunc-cluster"
+        assert "session='trunc'" in str(e.value)
+    finally:
+        server.stop(0)
+
+
+def test_client_is_a_context_manager():
+    pytest.importorskip("grpc")
+    from ccx.sidecar.client import SidecarClient
+
+    server, port = make_grpc_server()
+    server.start()
+    try:
+        with SidecarClient(f"127.0.0.1:{port}") as c:
+            assert c.ping()["version"]
+        # channel closed on exit: the next call fails fast
+        with pytest.raises(Exception):
+            c.ping()
+    finally:
+        server.stop(0)
+
+
+# ----- SnapshotRegistry under concurrency (ISSUE 12 satellite) ---------------
+
+
+def _session_arrays(seed):
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=6, n_racks=3, n_topics=3, n_partitions=32, seed=seed
+    ))
+    from ccx.model.snapshot import model_to_arrays
+
+    return m, model_to_arrays(m)
+
+
+def test_registry_eviction_racing_graft_never_tears():
+    """Eviction (HBM pressure path) racing a metric-delta graft on the
+    SAME session: whatever interleaving, the final state is a consistent
+    resident model for the latest generation or a clean rebuild — never a
+    stale/torn device model."""
+    import threading
+
+    from ccx.sidecar.server import SnapshotRegistry
+
+    m, arrays = _session_arrays(77)
+    for trial in range(6):
+        reg = SnapshotRegistry()
+        reg.put("s", 1, arrays)
+        assert reg.model("s") is not None
+        new = dict(arrays)
+        new["leader_load"] = (
+            np.asarray(arrays["leader_load"], np.float32)
+            * (2.0 + trial)
+        )
+        barrier = threading.Barrier(2)
+
+        def grafting():
+            barrier.wait()
+            reg.put("s", 2, new, changed={"leader_load"})
+
+        def evicting():
+            barrier.wait()
+            reg.evict_device()
+
+        ts = [threading.Thread(target=grafting),
+              threading.Thread(target=evicting)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # the registry must now serve generation 2's metrics, whether the
+        # graft survived or the eviction forced a rebuild
+        out = reg.model("s")
+        dense = np.asarray(new["leader_load"], np.float32).reshape(4, -1)
+        np.testing.assert_allclose(
+            np.asarray(out.leader_load)[:, : dense.shape[1]], dense,
+            rtol=1e-6,
+        )
+
+
+def test_registry_put_racing_model_rebuild_is_generation_consistent():
+    """put (new generation) racing model() (rebuilding the old): the old
+    build must never be installed over the newer snapshot — the next
+    model() serves the NEW generation's tensors."""
+    import threading
+
+    from ccx.sidecar.server import SnapshotRegistry
+
+    m, arrays = _session_arrays(78)
+    for trial in range(6):
+        reg = SnapshotRegistry()
+        reg.put("s", 1, arrays)
+        new = dict(arrays)
+        new["leader_load"] = (
+            np.asarray(arrays["leader_load"], np.float32)
+            * (3.0 + trial)
+        )
+        barrier = threading.Barrier(2)
+
+        def building():
+            barrier.wait()
+            reg.model("s")  # may build gen 1 or gen 2 — must not tear
+
+        def putting():
+            barrier.wait()
+            reg.put("s", 2, new)  # full put: invalidates the device copy
+
+        ts = [threading.Thread(target=building),
+              threading.Thread(target=putting)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        out = reg.model("s")
+        dense = np.asarray(new["leader_load"], np.float32).reshape(4, -1)
+        np.testing.assert_allclose(
+            np.asarray(out.leader_load)[:, : dense.shape[1]], dense,
+            rtol=1e-6,
+        )
+        # and the install bookkeeping is coherent: the resident entry (if
+        # any) is keyed by the CURRENT generation
+        with reg._lock:
+            cached = reg._models.get("s")
+            assert cached is None or cached[0] == 2
+
+
+def test_streamed_result_checksum_catches_payload_corruption():
+    """Byte flips INSIDE a segment's payload keep the segment count AND
+    the joined length intact — only the round-16 crc32 on the terminal
+    frame catches them. Deterministic: the client is fed a hand-built
+    stream with one flipped payload byte."""
+    import zlib
+
+    from ccx.sidecar import wire
+    from ccx.sidecar.client import SidecarClient
+
+    blob = bytes(range(256)) * 64
+    corrupted = bytearray(blob)
+    corrupted[100] ^= 0x40  # same length, decodes fine — silent without crc
+    term = wire.result_frame({
+        "verified": True,
+        "proposalsColumnarSegments": 1,
+        "proposalsColumnarBytes": len(blob),
+        "proposalsColumnarCrc32": zlib.crc32(blob) & 0xFFFFFFFF,
+    })
+
+    c = SidecarClient.__new__(SidecarClient)  # no channel — fed directly
+    c.stats = {"attempts": 0, "retries": 0, "stream_restarts": 0}
+    c._propose = lambda req, timeout=None: iter([
+        wire.pack_frame(wire.progress_frame("Optimizing")),
+        wire.pack_frame(
+            wire.result_segment_frame(0, 1, bytes(corrupted))
+        ),
+        wire.pack_frame(term),
+    ])
+    c.propose_deadline_s = None
+    with pytest.raises(wire.StreamTruncated, match="checksum"):
+        c._propose_once(b"", session="s", cluster_id="c",
+                        on_progress=None, timings=None)
+
+
+def test_streamed_result_carries_matching_checksum():
+    """The server's terminal frame crc32 matches the joined segments —
+    the client-side verification has something real to check."""
+    import msgpack
+    import zlib
+
+    from ccx.model.snapshot import to_msgpack as pack
+    from ccx.sidecar import wire
+
+    sidecar = OptimizerSidecar()
+    m = small_deterministic()
+    frames = list(sidecar.propose(msgpack.packb({
+        "snapshot": pack(m),
+        "goals": ["RackAwareGoal", "ReplicaDistributionGoal",
+                  "LeaderReplicaDistributionGoal"],
+        "options": {"chains": 4, "steps": 50, **LEAN},
+        "columnar_proposals": True, "stream_result": True,
+    })))
+    term = [f["result"] for f in frames if "result" in f][0]
+    blob = b"".join(
+        f["data"] for f in frames if wire.FIELD_RESULT_SEGMENT in f
+    )
+    assert term["proposalsColumnarCrc32"] == (zlib.crc32(blob) & 0xFFFFFFFF)
+
+
+def test_inprocess_abandoned_propose_cancels_worker():
+    """An in-process consumer that stops iterating sidecar.propose() must
+    not leave the optimize worker computing to completion — the
+    GeneratorExit handler cancels via the auto-created event even when
+    the embedder passed no cancel (the round-16 leak fix, in-process
+    twin of the gRPC disconnect test)."""
+    import msgpack
+    import time
+
+    from ccx.model.fixtures import RandomClusterSpec, random_cluster
+    from ccx.model.snapshot import to_msgpack as pack
+    from ccx.search.scheduler import FLEET
+
+    m = random_cluster(RandomClusterSpec(
+        n_brokers=12, n_racks=3, n_topics=4, n_partitions=220, seed=11
+    ))
+    sidecar = OptimizerSidecar()
+    req = msgpack.packb({
+        "snapshot": pack(m), "cluster_id": "abandoned",
+        "goals": ["RackAwareGoal", "ReplicaDistributionGoal",
+                  "LeaderReplicaDistributionGoal"],
+        "options": {"chains": 4, "steps": 200_000, "moves_per_step": 2,
+                    "chunk_steps": 50, **LEAN, "run_polish": False,
+                    "run_leader_pass": False},
+    })
+    gen = sidecar.propose(req)
+    # the generator advances only while consumed: pull frames (phase
+    # breadcrumbs + ~1/s heartbeats) until the worker has registered
+    deadline = time.monotonic() + 30
+    registered = False
+    while time.monotonic() < deadline and not registered:
+        next(gen)
+        registered = any(
+            j["job"] == "abandoned" for j in FLEET.stats()["activeJobs"]
+        )
+    assert registered, "propose job never registered"
+    gen.close()  # the embedder walks away mid-stream
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if not any(j["job"] == "abandoned"
+                   for j in FLEET.stats()["activeJobs"]):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(
+            "abandoned propose worker still registered after 20s"
+        )

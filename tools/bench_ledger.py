@@ -34,14 +34,19 @@ This tool is the ledger and the tripwire:
   (config, backend, effort) round fails, as does an unverified curve.
   Rounds 1-5 carry the old driver dryrun-probe wrapper (no walls) — they
   are listed as legacy, reported but never gated.
-* fleet/steady/wire: ``FLEET_r*.json`` (concurrent Propose streams),
-  ``STEADY_r*.json`` (warm re-proposals per metrics window) and
+* fleet/steady/wire/chaos: ``FLEET_r*.json`` (concurrent Propose
+  streams), ``STEADY_r*.json`` (warm re-proposals per metrics window),
   ``WIRE_r*.json`` (the result-path split: warm sidecar round-trip with
   the optimizer excluded, per-leg medians, cold columnar proposals-down
-  leg — ``bench.py --wire``) each get a trend section; ``--check`` fails
-  an unverified latest line and a >10% regression of the family's
-  headline (fleet p99, steady p99, wire round-trip p50) vs the best
-  banked comparable round.
+  leg — ``bench.py --wire``) and ``CHAOS_r*.json`` (fault-injected drift
+  windows — ``bench.py --chaos``: recovery walls under one killed seam
+  class per window) each get a trend section; ``--check`` fails an
+  unverified latest line and a >10% regression of the family's headline
+  (fleet p99, steady p99, wire round-trip p50, chaos recovery p99) vs
+  the best banked comparable round. The chaos gate additionally fails
+  ANY unrecovered window, a stuck scheduler job, or a leaked
+  registry/placement entry in the latest round — robustness is a gate,
+  not a trend.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -726,6 +731,176 @@ def render_wire(wrows: list[dict], partials: list[dict]) -> str:
     return "\n".join(out)
 
 
+# ----- chaos (CHAOS_r*.json) -------------------------------------------------
+
+
+def load_chaos(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``CHAOS_r*.json`` under ``root`` — the
+    ``bench.py --chaos`` artifact: recovery walls of fault-injected drift
+    windows (one seam class killed per window), next to the clean steady
+    baseline, the stuck-job / leak audits and the disarmed
+    zero-fresh-compile epilogue measured in the same round."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "CHAOS_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        # NOTE: unlike the other families, a chaos line with value=None
+        # is NOT a partial — run_chaos records unrecovered windows and
+        # finishes, so a round where NOTHING recovered completes with an
+        # empty recovery-wall list. Routing it to partials would let the
+        # worst possible chaos outcome slip past --check; only a round
+        # that never reached the chaos schema (wedged/killed) is partial.
+        if not isinstance(line, dict) or not line.get("chaos"):
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed chaos line (rc={wrapper.get('rc')})",
+            })
+            continue
+        rec = line.get("recovery") or {}
+        cov = line.get("recovered") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "config": line.get("config", "?"),
+            "n_iters": line.get("n_iters"),
+            "drift": line.get("drift_fraction"),
+            "backend": str(line.get("backend", "?")),
+            "host_cores": line.get("host_cores"),
+            "verified": bool(line.get("verified")),
+            "clean_p50": (line.get("clean") or {}).get("p50_s"),
+            "p50": rec.get("p50_s"),
+            "p99": rec.get("p99_s", line.get("value")),
+            "bounded": bool(rec.get("bounded")),
+            "windows": cov.get("windows"),
+            "recovered": cov.get("recovered"),
+            "warm": cov.get("warm"),
+            "cold_fallback": cov.get("cold_fallback"),
+            "stuck": (line.get("scheduler") or {}).get("stuckJobs", 0),
+            "leaks_ok": bool(line.get("leaks_ok")),
+            "disarmed_ok": bool((line.get("disarmed") or {}).get("ok")),
+            "effort": line.get("effort") or {},
+        })
+    return rows, partials
+
+
+def chaos_group_key(row: dict) -> str:
+    """Chaos rows compare at identical (config, drift, backend,
+    host_cores, effort) — recovery walls depend on the drift size, warm
+    budget and host exactly like the steady family's."""
+    return json.dumps(
+        [row["config"], row["drift"], row["backend"], row["host_cores"],
+         row["effort"]],
+        sort_keys=True,
+    )
+
+
+def check_chaos(crows: list[dict]) -> list[str]:
+    """The chaos gate (robustness is a GATE, not a trend): in the LATEST
+    banked chaos round, an unverified line fails, ANY unrecovered window
+    fails, a stuck scheduler job fails, a leaked registry/placement entry
+    fails, an unbounded recovery fails, a broken disarmed epilogue fails
+    — and a recovery-p99 regression >10% vs the best banked comparable
+    round fails."""
+    failures: list[str] = []
+    if not crows:
+        return failures
+    latest_round = max(r["round"] for r in crows)
+    for r in (r for r in crows if r["round"] == latest_round):
+        tag = f"chaos round {r['round']} {r['config']}"
+        if not r["verified"]:
+            failures.append(f"{tag}: UNVERIFIED chaos line banked")
+        if (
+            r["windows"] is not None and r["recovered"] is not None
+            and r["recovered"] < r["windows"]
+        ):
+            failures.append(
+                f"{tag}: {r['windows'] - r['recovered']} of "
+                f"{r['windows']} fault-injected windows did NOT recover"
+            )
+        if r["stuck"]:
+            failures.append(
+                f"{tag}: {r['stuck']} scheduler job(s) left stuck after "
+                "the fault schedule"
+            )
+        if not r["leaks_ok"]:
+            failures.append(
+                f"{tag}: leaked registry/placement entries after recovery"
+            )
+        if not r["bounded"]:
+            failures.append(f"{tag}: recovery latency exceeded its bound")
+        if not r["disarmed_ok"]:
+            failures.append(
+                f"{tag}: disarmed epilogue failed (fresh compiles or "
+                "unverified clean windows — the zero-overhead tripwire)"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in crows:
+        groups.setdefault(chaos_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"]
+            and r["p99"] is not None
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(p["p99"] for p in prior)
+        if r["p99"] is not None and best:
+            limit = best * (1 + WALL_REGRESSION)
+            if r["p99"] > limit:
+                failures.append(
+                    f"chaos round {r['round']} {r['config']}: recovery "
+                    f"p99 {r['p99']:.2f}s regressed "
+                    f">{WALL_REGRESSION:.0%} vs best banked round "
+                    f"({best:.2f}s, limit {limit:.2f}s)"
+                )
+    return failures
+
+
+def render_chaos(crows: list[dict], partials: list[dict]) -> str:
+    """The chaos section of the trend table."""
+    if not crows and not partials:
+        return ""
+    out = ["", "chaos recovery (CHAOS_r*.json):"]
+    headers = ["round", "config", "windows", "backend", "clean ms",
+               "p50 s", "p99 s", "warm/cold", "stuck", "leaks", "ok"]
+    body = []
+    for r in sorted(crows, key=lambda r: r["round"]):
+        body.append([
+            _fmt(r["round"], 0), r["config"],
+            f"{r['recovered']}/{r['windows']}",
+            f"{r['backend']}/{r['host_cores']}c",
+            _fmt(
+                None if r["clean_p50"] is None else r["clean_p50"] * 1e3, 0
+            ),
+            _fmt(r["p50"], 2), _fmt(r["p99"], 2),
+            f"{r['warm']}/{r['cold_fallback']}",
+            _fmt(r["stuck"], 0),
+            "no" if r["leaks_ok"] else "LEAK",
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 # ----- trend table -----------------------------------------------------------
 
 
@@ -1004,6 +1179,7 @@ def main(argv=None) -> int:
     frows, fpartials = load_fleet(root)
     srows, spartials = load_steady(root)
     wrows, wpartials = load_wire(root)
+    crows, cpartials = load_chaos(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
@@ -1011,6 +1187,7 @@ def main(argv=None) -> int:
             "fleet": frows, "fleetPartials": fpartials,
             "steady": srows, "steadyPartials": spartials,
             "wire": wrows, "wirePartials": wpartials,
+            "chaos": crows, "chaosPartials": cpartials,
         }, indent=1))
         return 0
     if args.roofline:
@@ -1020,7 +1197,7 @@ def main(argv=None) -> int:
         failures = (
             check(rows, partials) + check_multichip(mrows)
             + check_fleet(frows) + check_steady(srows)
-            + check_wire(wrows)
+            + check_wire(wrows) + check_chaos(crows)
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
@@ -1034,16 +1211,18 @@ def main(argv=None) -> int:
         print(f"bench ledger green: {n} banked line(s), "
               f"{len(partials)} partial round(s), {len(mrows)} scaling "
               f"curve(s), {len(frows)} fleet line(s), {len(srows)} "
-              f"steady line(s), {len(wrows)} wire line(s), no regression "
-              f"vs the best banked rounds")
+              f"steady line(s), {len(wrows)} wire line(s), {len(crows)} "
+              f"chaos line(s), no regression vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
     mc = render_multichip(mrows, mlegacy)
     fl = render_fleet(frows, fpartials)
     st = render_steady(srows, spartials)
     wi = render_wire(wrows, wpartials)
+    ch = render_chaos(crows, cpartials)
     print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
-          + (("\n" + st) if st else "") + (("\n" + wi) if wi else ""))
+          + (("\n" + st) if st else "") + (("\n" + wi) if wi else "")
+          + (("\n" + ch) if ch else ""))
     return 0
 
 

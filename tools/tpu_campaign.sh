@@ -140,6 +140,18 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # anneal phases ride the same per-chunk heartbeat/tap machinery).
   CCX_BENCH_STEADY=1 timeout -k 60 2400 python bench.py
   echo "steady rc=$?"
+  echo "--- chaos rung (fault-injected drift windows; CHAOS artifact) ---"
+  # chaos-hardened warm serving (ISSUE 12): the steady drift loop under a
+  # seeded fault schedule — every seam class (stream sever/corrupt,
+  # mid-wave engine kill, graft kill + HBM pressure, device-diff kill,
+  # warm-bank kill, cold-pipeline kill) injected once per cycle, gated on
+  # 100% recovered-and-verified windows, zero stuck scheduler jobs, zero
+  # leaked registry/placement entries, bounded recovery latency, and a
+  # zero-fresh-compile disarmed epilogue. The flight recorder stays armed
+  # (exported above), so every injected fault's recovery leaves its
+  # span/heartbeat trail in the same JSONL as the clean rungs.
+  CCX_BENCH_CHAOS=1 timeout -k 60 2400 python bench.py
+  echo "chaos rc=$?"
   echo "--- wire / result-path rung (streamed columnar warm round-trips; WIRE artifact) ---"
   # the result-path split (ISSUE 11): warm end-to-end sidecar round-trip
   # with the optimizer excluded — snapshot-up / diff / assembly /
