@@ -1,0 +1,424 @@
+"""Unified device-memory manager — one ledger for all device residency.
+
+Rounds 12 and 14 each grew their own device cache: the fleet's
+``SnapshotRegistry`` (``ccx/sidecar/server.py``) LRU-evicts built device
+models under a costmodel-priced HBM budget, while the incremental loop's
+``PlacementStore`` (``ccx/search/incremental.py``) kept warm placement
+bases under a COUNT cap (``max_sessions``) that sat entirely outside that
+budget — the stale-docs wart "Integrative Dynamic Reconfiguration"
+(PAPERS.md, 1602.03770) warns about: coupled resources managed by
+independent policies fight each other exactly when memory is tight. This
+module is the one allocator both ride (and "Tetris", PAPERS.md
+2508.00426, is the exemplar: admission/eviction as packing under
+per-resource capacity):
+
+* every device-resident object is an **entry**: a ``(class, key)`` pair
+  with a byte size, a priority, an LRU stamp and an eviction callback
+  supplied by the owning cache. Classes today: ``snapshot`` (built
+  device cluster models), ``warmBase`` (converged placement bases +
+  pressure banks), ``program`` (compiled-program working set — the cost
+  observatory's captured HBM watermark, pinned: XLA owns that memory,
+  the ledger only *accounts* it);
+* admission is **priority-aware packing**: when the evictable classes
+  (snapshots + warm bases) exceed the budget, victims are chosen lowest
+  priority first, LRU within a priority — and an admission may NEVER
+  evict an entry of strictly higher priority, so an urgent self-healing
+  job's warm base or snapshot cannot be displaced by a dryrun
+  (priority 10 vs 0, the fleet scheduler's vocabulary). An entry's
+  priority is the priority of the LAST job that used it — a later
+  dryrun touch demotes it back, so completed urgent jobs do not pin
+  memory forever;
+* eviction is **never an error** by construction: the owning caches
+  registered callbacks that drop only the device copy — an evicted
+  snapshot rebuilds from host arrays on its next Propose, an evicted
+  warm base degrades to the documented ``ColdStartRequired`` cold start
+  (reason on the result, the RPC succeeds);
+* when no permissible victim exists (everything live is higher
+  priority, or a single entry alone exceeds the budget) the admission
+  still proceeds and is counted (``overBudgetAdmissions``) — serving
+  beats strict accounting, one job must always be able to run (the
+  SnapshotRegistry's original contract, now ledger-wide).
+
+The budget is the costmodel-derived HBM budget
+(``ccx.common.costmodel.fleet_snapshot_budget_bytes``: explicit operator
+setting, else half of device capacity minus the captured program
+watermark — the watermark is the same number the pinned ``program``
+entry reports, so programs are priced exactly once). The config key
+``optimizer.devmem.budget.mb`` (and env ``CCX_DEVMEM_BUDGET_MB``)
+overrides it for the unified ledger specifically.
+
+Everything is observable: resident bytes per class and eviction counts
+by (reason, priority) ride ``GET /observability``,
+``AnalyzerState.observability.deviceMemory`` and labeled Prometheus
+gauges (``ccx_devmem_resident_bytes{class=...}``,
+``ccx_devmem_evictions{reason=...,priority=...}`` — strict-exposition-
+parser-safe), and ``bench.py --steady-fleet`` samples the ledger every
+window to prove the fleet never exceeds the budget.
+
+Import-light on purpose (stdlib only at module load): the scheduler and
+the incremental store import this at their own import time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+#: entry classes whose bytes the ledger may reclaim. ``program`` is
+#: accounted but pinned — the compiled working set belongs to XLA and is
+#: already subtracted from the auto-derived budget (costmodel watermark).
+EVICTABLE_CLASSES = frozenset({"snapshot", "warmBase"})
+
+#: env twin of ``optimizer.devmem.budget.mb`` (0/unset = fall through to
+#: the fleet snapshot budget derivation)
+ENV_BUDGET_MB = "CCX_DEVMEM_BUDGET_MB"
+
+
+class Entry:
+    """One device-resident object on the ledger."""
+
+    __slots__ = ("klass", "key", "nbytes", "priority", "stamp", "pinned",
+                 "job", "evictor")
+
+    def __init__(self, klass: str, key: str, nbytes: int, priority: int,
+                 stamp: int, pinned: bool, job: str | None, evictor) -> None:
+        self.klass = klass
+        self.key = key
+        self.nbytes = int(nbytes)
+        self.priority = int(priority)
+        self.stamp = stamp
+        self.pinned = pinned
+        #: fleet job / session label — the scheduler's admission hook
+        #: boosts a registering urgent job's entries by this label
+        self.job = job
+        #: callable(key) dropping the owner's device copy; owners hold
+        #: only the device copy behind it, so calling it twice is safe
+        self.evictor = evictor
+
+
+class DeviceMemoryManager:
+    """The ledger (module docstring). One process-wide instance
+    (:data:`DEVMEM`) is shared by the snapshot registry, the placement
+    store and the cost observatory's program accounting; tests and
+    embedders may construct private instances with explicit budgets."""
+
+    def __init__(self, budget_bytes: int | None = None,
+                 metrics: bool = False) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[tuple[str, str], Entry] = {}
+        self._seq = 0
+        self._explicit_budget = budget_bytes
+        #: (reason, priority-of-victim) -> count. Reasons: ``budget``
+        #: (packing eviction), ``pressure`` (RESOURCE_EXHAUSTED flush),
+        #: ``explicit`` (owner dropped/invalidated the entry itself)
+        self.evictions: dict[tuple[str, int], int] = {}
+        self.over_budget_admissions = 0
+        self.admissions = 0
+        #: export labeled gauges on the process registry (the singleton
+        #: arms this; private test instances stay silent)
+        self._metrics = metrics
+
+    # ----- budget -----------------------------------------------------------
+
+    def budget_bytes(self) -> int:
+        """The unified HBM budget: explicit constructor/config/env
+        override, else the costmodel derivation (capacity minus the
+        captured program watermark, halved, floor 64 MB)."""
+        if self._explicit_budget is not None and self._explicit_budget > 0:
+            return int(self._explicit_budget)
+        mb = _BUDGET_MB_CONFIG
+        if mb is None:
+            env = os.environ.get(ENV_BUDGET_MB)
+            mb = float(env) if env else None
+        if mb is not None and mb > 0:
+            return int(mb * 1e6)
+        from ccx.common import costmodel
+
+        return costmodel.fleet_snapshot_budget_bytes()
+
+    # ----- admission --------------------------------------------------------
+
+    def admit(self, klass: str, key: str, nbytes: int, *,
+              priority: int | None = None, job: str | None = None,
+              pinned: bool = False, evictor=None) -> None:
+        """Register (or refresh) a device-resident entry and pack the
+        evictable classes under the budget. ``priority=None`` resolves
+        to the ambient fleet job's priority, else an existing entry's
+        priority (a metric graft refreshing a resident model must not
+        demote it), else 0. Evictor callbacks run OUTSIDE the ledger
+        lock — owners take their own locks inside them."""
+        if priority is None:
+            priority = self._ambient_priority()
+        with self._lock:
+            self._seq += 1
+            cur = self._entries.get((klass, key))
+            if priority is None:
+                priority = cur.priority if cur is not None else 0
+            e = Entry(klass, key, nbytes, priority, self._seq, pinned,
+                      job if job is not None
+                      else (cur.job if cur is not None else None),
+                      evictor if evictor is not None
+                      else (cur.evictor if cur is not None else None))
+            self._entries[(klass, key)] = e
+            self.admissions += 1
+            victims = self._pick_victims(admit_priority=e.priority,
+                                         protect=(klass, key))
+        self._evict(victims, reason="budget")
+        self._export()
+
+    def touch(self, klass: str, key: str, *,
+              priority: int | None = None,
+              job: str | None = None) -> None:
+        """LRU-refresh an entry (cache hit); ``priority`` — the toucher's
+        job priority — becomes the entry's new priority (the last user
+        wins, in both directions), and ``job`` relabels the entry with
+        the toucher's fleet-job id (so a later ``touch_job`` from the
+        scheduler's admission hook matches). No gauge export: a touch
+        changes neither bytes nor eviction counts, and this is the
+        per-cache-hit hot path."""
+        with self._lock:
+            e = self._entries.get((klass, key))
+            if e is None:
+                return
+            self._seq += 1
+            e.stamp = self._seq
+            if priority is not None:
+                e.priority = int(priority)
+            if job is not None:
+                e.job = job
+
+    def touch_job(self, job: str, priority: int) -> None:
+        """Boost/demote every entry carrying ``job`` as its fleet-job
+        label to ``priority`` — the scheduler's admission hook: the
+        moment an urgent job registers, its warm base and snapshot are
+        protected from lower-priority packing for the job's duration
+        (and a later normal-priority registration demotes them back).
+        No gauge export — priorities are not gauged."""
+        with self._lock:
+            for e in self._entries.values():
+                if e.job == job:
+                    e.priority = int(priority)
+
+    def release(self, klass: str, key: str, *,
+                reason: str = "explicit") -> bool:
+        """Remove an entry (the owner dropped/invalidated its device
+        copy itself — LRU-install races, pressure flushes, puts). Does
+        NOT call the evictor: the owner already did the dropping."""
+        with self._lock:
+            e = self._entries.pop((klass, key), None)
+            if e is not None:
+                k = (reason, e.priority)
+                self.evictions[k] = self.evictions.get(k, 0) + 1
+        self._export()
+        return e is not None
+
+    def release_namespace(self, ns: str, *, reason: str = "explicit") -> int:
+        """Drop every entry whose key lives under ``ns + ":"`` — the
+        teardown hook a registry/store arms via ``weakref.finalize`` so a
+        dropped instance's entries never linger as phantom bytes on the
+        shared ledger (tests and embedders construct and drop many)."""
+        prefix = ns + ":"
+        with self._lock:
+            keys = [k for k in self._entries if k[1].startswith(prefix)]
+            n = 0
+            for k in keys:
+                e = self._entries.pop(k)
+                rk = (reason, e.priority)
+                self.evictions[rk] = self.evictions.get(rk, 0) + 1
+                n += 1
+        self._export()
+        return n
+
+    # ----- eviction ---------------------------------------------------------
+
+    def _pick_victims(self, admit_priority: int,
+                      protect: tuple[str, str]) -> list[Entry]:
+        """(lock held) Victims to bring the evictable classes under
+        budget: lowest priority first, LRU within a priority; entries of
+        STRICTLY higher priority than the admitter are untouchable (the
+        urgent-vs-dryrun invariant), as are pinned entries and the
+        just-admitted one. May come up short — the caller counts the
+        over-budget admission and serves anyway."""
+        budget = self.budget_bytes()
+        total = sum(
+            e.nbytes for e in self._entries.values()
+            if e.klass in EVICTABLE_CLASSES
+        )
+        if total <= budget:
+            return []
+        candidates = sorted(
+            (
+                e for (kl, ky), e in self._entries.items()
+                if kl in EVICTABLE_CLASSES and not e.pinned
+                and (kl, ky) != protect and e.priority <= admit_priority
+            ),
+            key=lambda e: (e.priority, e.stamp),
+        )
+        victims: list[Entry] = []
+        for e in candidates:
+            if total <= budget:
+                break
+            del self._entries[(e.klass, e.key)]
+            total -= e.nbytes
+            k = ("budget", e.priority)
+            self.evictions[k] = self.evictions.get(k, 0) + 1
+            victims.append(e)
+        if total > budget:
+            self.over_budget_admissions += 1
+        return victims
+
+    def _evict(self, victims: list[Entry], reason: str) -> None:
+        """Run the victims' owner callbacks outside the ledger lock (the
+        owners take their own locks; a failing callback never wedges the
+        ledger — the device copy it guards is already unaccounted)."""
+        for e in victims:
+            if e.evictor is None:
+                continue
+            try:
+                e.evictor(e.key)
+            except Exception:  # noqa: BLE001 — eviction is best-effort;
+                pass  # the entry is gone from the ledger either way
+
+    # ----- program residency ------------------------------------------------
+
+    def note_program_watermark(self) -> None:
+        """Refresh the pinned ``program`` entry from the cost
+        observatory's captured HBM watermark — the compiled working set,
+        priced exactly once (the auto budget derivation already
+        subtracts the same number)."""
+        try:
+            from ccx.common import costmodel
+
+            wm = int(costmodel.hbm_watermark_bytes())
+        except Exception:  # noqa: BLE001 — accounting, never a dependency
+            return
+        if wm <= 0:
+            return
+        with self._lock:
+            self._seq += 1
+            self._entries[("program", "xla-working-set")] = Entry(
+                "program", "xla-working-set", wm, 0, self._seq,
+                pinned=True, job=None, evictor=None,
+            )
+        # no export here: the only caller is stats(), which exports once
+        # at its end
+
+    # ----- ambient priority -------------------------------------------------
+
+    @staticmethod
+    def _ambient_priority() -> int | None:
+        """The calling thread's fleet-job priority (None = no ambient
+        job — the caller's explicit/existing priority applies)."""
+        try:
+            from ccx.search.scheduler import FLEET
+
+            h = FLEET.current()
+            return None if h is None else int(h.priority)
+        except Exception:  # noqa: BLE001 — scheduler import cycles in
+            return None  # exotic embedders must not break admission
+
+    # ----- observability ----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ledger block (``GET /observability``, ``AnalyzerState``,
+        the steady-fleet bench's per-window samples): resident bytes and
+        entry counts per class, eviction counts by reason and priority,
+        the budget and whether the evictable classes respect it."""
+        self.note_program_watermark()
+        with self._lock:
+            by_class_bytes: dict[str, int] = {}
+            by_class_count: dict[str, int] = {}
+            for e in self._entries.values():
+                by_class_bytes[e.klass] = (
+                    by_class_bytes.get(e.klass, 0) + e.nbytes
+                )
+                by_class_count[e.klass] = by_class_count.get(e.klass, 0) + 1
+            evictable = sum(
+                v for k, v in by_class_bytes.items()
+                if k in EVICTABLE_CLASSES
+            )
+            evs = {
+                f"{reason}/p{prio}": n
+                for (reason, prio), n in sorted(self.evictions.items())
+            }
+            budget = self.budget_bytes()
+            out = {
+                "budgetBytes": budget,
+                "residentBytes": by_class_bytes,
+                "residentCount": by_class_count,
+                "evictableBytes": evictable,
+                "withinBudget": evictable <= budget,
+                "evictions": evs,
+                "evictionsTotal": sum(self.evictions.values()),
+                "admissions": self.admissions,
+                "overBudgetAdmissions": self.over_budget_admissions,
+            }
+        self._export()  # every stats read re-seeds the gauges (/metrics)
+        return out
+
+    def _export(self) -> None:
+        """Push the labeled Prometheus gauges (singleton only): one
+        ``devmem-resident-bytes`` series per class, one
+        ``devmem-evictions`` series per (reason, priority), plus the
+        scalar budget — all settable gauges, so the exposition stays one
+        ``# TYPE`` per family (strict-parser-safe)."""
+        if not self._metrics:
+            return
+        try:
+            from ccx.common.metrics import REGISTRY
+
+            with self._lock:
+                by_class: dict[str, int] = {}
+                for e in self._entries.values():
+                    by_class[e.klass] = by_class.get(e.klass, 0) + e.nbytes
+                evs = dict(self.evictions)
+            for klass in ("snapshot", "warmBase", "program"):
+                REGISTRY.set_gauge(
+                    "devmem-resident-bytes", by_class.get(klass, 0),
+                    labels={"class": klass},
+                    help="device-resident bytes per ledger class "
+                         "(ccx.common.devmem)",
+                )
+            REGISTRY.set_gauge(
+                "devmem-budget-bytes", self.budget_bytes(),
+                help="unified device-memory budget (ccx.common.devmem)",
+            )
+            for (reason, prio), n in evs.items():
+                REGISTRY.set_gauge(
+                    "devmem-evictions", n,
+                    labels={"reason": reason, "priority": str(prio)},
+                    help="ledger evictions by reason and victim priority "
+                         "(ccx.common.devmem)",
+                )
+        except Exception:  # noqa: BLE001 — metrics are best-effort
+            pass
+
+    # ----- test/bench helpers -----------------------------------------------
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.evictions.clear()
+            self.admissions = 0
+            self.over_budget_admissions = 0
+        self._export()
+
+    def entry(self, klass: str, key: str) -> Entry | None:
+        with self._lock:
+            return self._entries.get((klass, key))
+
+
+#: config-layer override (``optimizer.devmem.budget.mb`` via configure())
+_BUDGET_MB_CONFIG: float | None = None
+
+
+def configure(budget_mb: float | None = None) -> None:
+    """Config hook (``optimizer.devmem.budget.mb``): 0/None restores the
+    fleet-snapshot/auto derivation."""
+    global _BUDGET_MB_CONFIG
+    _BUDGET_MB_CONFIG = float(budget_mb) if budget_mb else None
+
+
+#: the process-wide ledger (sidecar registry, placement store, facade and
+#: bench all share it — like FLEET / TRACER / REGISTRY)
+DEVMEM = DeviceMemoryManager(metrics=True)

@@ -366,7 +366,22 @@ def analyzer_config_def() -> ConfigDef:
              "registry (N cluster models kept live, LRU-evicted). 0 = "
              "auto: half of (device HBM capacity - the cost observatory's "
              "captured working-set watermark), floor 64 MB "
-             "(ccx.common.costmodel.fleet_snapshot_budget_bytes).",
+             "(ccx.common.costmodel.fleet_snapshot_budget_bytes). Also "
+             "the fallback budget of the unified device-memory ledger "
+             "when optimizer.devmem.budget.mb is 0.",
+             at_least(0))
+    d.define("optimizer.devmem.budget.mb", Type.INT, 0, Importance.LOW,
+             "Budget (MB) of the UNIFIED device-memory ledger "
+             "(ccx.common.devmem): one byte-priced pool for snapshot "
+             "device models, warm placement bases and the compiled-"
+             "program working set together, with priority-aware "
+             "eviction (an urgent self-healing job's residents are "
+             "never displaced by a dryrun admission; lowest-priority / "
+             "least-recently-used go first; eviction degrades to a "
+             "rebuild or a documented ColdStartRequired cold start, "
+             "never a failed RPC). 0 = fall through to "
+             "optimizer.fleet.snapshot.hbm.mb, else the auto "
+             "derivation. Env twin: CCX_DEVMEM_BUDGET_MB.",
              at_least(0))
     d.define("optimizer.incremental.enabled", Type.BOOLEAN, False,
              Importance.MEDIUM,
@@ -438,9 +453,13 @@ def analyzer_config_def() -> ConfigDef:
              "the low-temperature SA misses.", at_least(0))
     d.define("optimizer.incremental.max.sessions", Type.INT, 32,
              Importance.LOW,
-             "Sessions kept in the process-wide warm-placement store "
-             "(LRU; ~12 MB of device arrays per B5-scale session). An "
-             "evicted session simply cold-starts on its next proposal.",
+             "COUNT backstop on the process-wide warm-placement store "
+             "(~12 MB of device arrays per B5-scale session). Warm "
+             "bases are primarily BYTE-priced on the unified device-"
+             "memory ledger (optimizer.devmem.budget.mb) next to the "
+             "snapshot models, with priority-aware eviction; this cap "
+             "only bounds the session count on top. An evicted session "
+             "simply cold-starts on its next proposal.",
              at_least(1))
     d.define("optimizer.repair.backend", Type.STRING, "device",
              Importance.LOW,

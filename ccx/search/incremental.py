@@ -52,6 +52,8 @@ import os
 import threading
 import time
 
+from ccx.common.devmem import DEVMEM
+
 #: env off-switch (the config key ``optimizer.incremental.enabled`` wins
 #: when the facade set it explicitly; the env kills the subsystem outright
 #: for bench/tools/subprocess paths)
@@ -130,8 +132,12 @@ class IncrementalOptions:
     #: skip; ``optimizer.incremental.warm.leader.iters``) — leader-bytes
     #: drift sometimes needs transfers the coupled draw misses
     warm_leader_iters: int = 0
-    #: sessions kept in the process-wide placement store (LRU;
-    #: ``optimizer.incremental.max.sessions``)
+    #: COUNT backstop on the process-wide placement store
+    #: (``optimizer.incremental.max.sessions``). Warm bases are
+    #: primarily BYTE-priced on the unified device-memory ledger
+    #: (``ccx.common.devmem``, one budget with the snapshot registry,
+    #: priority-aware eviction); this cap only bounds the session count
+    #: on top.
     max_sessions: int = 32
 
     @property
@@ -165,10 +171,25 @@ class WarmStart:
     cost_vec: tuple = ()
     #: monotonic stamp for LRU eviction
     stamp: float = 0.0
+    #: per-put install token (the ledger evictor's stale-callback guard
+    #: — a callback that lost a race to a newer bank must not drop it)
+    token: int = 0
 
     def shape_key(self) -> tuple:
         a = self.assignment
         return (tuple(a.shape), tuple(self.leader_slot.shape))
+
+
+def warm_device_bytes(warm: WarmStart) -> int:
+    """Device footprint of one warm base: the placement arrays plus the
+    banked pressure stack (what actually sits in HBM per session)."""
+    total = 0
+    for a in (warm.assignment, warm.leader_slot, warm.replica_disk,
+              warm.pressure):
+        nb = getattr(a, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
 
 
 class PlacementStore:
@@ -176,23 +197,62 @@ class PlacementStore:
 
     ``put`` keeps placements by reference (no copy, no transfer);
     ``get(session, base_generation)`` returns the stored placement only
-    when the generation matches (None asks for the latest). LRU-bounded:
-    a steady-state fleet keeps its hot sessions resident, cold sessions
-    age out and simply cold-start on their next Propose (eviction is
-    never an error — the graceful-degradation contract the snapshot
-    registry set)."""
+    when the generation matches (None asks for the latest). Residency is
+    BYTE-priced on the unified device-memory ledger
+    (``ccx.common.devmem`` — one budget with the snapshot registry's
+    device models, priority-aware eviction: an urgent job's base is
+    never displaced by a dryrun admission), with ``max_sessions`` kept
+    as a count backstop. An evicted session simply cold-starts on its
+    next Propose (``ColdStartRequired`` with the reason on the result —
+    the graceful-degradation contract; eviction is never an error)."""
 
-    def __init__(self, max_sessions: int = 32) -> None:
+    def __init__(self, max_sessions: int = 32, ledger=None) -> None:
+        import weakref
+
         self._lock = threading.Lock()
         self._by_session: dict[str, WarmStart] = {}
         self.max_sessions = int(max_sessions)
+        self._seq = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        #: the unified device-memory ledger (None = count-LRU only, the
+        #: standalone/test construction path; the module :data:`STORE`
+        #: shares the process-wide ``devmem.DEVMEM``)
+        self._ledger = ledger
+        self._ns = f"store{id(self):x}"
+        self._self_ref = weakref.ref(self)
+        if ledger is not None:
+            # teardown hook: a dropped store must not leave phantom
+            # bytes on a shared ledger — finalize releases this
+            # instance's namespace at GC
+            weakref.finalize(self, ledger.release_namespace, self._ns)
 
-    def put(self, warm: WarmStart) -> None:
+    def _ledger_key(self, session: str) -> str:
+        return f"{self._ns}:{session}"
+
+    def _ledger_evicted(self, key: str, token: int) -> None:
+        """Ledger eviction callback: drop only this store's entry (the
+        next warm Propose for the session cold-starts with the reason on
+        the result — never a failed RPC). ``token`` is the install token
+        the evicting entry was admitted for — a callback that lost a
+        race to a NEWER bank of the same session must not drop it (its
+        own ledger entry is already gone; the re-admit covers the new
+        base)."""
+        session = key.split(":", 1)[1]
+        with self._lock:
+            cur = self._by_session.get(session)
+            if cur is not None and cur.token == token:
+                del self._by_session[session]
+                self.evictions += 1
+
+    def put(self, warm: WarmStart, priority: int | None = None,
+            job: str | None = None) -> None:
+        count_victims: list[str] = []
         with self._lock:
             warm.stamp = time.monotonic()
+            self._seq += 1
+            warm.token = self._seq
             self._by_session[warm.session] = warm
             while len(self._by_session) > max(self.max_sessions, 1):
                 victim = min(
@@ -200,9 +260,39 @@ class PlacementStore:
                 )
                 del self._by_session[victim]
                 self.evictions += 1
+                count_victims.append(victim)
+        if self._ledger is None:
+            return
+        for victim in count_victims:
+            self._ledger.release("warmBase", self._ledger_key(victim))
+        ref = self._self_ref
+        token = warm.token
 
-    def get(self, session: str,
-            base_generation: int | None = None) -> WarmStart | None:
+        def _evict(key, _ref=ref, _token=token):
+            s = _ref()
+            if s is not None:
+                s._ledger_evicted(key, _token)
+
+        self._ledger.admit(
+            "warmBase", self._ledger_key(warm.session),
+            warm_device_bytes(warm), priority=priority,
+            job=job or warm.session, evictor=_evict,
+        )
+        # close the install/admit race: a concurrent packing eviction
+        # between the store write above and this admit popped the base —
+        # the re-added ledger entry would account bytes that are no
+        # longer resident
+        with self._lock:
+            cur = self._by_session.get(warm.session)
+            resident = cur is not None and cur.token == token
+        if not resident:
+            self._ledger.release(
+                "warmBase", self._ledger_key(warm.session)
+            )
+
+    def get(self, session: str, base_generation: int | None = None,
+            priority: int | None = None,
+            job: str | None = None) -> WarmStart | None:
         with self._lock:
             warm = self._by_session.get(session)
             if warm is None or (
@@ -213,7 +303,15 @@ class PlacementStore:
                 return None
             warm.stamp = time.monotonic()
             self.hits += 1
-            return warm
+        if self._ledger is not None:
+            # LRU-refresh on the ledger; the reader's job priority becomes
+            # the entry's (the last user wins, in both directions) and the
+            # reader's fleet-job label re-labels it for touch_job
+            self._ledger.touch(
+                "warmBase", self._ledger_key(session), priority=priority,
+                job=job,
+            )
+        return warm
 
     def generation(self, session: str) -> int | None:
         with self._lock:
@@ -222,25 +320,44 @@ class PlacementStore:
 
     def drop(self, session: str) -> None:
         with self._lock:
-            self._by_session.pop(session, None)
+            had = self._by_session.pop(session, None) is not None
+        if had and self._ledger is not None:
+            self._ledger.release("warmBase", self._ledger_key(session))
 
     def clear(self) -> None:
         with self._lock:
+            sessions = list(self._by_session)
             self._by_session.clear()
+        if self._ledger is not None:
+            for s in sessions:
+                self._ledger.release("warmBase", self._ledger_key(s))
+
+    def device_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                warm_device_bytes(w) for w in self._by_session.values()
+            )
 
     def stats(self) -> dict:
         with self._lock:
+            device_bytes = sum(
+                warm_device_bytes(w) for w in self._by_session.values()
+            )
             return {
                 "sessions": len(self._by_session),
                 "maxSessions": self.max_sessions,
+                "deviceBytes": device_bytes,
+                "ledger": self._ledger is not None,
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
             }
 
 
-#: the process-wide store (sidecar Propose path, facade verbs, bench)
-STORE = PlacementStore()
+#: the process-wide store (sidecar Propose path, facade verbs, bench) —
+#: byte-priced on the unified device-memory ledger next to the snapshot
+#: registry's device models (one budget, priority-aware eviction)
+STORE = PlacementStore(ledger=DEVMEM)
 
 
 def configure(max_sessions: int | None = None) -> None:
@@ -253,7 +370,8 @@ def configure(max_sessions: int | None = None) -> None:
 
 
 def remember(
-    session: str, generation: int, model, cfg=None, pressure=None
+    session: str, generation: int, model, cfg=None, pressure=None,
+    priority: int | None = None, job: str | None = None,
 ) -> WarmStart:
     """Bank a converged result as the session's warm base: placement
     arrays by reference, plus the band-pressure delta cache (one jitted
@@ -292,7 +410,14 @@ def remember(
 
     if FAULTS.armed:
         FAULTS.hit("placement.bank")
-    STORE.put(warm)
+    # ``priority`` (the banking job's fleet priority — explicit from the
+    # sidecar, ambient from a facade verb's FLEET.job context) prices the
+    # base on the unified device-memory ledger: an urgent job's base is
+    # protected from lower-priority admissions until a later normal-
+    # priority use demotes it. ``job`` (the fleet cluster id, when it
+    # differs from the session) labels the entry so the scheduler's
+    # touch_job admission hook matches.
+    STORE.put(warm, priority=priority, job=job)
     return warm
 
 

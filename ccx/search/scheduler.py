@@ -238,6 +238,19 @@ class ChunkScheduler:
         from ccx.common.tracing import TRACER
 
         h = self.register(job_id, priority, cancel_event=cancel_event)
+        # admission hook of the unified device-memory ledger
+        # (ccx.common.devmem): the registering job's priority re-prices
+        # every device-resident entry carrying this job/session label
+        # (its snapshot model, its warm base) — the moment an urgent
+        # self-healing job is admitted, its residents are protected from
+        # lower-priority packing; a later normal-priority registration
+        # demotes them back (the last user wins).
+        try:
+            from ccx.common.devmem import DEVMEM
+
+            DEVMEM.touch_job(h.job_id, h.priority)
+        except Exception:  # noqa: BLE001 — accounting, never admission
+            pass
         self._tl.job = h
         prev_label = TRACER.set_job(h.job_id)
         try:

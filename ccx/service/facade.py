@@ -129,6 +129,13 @@ class CruiseControl:
             config["optimizer.fleet.snapshot.hbm.mb"]
         )
         costmodel.export_gauges(REGISTRY)
+        # unified device-memory ledger (ccx.common.devmem): one budget
+        # pricing snapshot models + warm placement bases + the compiled
+        # working set together, priority-aware eviction. 0 = fall through
+        # to the fleet snapshot derivation above.
+        from ccx.common import devmem as _devmem
+
+        _devmem.configure(budget_mb=config["optimizer.devmem.budget.mb"])
         # convergence telemetry taps (ccx.search.telemetry): same
         # tri-state precedence — an absent key leaves the env
         # (CCX_CONVERGENCE) in charge of the default-on taps; the
@@ -385,7 +392,7 @@ class CruiseControl:
             warm = None
             if getattr(opts, "incremental", None) is not None \
                     and opts.incremental.armed and backend != "greedy":
-                warm = _inc.STORE.get(cid)
+                warm = _inc.STORE.get(cid, priority=priority)
             res = self._run_optimizer_timed(
                 model, goal_names, opts, progress, backend, warm_start=warm
             )
@@ -397,8 +404,11 @@ class CruiseControl:
             ):
                 gen = self._incremental_gen.get(cid, 0) + 1
                 self._incremental_gen[cid] = gen
+                # the verb's fleet priority prices the banked base on the
+                # unified device-memory ledger (urgent self-healing bases
+                # are protected from dryrun packing)
                 _inc.remember(cid, gen, res.model, self.goal_config,
-                              pressure=res.warm_pressure)
+                              pressure=res.warm_pressure, priority=priority)
             return res
 
     def _run_optimizer_timed(self, model, goal_names, opts, progress,
@@ -705,10 +715,13 @@ class CruiseControl:
     def observability(self, include_threads: bool = False) -> dict:
         """The flight-deck endpoint (GET /observability): tracer + flight-
         recorder + watchdog state, live span stacks with chunk progress,
-        live compile counters, and — with ``threads=true`` — an all-thread
-        stack dump. Works DURING a wedged proposal: the optimizer holds no
-        lock this path needs, and a stuck device call releases the GIL."""
-        return TRACER.observability_json(threads=include_threads)
+        live compile counters, the unified device-memory ledger, and —
+        with ``threads=true`` — an all-thread stack dump. Works DURING a
+        wedged proposal: the optimizer holds no lock this path needs, and
+        a stuck device call releases the GIL."""
+        out = TRACER.observability_json(threads=include_threads)
+        out["deviceMemory"] = self._devmem_state()
+        return out
 
     # ----- cached proposals (ref GoalOptimizer precompute, C14) -------------
 
@@ -833,6 +846,12 @@ class CruiseControl:
                         # safe — the full timeline is USER-gated on
                         # /observability)
                         "convergenceTaps": self._convergence_state(),
+                        # unified device-memory ledger (ccx.common.
+                        # devmem): resident bytes per class (snapshots /
+                        # warm bases / programs), eviction counts by
+                        # reason and priority, and the budget — sizes and
+                        # counters only, VIEWER-safe
+                        "deviceMemory": self._devmem_state(),
                     },
                 }
         if "anomaly_detector" in want:
@@ -1054,6 +1073,16 @@ class CruiseControl:
             "warmT0": iopts.warm_t0,
             "store": _inc.STORE.stats(),
         }
+
+    def _devmem_state(self) -> dict:
+        """AnalyzerState.observability.deviceMemory / the /observability
+        ledger block (never raises — state must stay readable)."""
+        try:
+            from ccx.common.devmem import DEVMEM
+
+            return DEVMEM.stats()
+        except Exception:  # noqa: BLE001 — state must stay readable
+            return {}
 
     def _convergence_state(self) -> dict:
         """AnalyzerState.observability.convergenceTaps: taps armed + ring

@@ -59,16 +59,23 @@ class SnapshotRegistry:
     BUILT device model (``arrays_to_model`` output: padded, device-
     committed tensors) is cached per cluster so a fleet of repeat Propose
     callers stops paying the build + host→device transfer per call.
-    Device residency is bounded by an HBM budget priced from the cost
-    observatory (``costmodel.fleet_snapshot_budget_bytes``: device
-    capacity minus the captured program working-set watermark, operator-
-    overridable); least-recently-used models are evicted first — eviction
-    only drops the DEVICE copy, the host arrays stay, so an evicted
-    cluster's next Propose rebuilds instead of failing.
+    Device residency is byte-priced on the UNIFIED device-memory ledger
+    (``ccx.common.devmem`` — one costmodel-derived HBM budget shared
+    with the placement store's warm bases and the compiled-program
+    working set, priority-aware eviction: an urgent job's model is never
+    displaced by a dryrun admission; lowest-priority / least-recently-
+    used entries go first). Eviction only drops the DEVICE copy, the
+    host arrays stay, so an evicted cluster's next Propose rebuilds
+    instead of failing. An explicit ``hbm_budget_bytes`` detaches the
+    registry onto a PRIVATE ledger with that budget (tests, embedders
+    that want snapshot-only accounting); the default shares the
+    process-wide ``devmem.DEVMEM``.
 
     Thread-safe: one lock guards the maps; the model build itself runs
     outside it (two racing builders of the same session waste one build,
-    never corrupt state)."""
+    never corrupt state), and ledger admissions/evictions run outside it
+    too (the ledger calls back into ``_devmem_evicted`` which re-takes
+    it)."""
 
     #: delta fields that can be grafted onto a resident device model
     #: without a rebuild: the pure metric tensors (padded with zeros
@@ -78,6 +85,10 @@ class SnapshotRegistry:
     METRIC_FIELDS = frozenset({"leader_load", "follower_load"})
 
     def __init__(self, hbm_budget_bytes: int | None = None) -> None:
+        import weakref
+
+        from ccx.common import devmem as _devmem
+
         self._lock = threading.Lock()
         #: session -> (generation, host arrays)
         self._snapshots: dict[str, tuple[int, dict]] = {}
@@ -85,6 +96,22 @@ class SnapshotRegistry:
         self._models: dict[str, tuple[int, object, int, int]] = {}
         self._seq = 0
         self._explicit_budget = hbm_budget_bytes
+        #: the device-memory ledger pricing this registry's residents —
+        #: the process-wide unified one by default, a private one when an
+        #: explicit budget detaches it (class docstring)
+        self._devmem = (
+            _devmem.DEVMEM
+            if hbm_budget_bytes is None or hbm_budget_bytes <= 0
+            else _devmem.DeviceMemoryManager(
+                budget_bytes=int(hbm_budget_bytes)
+            )
+        )
+        self._ns = f"reg{id(self):x}"
+        self._self_ref = weakref.ref(self)
+        # teardown hook: a dropped registry (tests, embedders) must not
+        # leave phantom bytes on a SHARED ledger — finalize releases
+        # every entry under this instance's namespace at GC
+        weakref.finalize(self, self._devmem.release_namespace, self._ns)
         self.evictions = 0
         self.hits = 0
         self.misses = 0
@@ -102,11 +129,59 @@ class SnapshotRegistry:
         self.pressure_evictions = 0
 
     def budget_bytes(self) -> int:
-        if self._explicit_budget is not None and self._explicit_budget > 0:
-            return int(self._explicit_budget)
-        from ccx.common import costmodel
+        return self._devmem.budget_bytes()
 
-        return costmodel.fleet_snapshot_budget_bytes()
+    # ----- unified device-memory ledger hooks -------------------------------
+
+    def _ledger_key(self, session: str) -> str:
+        return f"{self._ns}:{session}"
+
+    def _devmem_evicted(self, key: str, stamp: int) -> None:
+        """Ledger eviction callback (runs outside the ledger lock): drop
+        only the DEVICE copy — the host arrays stay, the next Propose
+        rebuilds. Never an error. ``stamp`` is the INSTALL stamp the
+        evicting entry was admitted for: a callback that lost a race to
+        a newer install (the session was rebuilt and re-admitted before
+        the callback ran) must not drop the new model — its own ledger
+        entry is already gone, the re-admit's entry covers the new
+        install."""
+        session = key.split(":", 1)[1]
+        with self._lock:
+            cur = self._models.get(session)
+            if cur is not None and cur[3] == stamp:
+                del self._models[session]
+                self.evictions += 1
+
+    def _admit(self, session: str, nbytes: int, stamp: int,
+               priority: int | None = None,
+               job: str | None = None) -> None:
+        """Price an installed device model on the ledger (outside
+        ``self._lock`` — the ledger's packing may call back into
+        ``_devmem_evicted``). ``stamp`` is the install's stamp
+        (``_models[session][3]``) — the evictor guard above. ``job`` is
+        the serving fleet-job label (cluster id), passed through
+        verbatim: None preserves an existing entry's label (the graft
+        refresh must not undo a cluster-id relabel). The post-admit
+        residency check closes the install/admit race: a concurrent
+        packing eviction landing between the model install and this
+        admit would otherwise leave a ledger entry accounting a model
+        that is no longer resident."""
+        ref = self._self_ref
+
+        def _evict(key, _ref=ref, _stamp=stamp):
+            reg = _ref()
+            if reg is not None:
+                reg._devmem_evicted(key, _stamp)
+
+        self._devmem.admit(
+            "snapshot", self._ledger_key(session), nbytes,
+            priority=priority, job=job, evictor=_evict,
+        )
+        with self._lock:
+            cur = self._models.get(session)
+            resident = cur is not None and cur[3] == stamp
+        if not resident:
+            self._devmem.release("snapshot", self._ledger_key(session))
 
     # dict-compatible surface (the server's session logic + existing tests
     # reach through these like the old plain dict)
@@ -126,11 +201,17 @@ class SnapshotRegistry:
         with self._lock:
             self._snapshots[session] = (int(generation), arrays)
             cached = self._models.pop(session, None)
-        if (
+        graftable = (
             changed is not None
             and cached is not None
             and set(changed) <= self.METRIC_FIELDS
-        ):
+        )
+        if cached is not None and not graftable:
+            # device copy invalidated outright — unprice it (the graft
+            # path below keeps the ledger entry alive until it decides,
+            # so a successful graft preserves the entry's priority)
+            self._devmem.release("snapshot", self._ledger_key(session))
+        if graftable:
             # The resident model was POPPED above, so from here on every
             # failure mode is consistent by construction: a failed graft
             # (None below) simply leaves no device copy and the next
@@ -139,6 +220,7 @@ class SnapshotRegistry:
             grafted = self._graft_metrics(cached[1], arrays, changed)
             if grafted is None:
                 self.graft_failures += 1
+                self._devmem.release("snapshot", self._ledger_key(session))
                 return
             with self._lock:
                 cur = self._snapshots.get(session)
@@ -147,13 +229,23 @@ class SnapshotRegistry:
                     # this graft would pin a STALE device model under a
                     # fresh LRU stamp; drop it (the winner's own graft or
                     # the next Propose's rebuild serves the new state)
-                    return
-                self._seq += 1
-                self._models[session] = (
-                    int(generation), grafted, cached[2], self._seq
-                )
-                self.delta_grafts += 1
-                self._evict_over_budget()
+                    stamp = None
+                else:
+                    self._seq += 1
+                    stamp = self._seq
+                    self._models[session] = (
+                        int(generation), grafted, cached[2], stamp
+                    )
+                    self.delta_grafts += 1
+            if stamp is not None:
+                # refresh the ledger entry (same bytes; priority AND job
+                # label preserved — a metrics graft must neither demote
+                # an urgent job's resident model nor undo its cluster-id
+                # relabel)
+                self._admit(session, cached[2], stamp, priority=None,
+                            job=None)
+            else:
+                self._devmem.release("snapshot", self._ledger_key(session))
 
     @staticmethod
     def _graft_metrics(model, arrays: dict, changed: set):
@@ -195,9 +287,17 @@ class SnapshotRegistry:
         except Exception:  # noqa: BLE001 — fast path only, rebuild covers
             return None
 
-    def model(self, session: str):
+    def model(self, session: str, priority: int | None = None,
+              job: str | None = None):
         """The device model for a session's CURRENT snapshot — cache hit
-        when resident, else built and admitted under the HBM budget.
+        when resident, else built and admitted on the unified ledger.
+        ``priority`` is the serving job's fleet priority: it prices the
+        entry for the priority-aware packing (an urgent job's model
+        cannot be displaced by a later dryrun admission; a later dryrun
+        USE demotes it back — the last user wins). ``job`` is the fleet
+        job label (cluster id) the entry is re-labeled with, so the
+        scheduler's ``touch_job`` hook matches even when a client's
+        cluster_id differs from its session.
 
         Crash-consistent against the two organic failure modes: an
         allocation failure (RESOURCE_EXHAUSTED — HBM pressure) evicts
@@ -213,14 +313,22 @@ class SnapshotRegistry:
             gen = entry[0]
             cached = self._models.get(session)
             if cached is not None and cached[0] == gen:
-                self._seq += 1
-                self._models[session] = (
-                    cached[0], cached[1], cached[2], self._seq
-                )
+                # NOTE: the tuple's stamp is the INSTALL stamp (the
+                # ledger evictor's stale-callback guard) — a cache hit
+                # must not rewrite it; recency lives on the ledger
+                # (touch below), not here
                 self.hits += 1
-                return cached[1]
-            arrays = entry[1]
-            self.misses += 1
+                hit = cached[1]
+            else:
+                arrays = entry[1]
+                self.misses += 1
+                hit = None
+        if hit is not None:
+            self._devmem.touch(
+                "snapshot", self._ledger_key(session), priority=priority,
+                job=job,
+            )
+            return hit
         try:
             m = self._build(arrays)
         except Exception as e:  # noqa: BLE001 — classified below
@@ -231,15 +339,20 @@ class SnapshotRegistry:
             # registry's admission contract: one job can always run).
             # A second failure is a real capacity problem and raises.
             self.pressure_evictions += 1
-            self.evict_device()
+            self.evict_device(reason="pressure")
             m = self._build(arrays)
         nbytes = model_device_bytes(m)
         with self._lock:
             cur = self._snapshots.get(session)
             if cur is not None and cur[0] == gen:
                 self._seq += 1
-                self._models[session] = (gen, m, nbytes, self._seq)
-                self._evict_over_budget()
+                stamp = self._seq
+                self._models[session] = (gen, m, nbytes, stamp)
+            else:
+                stamp = None
+        if stamp is not None:
+            self._admit(session, nbytes, stamp, priority=priority,
+                        job=job or session)
         return m
 
     def _build(self, arrays):
@@ -249,33 +362,28 @@ class SnapshotRegistry:
             faults.FAULTS.hit("snapshot.transfer")
         return arrays_to_model(arrays)
 
-    def evict_device(self, session: str | None = None) -> int:
+    def evict_device(self, session: str | None = None,
+                     reason: str = "explicit") -> int:
         """Drop device-resident models (the host arrays always stay, so
         the next Propose rebuilds — eviction is never an error).
         ``session=None`` drops ALL residents: the HBM-pressure
         degradation path. Returns the number evicted."""
         with self._lock:
             if session is not None:
-                n = 1 if self._models.pop(session, None) is not None else 0
+                dropped = (
+                    [session]
+                    if self._models.pop(session, None) is not None
+                    else []
+                )
             else:
-                n = len(self._models)
+                dropped = list(self._models)
                 self._models.clear()
-            self.evictions += n
-            return n
-
-    def _evict_over_budget(self) -> None:
-        """LRU eviction of device models over the HBM budget (lock held).
-        The just-admitted model is kept even when it alone exceeds the
-        budget (serving beats strict accounting — one job must always be
-        able to run)."""
-        budget = self.budget_bytes()
-        while len(self._models) > 1:
-            total = sum(v[2] for v in self._models.values())
-            if total <= budget:
-                break
-            victim = min(self._models, key=lambda s: self._models[s][3])
-            del self._models[victim]
-            self.evictions += 1
+            self.evictions += len(dropped)
+        for s in dropped:
+            self._devmem.release(
+                "snapshot", self._ledger_key(s), reason=reason
+            )
+        return len(dropped)
 
     def stats(self) -> dict:
         with self._lock:
@@ -285,6 +393,8 @@ class SnapshotRegistry:
                 "deviceResident": len(self._models),
                 "deviceBytes": device_bytes,
                 "budgetBytes": self.budget_bytes(),
+                "unifiedLedger": self._explicit_budget is None
+                or self._explicit_budget <= 0,
                 "evictions": self.evictions,
                 "hits": self.hits,
                 "misses": self.misses,
@@ -421,6 +531,13 @@ class OptimizerSidecar:
         from ccx.search import incremental as incr
 
         warm_req = bool(req.get(wire.FIELD_WARM_START)) and incr.env_enabled()
+        # fleet job identity, parsed up front: the cluster id names this
+        # job on the multi-job chunk scheduler; the priority ALSO prices
+        # every device-resident object this RPC touches (snapshot model,
+        # warm base) on the unified device-memory ledger — an urgent
+        # job's residents are protected from lower-priority packing
+        cluster = str(req.get("cluster_id") or req.get("session") or "anon")
+        priority = int(req.get("priority") or 0)
         if req.get("snapshot") is not None:
             arrays = _decode_snapshot(req["snapshot"], what="snapshot")
         else:
@@ -460,8 +577,10 @@ class OptimizerSidecar:
             # (padded, device-committed) model for this cluster's current
             # generation — repeat Proposes skip arrays_to_model + the
             # host->device transfer entirely, N clusters stay live under
-            # the HBM budget (LRU-evicted; an evicted cluster rebuilds)
-            model = self.registry.model(session)
+            # the unified HBM budget (priority-aware packing; an evicted
+            # cluster rebuilds)
+            model = self.registry.model(session, priority=priority,
+                                        job=cluster)
         if model is None:
             model = arrays_to_model(arrays)
 
@@ -578,7 +697,8 @@ class OptimizerSidecar:
                 cold_reason = "warm_start requires a session"
             else:
                 want_gen = req.get("base_generation")
-                warm = incr.STORE.get(session, want_gen)
+                warm = incr.STORE.get(session, want_gen,
+                                      priority=priority, job=cluster)
                 if warm is None:
                     have = incr.STORE.generation(session)
                     cold_reason = (
@@ -600,14 +720,12 @@ class OptimizerSidecar:
 
         q: _queue.Queue = _queue.Queue()
         box: dict = {}
-        # fleet job identity: the cluster id names this job on the multi-
-        # job chunk scheduler (and on every span/heartbeat/histogram it
-        # emits); priority orders it in the run queue — an urgent
-        # fix-offline-replicas Propose preempts a queued dryrun at the
-        # next chunk boundary. Absent fields degrade to the session id
-        # (pre-fleet peers) and priority 0.
-        cluster = str(req.get("cluster_id") or req.get("session") or "anon")
-        priority = int(req.get("priority") or 0)
+        # fleet job identity (parsed up front, above): the cluster id
+        # names this job on the multi-job chunk scheduler (and on every
+        # span/heartbeat/histogram it emits); priority orders it in the
+        # run queue — an urgent fix-offline-replicas Propose preempts a
+        # queued dryrun at the next chunk boundary. Absent fields degrade
+        # to the session id (pre-fleet peers) and priority 0.
 
         def _run():
             try:
@@ -697,9 +815,12 @@ class OptimizerSidecar:
             t_bank = _time.monotonic()
             try:
                 # a warm result carries its pressure bank precomputed (the
-                # fused warm_finish program) — the bank costs nothing extra
+                # fused warm_finish program) — the bank costs nothing
+                # extra; the job's priority prices the base on the
+                # unified device-memory ledger
                 incr.remember(session, cur_gen, res.model, self.goal_config,
-                              pressure=res.warm_pressure)
+                              pressure=res.warm_pressure, priority=priority,
+                              job=cluster)
                 # the bank's pressure-scan program is a NEW shape on a
                 # session's first cold propose, dispatched AFTER optimize()'s
                 # cost-capture phase already flushed — capture it HERE, still
@@ -866,6 +987,13 @@ def make_grpc_server(sidecar: OptimizerSidecar | None = None,
     from ccx.common import costmodel
 
     costmodel.export_gauges()
+    # ... and the unified device-memory ledger's (resident bytes per
+    # class, evictions by reason/priority, budget — ccx.common.devmem):
+    # one stats() pass seeds every labeled series so /metrics shows the
+    # ledger from the first scrape
+    from ccx.common.devmem import DEVMEM
+
+    DEVMEM.stats()
 
     def unary(fn, rpc_name):
         def handler(request: bytes, context):
@@ -1003,7 +1131,16 @@ def main(argv=None) -> int:
                          "registry (default CCX_FLEET_HBM_MB, else auto "
                          "from device capacity minus the cost "
                          "observatory's watermark — the standalone twin "
-                         "of optimizer.fleet.snapshot.hbm.mb)")
+                         "of optimizer.fleet.snapshot.hbm.mb). Detaches "
+                         "the registry from the unified ledger onto a "
+                         "private snapshot-only budget; prefer "
+                         "--devmem-budget-mb to size the unified pool.")
+    ap.add_argument("--devmem-budget-mb", type=float, default=None,
+                    help="budget of the UNIFIED device-memory ledger "
+                         "(snapshots + warm bases + program working set, "
+                         "ccx.common.devmem; default CCX_DEVMEM_BUDGET_MB "
+                         "else the fleet snapshot derivation — the "
+                         "standalone twin of optimizer.devmem.budget.mb)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     # same wedged-accelerator safeguard as the service entry point: a hung
@@ -1036,6 +1173,11 @@ def main(argv=None) -> int:
         mc = int(mc_env) if mc_env else None
     if mc is not None:
         fleet.configure(max_concurrent=mc)
+    # unified device-memory budget (flag > env > fleet/auto derivation)
+    if args.devmem_budget_mb:
+        from ccx.common import devmem
+
+        devmem.configure(budget_mb=args.devmem_budget_mb)
     sidecar = OptimizerSidecar(
         snapshot_hbm_budget_bytes=(
             int(args.snapshot_hbm_mb * 1e6)

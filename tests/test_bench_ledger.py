@@ -515,6 +515,132 @@ def test_steady_rung_is_wired_into_campaign_script():
     assert "CCX_BENCH_STEADY=1" in sh
 
 
+# ----- steady fleet (STEADYFLEET_r*.json — bench.py --steady-fleet) ----------
+
+
+def _steadyfleet_line(rate=5.0, p99=0.8, verified=True, cores=2,
+                      clusters=16, budget_ok=True, **extra):
+    return {
+        "metric": "B3 steady-state fleet: 16 warm clusters x 10 drift "
+                  "windows through the sidecar (per-window p99)",
+        "value": p99, "unit": "s", "vs_baseline": 1.1,
+        "steadyfleet": True, "config": "B3", "n_clusters": clusters,
+        "n_windows": 10, "drift_fraction": 0.01, "backend": "cpu",
+        "host_cores": cores, "verified": verified,
+        "windows_per_sec": rate, "single_windows_per_sec": rate / 1.1,
+        "warm": {"p50_s": p99 * 0.7, "p99_s": p99, "mean_s": p99 * 0.7,
+                 "walls": [p99 * 0.7, p99]},
+        "all_warm_started": verified,
+        "zero_warm_fresh_compiles": verified,
+        "devmem": {"budget_respected": budget_ok,
+                   "max_evictable_bytes": 800_000, "samples": 160,
+                   "final": {"budgetBytes": 4_000_000_000}},
+        "occupancy": 0.9,
+        "effort": {"warm_swap_iters": 8, "n_clusters": clusters,
+                   "n_windows": 10, "cold": {"chains": 8, "steps": 400}},
+        **extra,
+    }
+
+
+def _bank_steadyfleet(tmp_path, n, line):
+    (tmp_path / f"STEADYFLEET_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_steadyfleet_rows_parse(tmp_path):
+    _bank_steadyfleet(tmp_path, 1, _steadyfleet_line())
+    rows, partials = bench_ledger.load_steadyfleet(str(tmp_path))
+    assert partials == []
+    (r,) = rows
+    assert r["windows_per_sec"] == 5.0 and r["p99"] == 0.8
+    assert r["verified"] and r["budget_respected"] and r["all_warm"]
+    assert r["n_clusters"] == 16
+
+
+def test_steadyfleet_throughput_regression_fails(tmp_path):
+    # the aggregate windows/sec headline regresses DOWNWARD — >10% below
+    # the best banked comparable round fails
+    _bank_steadyfleet(tmp_path, 1, _steadyfleet_line(rate=5.0))
+    _bank_steadyfleet(tmp_path, 2, _steadyfleet_line(rate=4.0))
+    rows, _ = bench_ledger.load_steadyfleet(str(tmp_path))
+    failures = bench_ledger.check_steadyfleet(rows)
+    assert failures and "windows/s" in failures[0]
+
+
+def test_steadyfleet_p99_regression_fails(tmp_path):
+    _bank_steadyfleet(tmp_path, 1, _steadyfleet_line(p99=0.8))
+    _bank_steadyfleet(tmp_path, 2, _steadyfleet_line(p99=1.2))
+    rows, _ = bench_ledger.load_steadyfleet(str(tmp_path))
+    failures = bench_ledger.check_steadyfleet(rows)
+    assert failures and "p99" in failures[0]
+
+
+def test_steadyfleet_within_threshold_passes(tmp_path):
+    _bank_steadyfleet(tmp_path, 1, _steadyfleet_line(rate=5.0, p99=0.8))
+    _bank_steadyfleet(tmp_path, 2, _steadyfleet_line(rate=4.7, p99=0.85))
+    rows, _ = bench_ledger.load_steadyfleet(str(tmp_path))
+    assert bench_ledger.check_steadyfleet(rows) == []
+
+
+def test_steadyfleet_unverified_latest_fails(tmp_path):
+    _bank_steadyfleet(tmp_path, 1, _steadyfleet_line(verified=False))
+    rows, _ = bench_ledger.load_steadyfleet(str(tmp_path))
+    failures = bench_ledger.check_steadyfleet(rows)
+    assert failures and "UNVERIFIED" in failures[0]
+
+
+def test_steadyfleet_budget_breach_fails(tmp_path):
+    # the unified-accounting gate: a ledger sample with snapshots + warm
+    # bases over budget fails on its own line, even when everything else
+    # looks healthy
+    line = _steadyfleet_line(budget_ok=False)
+    line["verified"] = False  # bench.py folds the breach into verified
+    _bank_steadyfleet(tmp_path, 1, line)
+    rows, _ = bench_ledger.load_steadyfleet(str(tmp_path))
+    failures = bench_ledger.check_steadyfleet(rows)
+    assert any("budget" in f.lower() for f in failures)
+
+
+def test_steadyfleet_different_fleet_size_not_comparable(tmp_path):
+    # an 8-cluster round must never gate a 16-cluster one (nor 2-core an
+    # 8-core one) — same contract as the fleet family
+    _bank_steadyfleet(tmp_path, 1, _steadyfleet_line(rate=9.0, clusters=8))
+    _bank_steadyfleet(tmp_path, 2, _steadyfleet_line(rate=5.0))
+    _bank_steadyfleet(tmp_path, 3, _steadyfleet_line(rate=2.0, cores=8))
+    rows, _ = bench_ledger.load_steadyfleet(str(tmp_path))
+    assert bench_ledger.check_steadyfleet(rows) == []
+
+
+def test_steadyfleet_partial_round_reported_not_failed(tmp_path):
+    (tmp_path / "STEADYFLEET_r03.json").write_text(
+        json.dumps({"n": 3, "rc": 124, "parsed": None})
+    )
+    rows, partials = bench_ledger.load_steadyfleet(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+    assert bench_ledger.check_steadyfleet(rows) == []
+
+
+def test_steadyfleet_gate_green_on_banked_artifacts():
+    """The repo's own STEADYFLEET artifacts must pass the gate."""
+    rows, _ = bench_ledger.load_steadyfleet(str(REPO))
+    assert bench_ledger.check_steadyfleet(rows) == []
+
+
+def test_steadyfleet_rides_cli_table_and_check(tmp_path, capsys):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_steadyfleet(tmp_path, 1, _steadyfleet_line())
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 0
+    bench_ledger.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "steady-state fleet" in out and "win/s" in out
+
+
+def test_steadyfleet_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_STEADYFLEET=1" in sh
+
+
 # ----- wire (WIRE_r*.json — bench.py --wire) ---------------------------------
 
 

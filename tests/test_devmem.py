@@ -1,0 +1,277 @@
+"""Unified device-memory manager (ISSUE 14): one ledger pricing snapshot
+device models, warm placement bases and the compiled-program working set
+under the costmodel-derived HBM budget, with priority-aware eviction.
+
+Invariants pinned here:
+
+* **packing under budget** — admissions over budget evict lowest-priority
+  / least-recently-used evictable entries first, via the owner's
+  callback; the just-admitted entry is protected;
+* **the urgent-vs-dryrun invariant** — an admission may NEVER evict an
+  entry of strictly higher priority: an urgent self-healing job
+  (priority 10) never loses its warm base or snapshot to a dryrun
+  (priority 0). When no permissible victim exists the admission still
+  proceeds (serving beats strict accounting) and is counted;
+* **the last user wins** — a later lower-priority touch/registration
+  demotes an entry back, so finished urgent jobs do not pin memory;
+* **the scheduler admission hook** — registering a fleet job re-prices
+  every ledger entry carrying that job/session label;
+* **pinned program accounting** — the compiled working set is priced
+  (resident bytes per class) but never evicted;
+* **observability** — stats blocks and labeled Prometheus gauges
+  (strict-exposition-parser-safe: one TYPE per family).
+"""
+
+from __future__ import annotations
+
+import re
+
+from ccx.common.devmem import DEVMEM, DeviceMemoryManager
+
+
+def _mgr(budget=100) -> DeviceMemoryManager:
+    return DeviceMemoryManager(budget_bytes=budget)
+
+
+def test_admission_packs_lru_within_priority():
+    m = _mgr(budget=100)
+    evicted = []
+    for key, size in (("a", 40), ("b", 40)):
+        m.admit("snapshot", key, size, priority=0, evictor=evicted.append)
+    assert evicted == []
+    m.admit("snapshot", "c", 40, priority=0, evictor=evicted.append)
+    # LRU within equal priority: "a" (oldest) goes first, via the
+    # owner's callback; "b" and "c" fit
+    assert evicted == ["a"]
+    st = m.stats()
+    assert st["residentBytes"]["snapshot"] == 80
+    assert st["withinBudget"]
+    assert st["evictions"] == {"budget/p0": 1}
+
+
+def test_urgent_entry_never_evicted_by_lower_priority_admission():
+    m = _mgr(budget=100)
+    evicted = []
+    m.admit("warmBase", "urgent-base", 60, priority=10,
+            evictor=evicted.append)
+    m.admit("snapshot", "dryrun-model", 60, priority=0,
+            evictor=evicted.append)
+    # the dryrun admission found NO permissible victim: the urgent base
+    # stays, the admission proceeds over budget and is counted
+    assert evicted == []
+    assert m.entry("warmBase", "urgent-base") is not None
+    assert m.entry("snapshot", "dryrun-model") is not None
+    st = m.stats()
+    assert not st["withinBudget"]
+    assert st["overBudgetAdmissions"] == 1
+
+
+def test_higher_priority_admission_evicts_lower_first():
+    m = _mgr(budget=100)
+    evicted = []
+    m.admit("snapshot", "dryrun-old", 30, priority=0,
+            evictor=evicted.append)
+    m.admit("warmBase", "mid", 40, priority=5, evictor=evicted.append)
+    m.admit("snapshot", "urgent", 60, priority=10,
+            evictor=evicted.append)
+    # lowest priority first (p0 before p5), regardless of class
+    assert evicted == ["dryrun-old"]
+    st = m.stats()
+    assert st["withinBudget"]
+    assert st["evictions"] == {"budget/p0": 1}
+
+
+def test_last_user_wins_priority_demotion_and_touch_lru():
+    m = _mgr(budget=100)
+    evicted = []
+    m.admit("warmBase", "base", 60, priority=10, evictor=evicted.append)
+    # the urgent job finished; a later dryrun USES the same base —
+    # touch demotes it to the toucher's priority
+    m.touch("warmBase", "base", priority=0)
+    m.admit("snapshot", "other", 60, priority=0, evictor=evicted.append)
+    assert evicted == ["base"]
+
+
+def test_touch_refreshes_lru_order():
+    m = _mgr(budget=100)
+    evicted = []
+    m.admit("snapshot", "a", 40, priority=0, evictor=evicted.append)
+    m.admit("snapshot", "b", 40, priority=0, evictor=evicted.append)
+    m.touch("snapshot", "a")  # "a" is now the most recently used
+    m.admit("snapshot", "c", 40, priority=0, evictor=evicted.append)
+    assert evicted == ["b"]
+
+
+def test_touch_job_boosts_and_demotes_by_label():
+    m = _mgr(budget=100)
+    evicted = []
+    m.admit("snapshot", "s:model", 30, priority=0, job="cluster-x",
+            evictor=evicted.append)
+    m.admit("warmBase", "s:base", 30, priority=0, job="cluster-x",
+            evictor=evicted.append)
+    # the urgent job registers on the scheduler → both entries protected
+    m.touch_job("cluster-x", 10)
+    m.admit("snapshot", "bulk", 90, priority=0, evictor=evicted.append)
+    assert evicted == []  # no permissible victim at p0
+    assert m.entry("snapshot", "s:model").priority == 10
+    # a later dryrun registration demotes them back; now they pack out
+    m.touch_job("cluster-x", 0)
+    m.admit("snapshot", "bulk2", 90, priority=0, evictor=evicted.append)
+    assert "s:model" in evicted and "s:base" in evicted
+
+
+def test_pinned_program_entry_is_priced_but_never_evicted():
+    m = _mgr(budget=100)
+    evicted = []
+    m.admit("program", "xla-working-set", 1000, priority=0, pinned=True)
+    m.admit("snapshot", "a", 60, priority=0, evictor=evicted.append)
+    m.admit("snapshot", "b", 60, priority=0, evictor=evicted.append)
+    # programs are accounted (residentBytes) but outside the evictable
+    # pool: only "a" packs out, the pinned entry stays
+    assert evicted == ["a"]
+    st = m.stats()
+    assert st["residentBytes"]["program"] >= 1000
+    assert m.entry("program", "xla-working-set") is not None
+
+
+def test_release_does_not_call_evictor_and_counts_reason():
+    m = _mgr(budget=1000)
+    calls = []
+    m.admit("snapshot", "a", 10, priority=3, evictor=calls.append)
+    assert m.release("snapshot", "a", reason="pressure")
+    assert calls == []  # the owner already dropped its device copy
+    assert m.stats()["evictions"] == {"pressure/p3": 1}
+    assert not m.release("snapshot", "a")  # idempotent
+
+
+def test_failing_evictor_never_wedges_the_ledger():
+    m = _mgr(budget=50)
+
+    def boom(key):
+        raise RuntimeError("owner died")
+
+    m.admit("snapshot", "a", 40, priority=0, evictor=boom)
+    m.admit("snapshot", "b", 40, priority=0)  # evicts "a" — boom swallowed
+    assert m.entry("snapshot", "a") is None
+    assert m.entry("snapshot", "b") is not None
+
+
+def test_scheduler_registration_reprices_job_entries():
+    """The admission hook end-to-end: FLEET.job(id, priority) re-prices
+    every DEVMEM entry labeled with that job id (the moment an urgent
+    job is admitted, its residents are protected)."""
+    from ccx.search.scheduler import FLEET
+
+    key = "test-sched-hook:model"
+    try:
+        DEVMEM.admit("snapshot", key, 1, priority=0,
+                     job="test-sched-hook")
+        with FLEET.job("test-sched-hook", 10):
+            assert DEVMEM.entry("snapshot", key).priority == 10
+        # a later normal-priority registration demotes it back
+        with FLEET.job("test-sched-hook", 0):
+            assert DEVMEM.entry("snapshot", key).priority == 0
+    finally:
+        DEVMEM.release("snapshot", key)
+
+
+def test_ambient_fleet_priority_prices_admissions():
+    """An admission from inside a fleet-job context inherits the job's
+    priority when none is passed explicitly."""
+    from ccx.search.scheduler import FLEET
+
+    m = _mgr(budget=1000)
+    with FLEET.job("ambient-test", 7):
+        m.admit("warmBase", "b", 10)
+    assert m.entry("warmBase", "b").priority == 7
+    m.admit("warmBase", "c", 10)  # no ambient job → 0
+    assert m.entry("warmBase", "c").priority == 0
+
+
+def test_stats_block_shape():
+    m = _mgr(budget=100)
+    m.admit("snapshot", "a", 30, priority=0)
+    m.admit("warmBase", "b", 20, priority=10)
+    st = m.stats()
+    assert st["budgetBytes"] == 100
+    assert st["residentBytes"] == {"snapshot": 30, "warmBase": 20}
+    assert st["residentCount"] == {"snapshot": 1, "warmBase": 1}
+    assert st["evictableBytes"] == 50
+    assert st["withinBudget"] is True
+    assert st["admissions"] == 2
+
+
+def test_labeled_gauges_strict_exposition():
+    """The ledger's labeled gauges render one TYPE per family with one
+    sample per label set — the strict-exposition contract the
+    /metrics parser test pins for every other family."""
+    from ccx.common.metrics import REGISTRY
+
+    m = DeviceMemoryManager(budget_bytes=100, metrics=True)
+    m.admit("snapshot", "a", 60, priority=0)
+    m.admit("warmBase", "b", 60, priority=10)  # evicts "a" (p0 < p10)
+    text = REGISTRY.render_prometheus()
+    assert text.count("# TYPE ccx_devmem_resident_bytes gauge") == 1
+    assert 'ccx_devmem_resident_bytes{class="snapshot"}' in text
+    assert 'ccx_devmem_resident_bytes{class="warmBase"}' in text
+    assert 'ccx_devmem_resident_bytes{class="program"}' in text
+    assert text.count("# TYPE ccx_devmem_budget_bytes gauge") == 1
+    assert text.count("# TYPE ccx_devmem_evictions gauge") == 1
+    assert 'ccx_devmem_evictions{priority="0",reason="budget"} 1' in text
+    # every devmem sample line is well-formed (name{labels} value)
+    for line in text.splitlines():
+        if line.startswith("ccx_devmem"):
+            assert re.fullmatch(
+                r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+", line
+            ), line
+
+
+def test_budget_resolution_env_and_config(monkeypatch):
+    from ccx.common import devmem
+
+    m = DeviceMemoryManager()
+    monkeypatch.setenv(devmem.ENV_BUDGET_MB, "123")
+    assert m.budget_bytes() == 123_000_000
+    monkeypatch.delenv(devmem.ENV_BUDGET_MB)
+    devmem.configure(budget_mb=7)
+    try:
+        assert m.budget_bytes() == 7_000_000
+    finally:
+        devmem.configure(budget_mb=None)
+    # explicit constructor budget wins over everything
+    assert DeviceMemoryManager(budget_bytes=55).budget_bytes() == 55
+
+
+def test_touch_relabels_job_so_scheduler_hook_matches():
+    """A client whose cluster_id differs from its session: the serving
+    path touches the entry with job=<cluster-id>, so a later scheduler
+    registration under that cluster id re-prices the entry (the
+    review-found gap: entries labeled only by session never matched)."""
+    m = _mgr(budget=1000)
+    m.admit("snapshot", "reg:sess-42", 10, priority=0, job="sess-42")
+    # the propose path serves the session under cluster "analytics-prod"
+    m.touch("snapshot", "reg:sess-42", priority=0, job="analytics-prod")
+    m.touch_job("analytics-prod", 10)
+    assert m.entry("snapshot", "reg:sess-42").priority == 10
+
+
+def test_dropped_owner_releases_namespace_on_gc():
+    """A SnapshotRegistry dropped without explicit teardown must not
+    leave phantom bytes on the shared ledger (weakref.finalize →
+    release_namespace)."""
+    import gc
+
+    from ccx.model.fixtures import small_deterministic
+    from ccx.model.snapshot import model_to_arrays
+    from ccx.sidecar.server import SnapshotRegistry
+
+    arrays = model_to_arrays(small_deterministic())
+    reg = SnapshotRegistry()
+    reg.put("ns-gc-session", 1, arrays)
+    assert reg.model("ns-gc-session") is not None
+    ns = reg._ns
+    key = f"{ns}:ns-gc-session"
+    assert DEVMEM.entry("snapshot", key) is not None
+    del reg
+    gc.collect()
+    assert DEVMEM.entry("snapshot", key) is None

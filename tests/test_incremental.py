@@ -664,3 +664,160 @@ def test_registry_metric_delta_graft_and_eviction_rebuild():
         np.asarray(rebuilt.leader_load)[:, : built.leader_load.shape[1]],
         np.asarray(built.leader_load) * 2.0,
     )
+
+
+def test_ledger_evicted_warm_base_cold_starts_cleanly():
+    """The ISSUE 14 eviction invariant, end to end through the sidecar:
+    a warm base packed out of the UNIFIED device-memory ledger (a
+    higher-priority admission squeezed the budget) must degrade the next
+    warm_start Propose to the documented ColdStartRequired fallback —
+    verified result, coldStart reason in the incremental block, NEVER a
+    failed or torn RPC."""
+    import msgpack
+
+    from ccx.common.devmem import DEVMEM
+    from ccx.model.snapshot import to_msgpack as pack
+    from ccx.sidecar.server import OptimizerSidecar
+
+    sidecar = OptimizerSidecar()
+    m = small_deterministic()
+    sidecar.put_snapshot(msgpack.packb({
+        "session": "evict-me", "generation": 1, "packed": pack(m),
+    }))
+    res = _propose(sidecar, {
+        "session": "evict-me", "goals": SIDE_GOALS, "options": SIDE_OPTS,
+    })
+    assert res["verified"] and incr.STORE.generation("evict-me") == 1
+    # a priority-10 admission larger than the whole budget packs out
+    # every evictable p<=10 entry — including this session's warm base
+    # (the store's devmem evictor drops it) and its snapshot model
+    try:
+        DEVMEM.admit("snapshot", "test-budget-squeeze", 2 ** 62,
+                     priority=10)
+    finally:
+        DEVMEM.release("snapshot", "test-budget-squeeze")
+    assert incr.STORE.get("evict-me") is None  # the base is gone
+    res = _propose(sidecar, {
+        "session": "evict-me", "goals": SIDE_GOALS, "options": SIDE_OPTS,
+        "warm_start": True, "base_generation": 1,
+    })
+    assert res["verified"]
+    inc_block = res["incremental"]
+    assert inc_block["coldStart"] is True and not inc_block["warmStart"]
+    assert "no warm placement" in inc_block["reason"]
+    # the cold fallback re-banked: the loop recovers on its own
+    assert incr.STORE.generation("evict-me") == 1
+
+
+def test_urgent_warm_base_survives_dryrun_packing_e2e():
+    """The priority invariant end to end: a warm base banked by an
+    URGENT (priority 10) Propose is never displaced by a dryrun
+    (priority 0) admission squeezing the same unified budget."""
+    import msgpack
+
+    from ccx.common.devmem import DEVMEM
+    from ccx.model.snapshot import to_msgpack as pack
+    from ccx.sidecar.server import OptimizerSidecar
+
+    sidecar = OptimizerSidecar()
+    m = small_deterministic()
+    sidecar.put_snapshot(msgpack.packb({
+        "session": "urgent-keep", "generation": 1, "packed": pack(m),
+    }))
+    res = _propose(sidecar, {
+        "session": "urgent-keep", "goals": SIDE_GOALS,
+        "options": SIDE_OPTS, "cluster_id": "urgent-keep",
+        "priority": 10,
+    })
+    assert res["verified"]
+    assert incr.STORE.get("urgent-keep") is not None
+    # a dryrun-priority admission bigger than the budget: every p0
+    # entry packs out, the p10 warm base and snapshot model must stay
+    try:
+        DEVMEM.admit("snapshot", "test-dryrun-squeeze", 2 ** 62,
+                     priority=0)
+    finally:
+        DEVMEM.release("snapshot", "test-dryrun-squeeze")
+    assert incr.STORE.get("urgent-keep") is not None
+    assert sidecar.registry.stats()["deviceResident"] >= 1
+    # ... and a warm_start Propose still resolves the protected base
+    res = _propose(sidecar, {
+        "session": "urgent-keep", "goals": SIDE_GOALS,
+        "options": SIDE_OPTS, "warm_start": True, "base_generation": 1,
+        "cluster_id": "urgent-keep", "priority": 10,
+    })
+    assert res["verified"] and res["incremental"]["warmStart"] is True
+    incr.STORE.drop("urgent-keep")
+
+
+def test_sixteen_warm_sessions_concurrent_zero_fresh_compiles():
+    """The ISSUE 14 zero-fresh-compile tripwire: 16 shape-bucketed warm
+    sessions driving warm_start Proposes CONCURRENTLY through the
+    in-process sidecar pay ZERO fresh XLA compiles in the measured loop
+    — the whole fleet shares one compiled warm program set (the same
+    (padded P, padded B, bucketed max-partitions-per-topic) key the cold
+    fleet test pins in tests/test_scheduler.py)."""
+    import threading
+
+    import msgpack
+
+    from ccx.model.snapshot import to_msgpack as pack
+    from ccx.sidecar.server import OptimizerSidecar
+
+    sidecar = OptimizerSidecar()
+    base = small_deterministic()
+    n = 16
+    # same pad bucket, different metrics per session (scaled loads)
+    models = [
+        drifted(base, scale=1.0 + 0.05 * i, frac=0.5, seed=100 + i)
+        for i in range(n)
+    ]
+    for i, m in enumerate(models):
+        sidecar.put_snapshot(msgpack.packb({
+            "session": f"wf-{i}", "generation": 1, "packed": pack(m),
+        }))
+        res = _propose(sidecar, {
+            "session": f"wf-{i}", "goals": SIDE_GOALS,
+            "options": SIDE_OPTS,
+        })
+        assert res["verified"]
+    # one warm propose prewarms the warm program set for the bucket
+    res = _propose(sidecar, {
+        "session": "wf-0", "goals": SIDE_GOALS, "options": SIDE_OPTS,
+        "warm_start": True, "base_generation": 1,
+    })
+    assert res["incremental"]["warmStart"] is True
+
+    before = compilestats.snapshot()
+    errs: list = []
+    outs: list = []
+
+    def warm(i):
+        try:
+            r = _propose(sidecar, {
+                "session": f"wf-{i}", "goals": SIDE_GOALS,
+                "options": SIDE_OPTS, "warm_start": True,
+                "base_generation": 1,
+                "cluster_id": f"wf-{i}",
+            })
+            outs.append(r)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ths = [threading.Thread(target=warm, args=(i,)) for i in range(n)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert not errs, errs
+    assert len(outs) == n
+    assert all(r["verified"] for r in outs)
+    assert all(
+        (r.get("incremental") or {}).get("warmStart") for r in outs
+    )
+    delta = compilestats.delta(before, compilestats.snapshot())
+    assert delta["backend_compiles"] == 0, (
+        f"16 shape-bucketed concurrent WARM sessions paid "
+        f"{delta['backend_compiles']} fresh compiles — a per-session "
+        f"static leaked into a warm program's jit key: {delta}"
+    )

@@ -587,6 +587,68 @@ def test_registry_put_racing_model_rebuild_is_generation_consistent():
             assert cached is None or cached[0] == 2
 
 
+def test_ledger_packing_eviction_racing_graft_stays_consistent():
+    """The round-16 concurrency pin extended to the UNIFIED allocator
+    (ISSUE 14): while a metric-delta graft lands on session "s", another
+    session's admission packs the shared ledger and may evict "s" via the
+    devmem callback. Whatever interleaving, the registry afterwards
+    serves generation 2's metrics for "s" — a consistent grafted model or
+    a clean rebuild, never a torn/stale one, and the ledger's accounting
+    matches what is actually resident."""
+    import threading
+
+    from ccx.sidecar.server import SnapshotRegistry, model_device_bytes
+
+    m, arrays = _session_arrays(79)
+    size = model_device_bytes(
+        __import__("ccx.model.snapshot", fromlist=["arrays_to_model"])
+        .arrays_to_model(arrays)
+    )
+    for trial in range(6):
+        # budget fits ~1.5 models: admitting "t" must pack "s" out
+        # through the ledger's evictor callback, concurrently with the
+        # graft install's own admit
+        reg = SnapshotRegistry(hbm_budget_bytes=int(size * 1.5))
+        reg.put("s", 1, arrays)
+        assert reg.model("s") is not None
+        reg.put("t", 1, arrays)
+        new = dict(arrays)
+        new["leader_load"] = (
+            np.asarray(arrays["leader_load"], np.float32) * (2.0 + trial)
+        )
+        barrier = threading.Barrier(2)
+
+        def grafting():
+            barrier.wait()
+            reg.put("s", 2, new, changed={"leader_load"})
+
+        def admitting_other():
+            barrier.wait()
+            reg.model("t")  # ledger packing may evict "s"
+
+        ts = [threading.Thread(target=grafting),
+              threading.Thread(target=admitting_other)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        out = reg.model("s")
+        dense = np.asarray(new["leader_load"], np.float32).reshape(4, -1)
+        np.testing.assert_allclose(
+            np.asarray(out.leader_load)[:, : dense.shape[1]], dense,
+            rtol=1e-6,
+        )
+        # ledger/registry coherence: every session with a ledger entry is
+        # actually device-resident, and vice versa
+        with reg._lock:
+            resident = set(reg._models)
+        for s in ("s", "t"):
+            entry = reg._devmem.entry("snapshot", reg._ledger_key(s))
+            assert (entry is not None) == (s in resident), (
+                trial, s, resident, entry,
+            )
+
+
 def test_streamed_result_checksum_catches_payload_corruption():
     """Byte flips INSIDE a segment's payload keep the segment count AND
     the joined length intact — only the round-16 crc32 on the terminal

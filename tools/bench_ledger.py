@@ -34,19 +34,24 @@ This tool is the ledger and the tripwire:
   (config, backend, effort) round fails, as does an unverified curve.
   Rounds 1-5 carry the old driver dryrun-probe wrapper (no walls) — they
   are listed as legacy, reported but never gated.
-* fleet/steady/wire/chaos: ``FLEET_r*.json`` (concurrent Propose
-  streams), ``STEADY_r*.json`` (warm re-proposals per metrics window),
+* fleet/steady/steady-fleet/wire/chaos: ``FLEET_r*.json`` (concurrent
+  Propose streams), ``STEADY_r*.json`` (warm re-proposals per metrics
+  window), ``STEADYFLEET_r*.json`` (their composition — N warm clusters
+  x drift windows concurrently under the unified device-memory ledger,
+  ``bench.py --steady-fleet``: aggregate windows/sec + per-window p99),
   ``WIRE_r*.json`` (the result-path split: warm sidecar round-trip with
   the optimizer excluded, per-leg medians, cold columnar proposals-down
   leg — ``bench.py --wire``) and ``CHAOS_r*.json`` (fault-injected drift
   windows — ``bench.py --chaos``: recovery walls under one killed seam
   class per window) each get a trend section; ``--check`` fails an
   unverified latest line and a >10% regression of the family's headline
-  (fleet p99, steady p99, wire round-trip p50, chaos recovery p99) vs
-  the best banked comparable round. The chaos gate additionally fails
-  ANY unrecovered window, a stuck scheduler job, or a leaked
-  registry/placement entry in the latest round — robustness is a gate,
-  not a trend.
+  (fleet p99, steady p99, steady-fleet windows/sec AND p99, wire
+  round-trip p50, chaos recovery p99) vs the best banked comparable
+  round. The steady-fleet gate additionally fails a unified-budget
+  breach (a ledger sample with snapshots + warm bases over budget) and
+  the chaos gate fails ANY unrecovered window, a stuck scheduler job,
+  or a leaked registry/placement entry in the latest round — robustness
+  is a gate, not a trend.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -583,6 +588,182 @@ def render_steady(srows: list[dict], partials: list[dict]) -> str:
             _fmt(None if r["p99"] is None else r["p99"] * 1e3, 0),
             _fmt(r["speedup"], 0) + "x",
             _fmt(r["diff_rows"], 0),
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
+# ----- steady fleet (STEADYFLEET_r*.json) ------------------------------------
+
+
+def load_steadyfleet(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``STEADYFLEET_r*.json`` under ``root``
+    — the ``bench.py --steady-fleet`` artifact: N warm clusters x drift
+    windows driven concurrently through the sidecar under the unified
+    device-memory ledger. Headlines: aggregate windows/sec and
+    per-window p99; the line also carries the budget-respected proof
+    (ledger sampled after every window)."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "STEADYFLEET_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("steadyfleet") \
+                or line.get("value") is None:
+            partials.append({
+                "file": name, "round": rnd,
+                "why": "no completed steady-fleet line "
+                       f"(rc={wrapper.get('rc')})",
+            })
+            continue
+        warm = line.get("warm") or {}
+        dm = line.get("devmem") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "config": line.get("config", "?"),
+            "n_clusters": line.get("n_clusters"),
+            "n_windows": line.get("n_windows"),
+            "drift": line.get("drift_fraction"),
+            "backend": str(line.get("backend", "?")),
+            "host_cores": line.get("host_cores"),
+            "verified": bool(line.get("verified")),
+            "windows_per_sec": line.get("windows_per_sec"),
+            "single_rate": line.get("single_windows_per_sec"),
+            "vs_single": line.get("vs_baseline"),
+            "p50": warm.get("p50_s"),
+            "p99": warm.get("p99_s", line.get("value")),
+            "all_warm": bool(line.get("all_warm_started")),
+            "budget_respected": bool(dm.get("budget_respected")),
+            "max_evictable_mb": (
+                None if dm.get("max_evictable_bytes") is None
+                else dm["max_evictable_bytes"] / 1e6
+            ),
+            "occupancy": line.get("occupancy"),
+            "effort": line.get("effort") or {},
+        })
+    return rows, partials
+
+
+def steadyfleet_group_key(row: dict) -> str:
+    """Steady-fleet rows compare at identical (config, n_clusters,
+    backend, host_cores, effort) — aggregate throughput under
+    concurrency depends on the host's core count as much as the code
+    (the fleet family's contract)."""
+    return json.dumps(
+        [row["config"], row["n_clusters"], row["backend"],
+         row["host_cores"], row["effort"]],
+        sort_keys=True,
+    )
+
+
+def check_steadyfleet(sfrows: list[dict]) -> list[str]:
+    """The steady-fleet gate: in the LATEST banked round, an unverified
+    line fails (a window failed verification or cold-started, a fresh
+    compile in the measured loop, or a ledger sample over budget — the
+    unified-accounting proof is part of verification), a budget
+    violation fails on its own line, and a >10% regression of EITHER
+    headline (aggregate windows/sec down, or per-window p99 up) vs the
+    best banked comparable round fails."""
+    failures: list[str] = []
+    if not sfrows:
+        return failures
+    latest_round = max(r["round"] for r in sfrows)
+    for r in (r for r in sfrows if r["round"] == latest_round):
+        tag = (
+            f"steady-fleet round {r['round']} "
+            f"{r['config']}x{r['n_clusters']}"
+        )
+        if not r["verified"]:
+            failures.append(
+                f"{tag}: UNVERIFIED steady-fleet line banked (window "
+                "verification failure, cold-start fallback, fresh "
+                "compiles in the measured loop, or ledger budget breach)"
+            )
+        if not r["budget_respected"]:
+            failures.append(
+                f"{tag}: unified device-memory budget EXCEEDED in a "
+                "ledger sample (snapshots + warm bases over "
+                "budgetBytes)"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in sfrows:
+        groups.setdefault(steadyfleet_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"]
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best_rate = max(
+            (p["windows_per_sec"] for p in prior
+             if p["windows_per_sec"] is not None),
+            default=None,
+        )
+        if r["windows_per_sec"] is not None and best_rate:
+            limit = best_rate * (1 - WALL_REGRESSION)
+            if r["windows_per_sec"] < limit:
+                failures.append(
+                    f"steady-fleet round {r['round']} {r['config']}x"
+                    f"{r['n_clusters']}: aggregate {r['windows_per_sec']:.2f}"
+                    f" windows/s regressed >{WALL_REGRESSION:.0%} vs best "
+                    f"banked round ({best_rate:.2f}, limit {limit:.2f})"
+                )
+        best_p99 = min(
+            (p["p99"] for p in prior if p["p99"] is not None),
+            default=None,
+        )
+        if r["p99"] is not None and best_p99:
+            limit = best_p99 * (1 + WALL_REGRESSION)
+            if r["p99"] > limit:
+                failures.append(
+                    f"steady-fleet round {r['round']} {r['config']}x"
+                    f"{r['n_clusters']}: per-window p99 "
+                    f"{r['p99'] * 1e3:.0f}ms regressed "
+                    f">{WALL_REGRESSION:.0%} vs best banked round "
+                    f"({best_p99 * 1e3:.0f}ms, limit {limit * 1e3:.0f}ms)"
+                )
+    return failures
+
+
+def render_steadyfleet(sfrows: list[dict], partials: list[dict]) -> str:
+    """The steady-fleet section of the trend table."""
+    if not sfrows and not partials:
+        return ""
+    out = ["", "steady-state fleet (STEADYFLEET_r*.json):"]
+    headers = ["round", "config", "fleet", "backend", "win/s", "1x win/s",
+               "ratio", "p50 ms", "p99 ms", "ledger MB", "budget", "ok"]
+    body = []
+    for r in sorted(sfrows, key=lambda r: r["round"]):
+        body.append([
+            _fmt(r["round"], 0), r["config"],
+            f"{r['n_clusters']}x{r['n_windows']}",
+            f"{r['backend']}/{r['host_cores']}c",
+            _fmt(r["windows_per_sec"], 2), _fmt(r["single_rate"], 2),
+            _fmt(r["vs_single"], 2),
+            _fmt(None if r["p50"] is None else r["p50"] * 1e3, 0),
+            _fmt(None if r["p99"] is None else r["p99"] * 1e3, 0),
+            _fmt(r["max_evictable_mb"], 0),
+            "ok" if r["budget_respected"] else "OVER",
             "yes" if r["verified"] else "NO",
         ])
     if body:
@@ -1178,6 +1359,7 @@ def main(argv=None) -> int:
     mrows, mlegacy = load_multichip(root)
     frows, fpartials = load_fleet(root)
     srows, spartials = load_steady(root)
+    sfrows, sfpartials = load_steadyfleet(root)
     wrows, wpartials = load_wire(root)
     crows, cpartials = load_chaos(root)
     if args.json:
@@ -1186,6 +1368,7 @@ def main(argv=None) -> int:
             "multichip": mrows, "multichipLegacy": mlegacy,
             "fleet": frows, "fleetPartials": fpartials,
             "steady": srows, "steadyPartials": spartials,
+            "steadyfleet": sfrows, "steadyfleetPartials": sfpartials,
             "wire": wrows, "wirePartials": wpartials,
             "chaos": crows, "chaosPartials": cpartials,
         }, indent=1))
@@ -1197,6 +1380,7 @@ def main(argv=None) -> int:
         failures = (
             check(rows, partials) + check_multichip(mrows)
             + check_fleet(frows) + check_steady(srows)
+            + check_steadyfleet(sfrows)
             + check_wire(wrows) + check_chaos(crows)
         )
         for f in failures:
@@ -1211,18 +1395,20 @@ def main(argv=None) -> int:
         print(f"bench ledger green: {n} banked line(s), "
               f"{len(partials)} partial round(s), {len(mrows)} scaling "
               f"curve(s), {len(frows)} fleet line(s), {len(srows)} "
-              f"steady line(s), {len(wrows)} wire line(s), {len(crows)} "
+              f"steady line(s), {len(sfrows)} steady-fleet line(s), "
+              f"{len(wrows)} wire line(s), {len(crows)} "
               f"chaos line(s), no regression vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
     mc = render_multichip(mrows, mlegacy)
     fl = render_fleet(frows, fpartials)
     st = render_steady(srows, spartials)
+    sf = render_steadyfleet(sfrows, sfpartials)
     wi = render_wire(wrows, wpartials)
     ch = render_chaos(crows, cpartials)
     print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
-          + (("\n" + st) if st else "") + (("\n" + wi) if wi else "")
-          + (("\n" + ch) if ch else ""))
+          + (("\n" + st) if st else "") + (("\n" + sf) if sf else "")
+          + (("\n" + wi) if wi else "") + (("\n" + ch) if ch else ""))
     return 0
 
 

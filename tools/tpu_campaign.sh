@@ -140,6 +140,19 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # anneal phases ride the same per-chunk heartbeat/tap machinery).
   CCX_BENCH_STEADY=1 timeout -k 60 2400 python bench.py
   echo "steady rc=$?"
+  echo "--- steady-state fleet rung (N warm clusters x drift windows; STEADYFLEET artifact) ---"
+  # the composition of the fleet and steady rungs (ISSUE 14): 16
+  # shape-bucketed warm clusters drive 1%-drift windows CONCURRENTLY
+  # through the sidecar, every device resident (snapshot model + warm
+  # base) byte-priced on the unified device-memory ledger
+  # (ccx.common.devmem) — aggregate windows/sec and per-window p99 are
+  # the gated metrics, the measured loop must pay zero fresh compiles,
+  # and the ledger is sampled per window to prove the fleet never
+  # exceeds the budget. On TPU this is the "millions of users" rung: a
+  # window per cluster per minute at N=1000 is ~17 windows/sec. Flight
+  # recorder + watchdog stay armed (exported above).
+  CCX_BENCH_STEADYFLEET=1 timeout -k 60 2400 python bench.py
+  echo "steady-fleet rc=$?"
   echo "--- chaos rung (fault-injected drift windows; CHAOS artifact) ---"
   # chaos-hardened warm serving (ISSUE 12): the steady drift loop under a
   # seeded fault schedule — every seam class (stream sever/corrupt,
