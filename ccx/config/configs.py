@@ -461,6 +461,25 @@ def analyzer_config_def() -> ConfigDef:
              "only bounds the session count on top. An evicted session "
              "simply cold-starts on its next proposal.",
              at_least(1))
+    d.define("optimizer.scenario.seed", Type.INT, 7, Importance.LOW,
+             "Seed of the adversarial scenario generator "
+             "(ccx.bench.scenarios): the whole family x window corpus — "
+             "cascading broker failures, full-disk evacuation, hot-topic "
+             "skew, broker add/demote/remove waves, partition-count "
+             "changes — is a pure function of (base snapshot, seed, "
+             "windows). Env twin for the bench rung: CCX_SCENARIO_SEED.",
+             at_least(0))
+    d.define("optimizer.scenario.windows", Type.INT, 4, Importance.LOW,
+             "Windows per scenario family (cumulative damage steps). "
+             "Every window of every family keeps the base snapshot's "
+             "padded program-shape buckets by construction, so the "
+             "whole matrix runs zero-compile after one prewarm pass. "
+             "Env twin: CCX_SCENARIO_WINDOWS.", at_least(1))
+    d.define("optimizer.scenario.families", Type.LIST, (), Importance.LOW,
+             "Scenario families to emit (empty = all five: "
+             "broker-failures, disk-evacuation, hot-skew, broker-wave, "
+             "partition-change). Env twin: CCX_SCENARIO_FAMILIES "
+             "(comma-separated).")
     d.define("optimizer.repair.backend", Type.STRING, "device",
              Importance.LOW,
              "hard_repair loop driver: 'device' runs the whole sweep loop "

@@ -139,6 +139,14 @@ class IncrementalOptions:
     #: priority-aware eviction); this cap only bounds the session count
     #: on top.
     max_sessions: int = 32
+    #: leadership-only warm profile (round 18): the facade's demote verb
+    #: — its result may move LEADERSHIP ONLY, so (a) the warm base is
+    #: usable only when its replica placement matches the live
+    #: snapshot's (a base carrying unapplied replica moves would leak
+    #: them into the verb's diff — documented ColdStartRequired
+    #: otherwise) and (b) callers zero the swap engine and arm the
+    #: leadership pass instead.
+    leadership_only: bool = False
 
     @property
     def armed(self) -> bool:
@@ -592,21 +600,57 @@ def _touched_mask(new, old):
     return _TOUCHED_JIT(new, old)
 
 
+#: module-level jitted placement-merge program (ONE compile per shape,
+#: paid at prewarm like the other warm programs)
+_MERGE_JIT = None
+
+
+def _merge_program():
+    global _MERGE_JIT
+    if _MERGE_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        from ccx.common import costmodel
+
+        @costmodel.instrument("warm-merge")
+        @jax.jit
+        def _merge(new_a, new_ls, new_rd, wa, wls, wrd):
+            base_has = (wa >= 0).any(axis=1)
+            return (
+                jnp.where(base_has[:, None], wa, new_a),
+                jnp.where(base_has, wls, new_ls),
+                jnp.where(base_has[:, None], wrd, new_rd),
+            )
+
+        _MERGE_JIT = _merge
+    return _MERGE_JIT
+
+
 def warm_model(m_new, warm: WarmStart):
     """The new snapshot's metric/topology tensors with the previous
-    converged placement grafted on — a few device-array replacements,
-    never a rebuild. None when the padded shapes disagree (topology
-    changed enough that the warm placement is meaningless — callers
-    cold-start)."""
+    converged placement grafted on — array replacements, never a model
+    rebuild. None when the padded shapes disagree (topology changed
+    enough that the warm placement is meaningless — callers cold-start).
+
+    Elasticity merge (round 18, the scenario corpus): rows where the
+    warm base holds NO replicas but the new snapshot does are partitions
+    CREATED since the base was banked (a partition-count change, arxiv
+    2205.09415's production event) — they keep the snapshot's
+    controller placement instead of arriving empty, so an elastic window
+    stays a warm window (the drift scan sees the new partitions' bands
+    as touched and the warm engines re-balance them). One tiny fused
+    device program; for a pure metrics window the merge is the identity
+    on the warm arrays."""
     if tuple(m_new.assignment.shape) != tuple(warm.assignment.shape) or (
         tuple(m_new.leader_slot.shape) != tuple(warm.leader_slot.shape)
     ):
         return None
-    return m_new.replace(
-        assignment=warm.assignment,
-        leader_slot=warm.leader_slot,
-        replica_disk=warm.replica_disk,
+    a, ls, rd = _merge_program()(
+        m_new.assignment, m_new.leader_slot, m_new.replica_disk,
+        warm.assignment, warm.leader_slot, warm.replica_disk,
     )
+    return m_new.replace(assignment=a, leader_slot=ls, replica_disk=rd)
 
 
 # ----- drift scan: touched bands -> targeted hot list ------------------------
@@ -754,6 +798,26 @@ def reoptimize(
                 f"shape mismatch: snapshot {tuple(m.assignment.shape)} vs "
                 f"warm base {warm.shape_key()[0]}"
             )
+        if iopts.leadership_only:
+            # a leadership-only verb (demote) may only inherit a base
+            # whose REPLICA placement matches the live snapshot — a base
+            # carrying unapplied replica moves would leak them into a
+            # diff contractually restricted to leadership transfers.
+            # (After the shape gate above, so a topology change reports
+            # as the shape mismatch it is, not as unapplied moves.)
+            import jax.numpy as jnp
+
+            same = bool(
+                jnp.array_equal(m.assignment, warm.assignment)
+            ) and bool(
+                jnp.array_equal(m.replica_disk, warm.replica_disk)
+            )
+            if not same:
+                raise ColdStartRequired(
+                    "leadership-only verb: warm base replica placement "
+                    "differs from the live snapshot (unapplied moves) — "
+                    "inheriting it would move replicas"
+                )
 
     run_swap = iopts.warm_swap_iters > 0 and allows_inter_broker(goal_names)
     ksw = max(iopts.warm_swap_candidates // 2, 1)

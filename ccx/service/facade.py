@@ -304,15 +304,21 @@ class CruiseControl:
             swap_polish_chunk_iters=self.config[
                 "optimizer.swap.polish.chunk.iters"
             ],
-            # incremental re-optimization (ISSUE 10): the warm pipeline
-            # refines a full placement stack — leadership-/disk-only fast
-            # paths keep from-scratch semantics
+            # incremental re-optimization (ISSUE 10 / round 18): the
+            # full warm pipeline serves the placement verbs; a
+            # leadership-only verb (demote) warm-starts too, but with
+            # the swap engine ZEROED — its stack is not intra-only, so
+            # an armed swap polish would move replicas and break the
+            # leadership-only contract — and the leadership pass as the
+            # warm engine instead. Disk-only keeps from-scratch
+            # semantics (intra-broker moves have no warm engine).
             incremental=self._incremental_options(
-                disabled=leadership_only or disk_only
+                disabled=disk_only, leadership_only=leadership_only
             ),
         )
 
-    def _incremental_options(self, disabled: bool = False):
+    def _incremental_options(self, disabled: bool = False,
+                             leadership_only: bool = False):
         from ccx.search.incremental import IncrementalOptions
 
         return IncrementalOptions(
@@ -320,9 +326,10 @@ class CruiseControl:
                 not disabled
                 and self.config["optimizer.incremental.enabled"]
             ),
-            warm_swap_iters=self.config[
-                "optimizer.incremental.warm.swap.iters"
-            ],
+            warm_swap_iters=(
+                0 if leadership_only
+                else self.config["optimizer.incremental.warm.swap.iters"]
+            ),
             warm_swap_patience=self.config[
                 "optimizer.incremental.warm.swap.patience"
             ],
@@ -337,10 +344,20 @@ class CruiseControl:
             warm_moves_per_step=self.config["optimizer.incremental.warm.moves"],
             plateau_window=self.config["optimizer.incremental.plateau.window"],
             warm_t0=self.config["optimizer.incremental.warm.t0"],
-            warm_leader_iters=self.config[
-                "optimizer.incremental.warm.leader.iters"
-            ],
+            # the leadership-only warm engine: a demote's drift is pure
+            # leadership, so the greedy leader pass (never a replica
+            # move by construction) does the work the zeroed swap
+            # engine would otherwise
+            warm_leader_iters=(
+                max(
+                    self.config["optimizer.incremental.warm.leader.iters"],
+                    8,
+                )
+                if leadership_only
+                else self.config["optimizer.incremental.warm.leader.iters"]
+            ),
             max_sessions=self.config["optimizer.incremental.max.sessions"],
+            leadership_only=leadership_only,
         )
 
     def _cluster_lock(self, cluster_id: str | None = None) -> threading.Lock:
@@ -396,6 +413,28 @@ class CruiseControl:
             res = self._run_optimizer_timed(
                 model, goal_names, opts, progress, backend, warm_start=warm
             )
+            if (
+                getattr(opts, "incremental", None) is not None
+                and opts.incremental.armed
+                and backend != "greedy"
+                and warm is None
+                and res.incremental is None
+            ):
+                # documented cold start (the sidecar Propose contract,
+                # now mirrored by every verb): warm was armed but no
+                # base fit — say so on the result instead of silently
+                # looking like a from-scratch run
+                import dataclasses as _dc
+
+                res = _dc.replace(
+                    res,
+                    incremental={
+                        "warmStart": False, "coldStart": True,
+                        "reason": (
+                            f"no warm placement banked for cluster {cid!r}"
+                        ),
+                    },
+                )
             if (
                 getattr(opts, "incremental", None) is not None
                 and opts.incremental.armed
@@ -598,11 +637,16 @@ class CruiseControl:
             ModelBuildOptions(brokers_to_demote=tuple(broker_ids)),
             progress=progress,
         )
+        # urgent=self_healing (round 18 fix): a detector-triggered demote
+        # (slow-broker self-healing) must preempt queued dryruns like the
+        # other anomaly verbs — it previously dropped the flag and ran at
+        # normal priority
         res = self._run_optimizer(
             model,
             ("StructuralFeasibility", "PreferredLeaderElectionGoal"),
             self._optimize_options(leadership_only=True),
             progress, verb="demote-brokers",
+            urgent=self_healing,
         )
         return self._finish(res, metadata, dryrun, reason, uuid, progress)
 

@@ -872,3 +872,177 @@ def test_chaos_total_failure_is_gated_not_partial(tmp_path):
     assert partials == [] and len(rows) == 1
     failures = bench_ledger.check_chaos(rows)
     assert any("did NOT recover" in f for f in failures)
+
+
+# ----- scenario corpus (SCENARIO_r*.json — bench.py --scenario) --------------
+
+
+def _scenario_family(p50=0.4, p99=0.45, verified=True, warm=True,
+                     env_ok=True, verb="fix_offline_replicas", windows=4):
+    return {
+        "verb": verb, "windows": windows, "p50_s": p50, "p99_s": p99,
+        "walls": [p50] * (windows - 1) + [p99],
+        "all_verified": verified, "all_warm": warm, "envelope_ok": env_ok,
+        "window_detail": [],
+    }
+
+
+def _scenario_line(verified=True, zero_compiles=True,
+                   warm_recovered=("hot-skew",), families=None, **extra):
+    families = families if families is not None else {
+        "broker-failures": _scenario_family(),
+        "hot-skew": _scenario_family(p50=0.06, p99=0.08, verb="rebalance"),
+        "partition-change": _scenario_family(p50=0.06, p99=0.07, verb=None),
+    }
+    return {
+        "metric": "B3 scenario-corpus recovery: adversarial structural/"
+                  "elasticity windows through the sidecar warm path "
+                  "(3 families x 4 windows, p99 recovery wall)",
+        "value": 0.45, "unit": "s", "vs_baseline": 60.0, "scenario": True,
+        "config": "B3", "n_windows": 4, "seed": 7, "backend": "cpu",
+        "host_cores": 2, "verified": verified, "cold_s": 25.0,
+        "clean": {"p50_s": 0.05, "walls": [0.05, 0.05, 0.06]},
+        "recovery": {"p50_s": 0.2, "p99_s": 0.45, "walls": [0.2, 0.45]},
+        "warm_recovered_families": list(warm_recovered),
+        "warm_limit_s": 0.1,
+        "all_windows_verified": verified, "all_windows_warm": True,
+        "all_envelopes_ok": True,
+        "zero_measured_loop_compiles": zero_compiles,
+        "compile_cache": {"measured": {"backend_compiles": 0}},
+        "shape_key": [2048, 32, 3, 1, 32, 128, 4],
+        "families": families,
+        "clean_goals_after": {"ReplicaDistributionGoal": 10.0},
+        "effort": {"warm_swap_iters": 8, "windows": 4, "seed": 7,
+                   "cold": {"chains": 16, "steps": 250},
+                   "families": list(families)},
+        **extra,
+    }
+
+
+def _bank_scenario(tmp_path, n, line):
+    (tmp_path / f"SCENARIO_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_scenario_rows_parse_per_family(tmp_path):
+    _bank_scenario(tmp_path, 1, _scenario_line())
+    rows, partials = bench_ledger.load_scenario(str(tmp_path))
+    assert partials == []
+    assert len(rows) == 3  # one row per family
+    fams = {r["family"] for r in rows}
+    assert fams == {"broker-failures", "hot-skew", "partition-change"}
+    r = next(r for r in rows if r["family"] == "broker-failures")
+    assert r["p99"] == 0.45 and r["verified"] and r["envelope_ok"]
+    assert r["verb"] == "fix_offline_replicas"
+    assert r["warm_recovered"] == ["hot-skew"]
+
+
+def test_scenario_green_round_passes_check(tmp_path):
+    _bank_scenario(tmp_path, 1, _scenario_line())
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    assert bench_ledger.check_scenario(rows) == []
+
+
+def test_scenario_unverified_and_cold_fallback_fail(tmp_path):
+    fams = {
+        "broker-failures": _scenario_family(verified=False, warm=False),
+        "hot-skew": _scenario_family(p50=0.06, verb="rebalance"),
+    }
+    _bank_scenario(tmp_path, 1, _scenario_line(
+        verified=False, families=fams))
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    failures = bench_ledger.check_scenario(rows)
+    assert any("failed verification" in f for f in failures)
+    assert any("cold start" in f for f in failures)
+    assert any("UNVERIFIED" in f for f in failures)
+
+
+def test_scenario_envelope_miss_fails(tmp_path):
+    fams = {"hot-skew": _scenario_family(verb="rebalance", env_ok=False)}
+    _bank_scenario(tmp_path, 1, _scenario_line(
+        verified=False, families=fams))
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    failures = bench_ledger.check_scenario(rows)
+    assert any("envelope" in f for f in failures)
+
+
+def test_scenario_fresh_compiles_and_no_warm_family_fail(tmp_path):
+    _bank_scenario(tmp_path, 1, _scenario_line(
+        verified=False, zero_compiles=False, warm_recovered=()))
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    failures = bench_ledger.check_scenario(rows)
+    assert any("fresh compiles" in f for f in failures)
+    assert any("NO anomaly-verb family" in f for f in failures)
+
+
+def test_scenario_p99_regression_gated_per_family(tmp_path):
+    _bank_scenario(tmp_path, 1, _scenario_line())
+    fams = {
+        "broker-failures": _scenario_family(p99=0.45 * 1.15),
+        "hot-skew": _scenario_family(p50=0.06, p99=0.08, verb="rebalance"),
+        "partition-change": _scenario_family(p50=0.06, p99=0.07, verb=None),
+    }
+    _bank_scenario(tmp_path, 2, _scenario_line(families=fams))
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    failures = bench_ledger.check_scenario(rows)
+    assert any(
+        "broker-failures" in f and "regressed" in f for f in failures
+    )
+    # the un-regressed families stay green
+    assert not any("hot-skew" in f for f in failures)
+
+
+def test_scenario_regression_within_limit_passes(tmp_path):
+    _bank_scenario(tmp_path, 1, _scenario_line())
+    fams = {
+        "broker-failures": _scenario_family(p99=0.45 * 1.05),
+        "hot-skew": _scenario_family(p50=0.06, p99=0.08, verb="rebalance"),
+        "partition-change": _scenario_family(p50=0.06, p99=0.07, verb=None),
+    }
+    _bank_scenario(tmp_path, 2, _scenario_line(families=fams))
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    assert bench_ledger.check_scenario(rows) == []
+
+
+def test_scenario_wedged_round_is_partial(tmp_path):
+    (tmp_path / "SCENARIO_r01.json").write_text(
+        json.dumps({"n": 1, "rc": 124, "parsed": None})
+    )
+    rows, partials = bench_ledger.load_scenario(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+
+
+def test_scenario_render_lists_families(tmp_path):
+    _bank_scenario(tmp_path, 1, _scenario_line())
+    rows, partials = bench_ledger.load_scenario(str(tmp_path))
+    table = bench_ledger.render_scenario(rows, partials)
+    assert "broker-failures" in table and "hot-skew" in table
+    assert "SCENARIO_r*.json" in table
+
+
+def test_scenario_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_SCENARIO=1" in sh
+
+
+def test_scenario_verbless_subset_skips_warm_gate(tmp_path):
+    """A family subset with no anomaly-verb family cannot satisfy the
+    warm-recovery gate by construction — the line marks the gate
+    inapplicable and --check does not fail it (everything else still
+    gates)."""
+    fams = {"partition-change": _scenario_family(p50=0.06, verb=None)}
+    _bank_scenario(tmp_path, 1, _scenario_line(
+        families=fams, warm_recovered=(),
+        warm_gate_applicable=False,
+    ))
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    assert bench_ledger.check_scenario(rows) == []
+    # pre-fix lines (no key) keep the gate
+    _bank_scenario(tmp_path, 1, _scenario_line(
+        verified=False, families=fams, warm_recovered=()))
+    rows, _ = bench_ledger.load_scenario(str(tmp_path))
+    assert any(
+        "NO anomaly-verb family" in f
+        for f in bench_ledger.check_scenario(rows)
+    )

@@ -821,3 +821,66 @@ def test_sixteen_warm_sessions_concurrent_zero_fresh_compiles():
         f"{delta['backend_compiles']} fresh compiles — a per-session "
         f"static leaked into a warm program's jit key: {delta}"
     )
+
+
+def test_warm_model_merges_new_partition_rows_from_snapshot():
+    """Elasticity merge (round 18, the scenario corpus): rows where the
+    warm base holds NO replicas but the new snapshot does are partitions
+    created since the base was banked (a partition-count change) — they
+    keep the snapshot's controller placement while every pre-existing
+    row keeps the converged warm placement. A pure metrics window is the
+    identity on the warm arrays."""
+    from ccx.model.snapshot import arrays_to_model, model_to_arrays
+
+    spec = RandomClusterSpec(
+        n_brokers=6, n_racks=3, n_topics=4, n_partitions=40, seed=9
+    )
+    m = random_cluster(spec)
+    warm = incr.WarmStart(
+        session="merge", generation=1, assignment=m.assignment,
+        leader_slot=m.leader_slot, replica_disk=m.replica_disk,
+    )
+    # identity on a pure metrics window
+    m_metrics = m.replace(leader_load=m.leader_load * 1.25)
+    wm = incr.warm_model(m_metrics, warm)
+    np.testing.assert_array_equal(
+        np.asarray(wm.assignment), np.asarray(m.assignment)
+    )
+    # partition growth inside the pad bucket: new rows keep the
+    # snapshot's controller placement, old rows the warm placement
+    arrays = model_to_arrays(m)
+    P0 = np.asarray(arrays["assignment"]).shape[0]
+    n_new = 4
+    new_rows = np.full((n_new, m.R), -1, np.int32)
+    new_rows[:, 0] = np.arange(n_new) % spec.n_brokers
+    new_rows[:, 1] = (np.arange(n_new) + 1) % spec.n_brokers
+    arrays["assignment"] = np.concatenate(
+        [np.asarray(arrays["assignment"]), new_rows]
+    )
+    arrays["leader_slot"] = np.concatenate(
+        [np.asarray(arrays["leader_slot"]), np.zeros(n_new, np.int32)]
+    )
+    arrays["replica_disk"] = np.concatenate(
+        [np.asarray(arrays["replica_disk"]),
+         np.where(new_rows >= 0, 0, -1).astype(np.int32)]
+    )
+    arrays["partition_topic"] = np.concatenate(
+        [np.asarray(arrays["partition_topic"]),
+         np.zeros(n_new, np.int32)]
+    )
+    arrays["partition_immovable"] = np.concatenate(
+        [np.asarray(arrays["partition_immovable"]), np.zeros(n_new, bool)]
+    )
+    for f in ("leader_load", "follower_load"):
+        a = np.asarray(arrays[f], np.float32)
+        arrays[f] = np.concatenate([a, a[:, :n_new]], axis=1)
+    m_grown = arrays_to_model(arrays)
+    assert m_grown.P == m.P  # same pad bucket — the warm-able case
+    wm = incr.warm_model(m_grown, warm)
+    got = np.asarray(wm.assignment)
+    np.testing.assert_array_equal(got[:P0], np.asarray(m.assignment)[:P0])
+    np.testing.assert_array_equal(got[P0:P0 + n_new], new_rows)
+    assert np.asarray(wm.leader_slot)[P0:P0 + n_new].tolist() == [0] * n_new
+    # a real topology change (different pad bucket) still cold-starts
+    big = random_cluster(dataclasses.replace(spec, n_partitions=200))
+    assert incr.warm_model(big, warm) is None

@@ -1,0 +1,395 @@
+"""Scenario corpus (ISSUE 15): seeded adversarial structural/elasticity
+workloads served through the warm path.
+
+Contracts pinned here:
+
+* **Determinism + shape stability** — the corpus is a pure function of
+  (base, seed, windows), and every window of every family keeps the
+  base's padded program-shape key (the zero-compile-after-prewarm
+  precondition the bench matrix is gated on).
+* **Family semantics** — cascading failures spread across racks; the
+  disk-full family genuinely overflows the victim's DISK capacity; the
+  wave family adds ``broker_new`` brokers / demotes ONE broker at a
+  time; partition growth places new partitions controller-style
+  (rack-distinct replica sets on alive brokers) inside the topic's pow2
+  member bucket.
+* **Envelope semantics** — ``check_envelope`` passes clean==clean and
+  fails an inflated tier with a readable message.
+* **Tier-1 envelope run per family** — every family's windows, served
+  through ``optimize(warm_start=...)`` at a small scale, come back
+  VERIFIED, WARM and inside the family's pinned envelope.
+* **Chaos composition (slow)** — a structural scenario window with a
+  fault seam armed in the same window: the two robustness layers stack
+  (the injected bank kill degrades exactly as documented while the
+  structural damage still heals warm).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from ccx.bench import scenarios as sc
+from ccx.goals.base import GoalConfig
+from ccx.model.fixtures import RandomClusterSpec, random_cluster
+from ccx.model.snapshot import arrays_to_model, model_to_arrays
+from ccx.optimizer import OptimizeOptions, optimize
+from ccx.search import incremental as incr
+from ccx.search.annealer import AnnealOptions
+from ccx.search.greedy import GreedyOptions
+from ccx.search.incremental import IncrementalOptions
+
+CFG = GoalConfig()
+GOALS = (
+    "StructuralFeasibility", "ReplicaDistributionGoal", "RackAwareGoal",
+    "DiskCapacityGoal",
+)
+
+
+def base_spec() -> RandomClusterSpec:
+    # 10 brokers pad to 16 (wave headroom), 200 partitions pad to 256
+    # (growth headroom) — every family has room inside its buckets
+    return RandomClusterSpec(
+        n_brokers=10, n_racks=3, n_topics=6, n_partitions=200, seed=11
+    )
+
+
+def small_opts() -> OptimizeOptions:
+    return OptimizeOptions(
+        anneal=AnnealOptions(n_chains=2, n_steps=24, chunk_steps=12),
+        polish=GreedyOptions(n_candidates=8, max_iters=6, chunk_iters=3),
+        topic_rebalance_rounds=0, swap_polish_iters=4,
+        swap_polish_post_iters=0, run_cold_greedy=False,
+        incremental=IncrementalOptions(
+            enabled=True, warm_swap_iters=4, warm_swap_candidates=8,
+            warm_steps=16, warm_chunk_steps=4,
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def converged_base():
+    """(applied arrays, applied model, clean goals_after) — one cold
+    solve shared by every envelope test in the module."""
+    m = random_cluster(base_spec())
+    res = optimize(m, CFG, GOALS, small_opts())
+    assert res.verification.ok
+    applied_model = m.replace(
+        assignment=res.model.assignment,
+        leader_slot=res.model.leader_slot,
+        replica_disk=res.model.replica_disk,
+    )
+    clean = sc.goals_after(
+        res.to_json(include_stats=False).get("goalSummary")
+    )
+    return model_to_arrays(applied_model), applied_model, clean
+
+
+# ----- generator -------------------------------------------------------------
+
+
+def test_generate_is_deterministic(converged_base):
+    applied, _, _ = converged_base
+    for fam in sc.FAMILIES:
+        a = sc.generate(fam, applied, sc.ScenarioOptions(windows=3))
+        b = sc.generate(fam, applied, sc.ScenarioOptions(windows=3))
+        assert [w.label for w in a] == [w.label for w in b]
+        for wa, wb in zip(a, b):
+            for k in wa.arrays:
+                va, vb = wa.arrays[k], wb.arrays[k]
+                if isinstance(va, np.ndarray):
+                    assert np.array_equal(va, vb), (fam, k)
+                else:
+                    assert va == vb
+
+
+def test_every_family_window_keeps_the_program_shape_key(converged_base):
+    applied, _, _ = converged_base
+    key0 = sc.shape_key(applied)
+    for fam in sc.FAMILIES:
+        for w in sc.generate(fam, applied, sc.ScenarioOptions(windows=4)):
+            assert sc.shape_key(w.arrays) == key0, (fam, w.label)
+
+
+def test_unknown_family_and_seed_variation(converged_base):
+    applied, _, _ = converged_base
+    with pytest.raises(KeyError, match="unknown scenario family"):
+        sc.generate("no-such-family", applied)
+    a = sc.generate("broker-failures", applied, sc.ScenarioOptions(seed=7))
+    b = sc.generate("broker-failures", applied, sc.ScenarioOptions(seed=8))
+    assert not all(
+        np.array_equal(x.arrays["broker_alive"], y.arrays["broker_alive"])
+        for x, y in zip(a, b)
+    )
+
+
+def test_broker_failures_cascade_across_racks(converged_base):
+    applied, _, _ = converged_base
+    ws = sc.generate(
+        "broker-failures", applied, sc.ScenarioOptions(windows=3)
+    )
+    alive0 = np.asarray(applied["broker_alive"], bool)
+    racks = np.asarray(applied["broker_rack"])
+    dead_so_far = 0
+    for w in ws:
+        alive = np.asarray(w.arrays["broker_alive"], bool)
+        newly = alive0 & ~alive
+        assert newly.sum() == dead_so_far + 1  # one MORE per window
+        dead_so_far += 1
+        assert w.structural
+    # the first windows spread across distinct racks
+    dead3 = np.nonzero(alive0 & ~np.asarray(ws[2].arrays["broker_alive"],
+                                            bool))[0]
+    assert len({int(racks[b]) for b in dead3}) == 3
+
+
+def test_disk_evacuation_overflows_the_victim(converged_base):
+    applied, _, _ = converged_base
+    (w,) = sc.generate(
+        "disk-evacuation", applied, sc.ScenarioOptions(windows=1)
+    )
+    cap0 = np.asarray(applied["broker_capacity"], np.float32)
+    cap1 = np.asarray(w.arrays["broker_capacity"], np.float32)
+    changed = np.nonzero(cap0[3] != cap1[3])[0]
+    assert len(changed) == 1
+    victim = int(changed[0])
+    usage = sc._broker_disk_usage(w.arrays)[victim]
+    assert cap1[3, victim] < usage  # genuinely over: must evacuate
+    # JBOD invariant preserved: broker DISK cap == sum of its disks
+    dc = np.asarray(w.arrays["disk_capacity"], np.float32)
+    np.testing.assert_allclose(dc[victim].sum(), cap1[3, victim], rtol=1e-5)
+
+
+def test_hot_skew_is_metrics_only_and_ramps(converged_base):
+    applied, _, _ = converged_base
+    ws = sc.generate("hot-skew", applied, sc.ScenarioOptions(windows=3))
+    for w in ws:
+        assert not w.structural
+        for k, v in w.arrays.items():
+            if k in ("leader_load", "follower_load") or not isinstance(
+                v, np.ndarray
+            ):
+                continue
+            assert np.array_equal(v, applied[k]), (w.label, k)
+    # the spike ramps against the BASE loads (x2 then x4)
+    l0 = np.asarray(applied["leader_load"], np.float32)
+    l1 = np.asarray(ws[0].arrays["leader_load"], np.float32)
+    l2 = np.asarray(ws[1].arrays["leader_load"], np.float32)
+    spiked = l1[0] > l0[0] * 1.5
+    assert spiked.any()
+    np.testing.assert_allclose(l2[0][spiked], l0[0][spiked] * 4, rtol=1e-5)
+    # DISK never spikes (a consumer storm moves bytes, not stored data)
+    np.testing.assert_array_equal(l1[3], l0[3])
+
+
+def test_broker_wave_adds_then_demotes_one_then_removes(converged_base):
+    applied, _, _ = converged_base
+    ws = sc.generate("broker-wave", applied, sc.ScenarioOptions(windows=4))
+    B0 = np.asarray(applied["broker_rack"]).shape[0]
+    a1 = ws[0].arrays
+    assert np.asarray(a1["broker_rack"]).shape[0] > B0
+    assert np.asarray(a1["broker_new"], bool)[B0:].all()
+    assert np.asarray(a1["broker_alive"], bool)[B0:].all()
+    # demote window: exactly ONE broker demoted (a whole-replica-set
+    # demote has no legal leader without a replica move)
+    d = np.asarray(ws[2].arrays["broker_excl_leadership"], bool)
+    assert d.sum() == 1
+    # remove window: one broker dead, different from the demoted one
+    dead = (
+        np.asarray(applied["broker_alive"], bool)[:B0]
+        & ~np.asarray(ws[3].arrays["broker_alive"], bool)[:B0]
+    )
+    assert dead.sum() == 1
+    assert not d[:B0][dead].any()
+
+
+def test_partition_growth_is_controller_placed(converged_base):
+    applied, _, _ = converged_base
+    ws = sc.generate(
+        "partition-change", applied, sc.ScenarioOptions(windows=2)
+    )
+    P0 = np.asarray(applied["assignment"]).shape[0]
+    racks = np.asarray(applied["broker_rack"])
+    alive = np.asarray(applied["broker_alive"], bool)
+    for w in ws:
+        a = np.asarray(w.arrays["assignment"])
+        assert a.shape[0] > P0
+        new = a[P0:]
+        n_racks = len(set(racks[alive].tolist()))
+        for row in new:
+            reps = row[row >= 0]
+            assert len(reps) >= 1
+            # distinct brokers, all alive, rack-distinct replica set
+            # (up to the rack count — rf > NR cannot be rack-distinct)
+            assert len(set(reps.tolist())) == len(reps)
+            assert alive[reps].all()
+            assert len({int(racks[b]) for b in reps}) == min(
+                len(reps), n_racks
+            )
+        # loads exist for the new partitions
+        ll = np.asarray(w.arrays["leader_load"], np.float32)
+        assert ll.shape[1] == a.shape[0]
+        assert (ll[:, P0:] > 0).any()
+        P0 = a.shape[0]  # cumulative
+
+
+# ----- envelope --------------------------------------------------------------
+
+
+def test_envelope_clean_passes_inflated_fails():
+    clean = {"ReplicaDistributionGoal": 10.0, "DiskUsageDistributionGoal": 4.0}
+    assert sc.check_envelope("hot-skew", clean, dict(clean)) == []
+    bad = dict(clean, ReplicaDistributionGoal=10.0 * 2.0 + 33.0)
+    fails = sc.check_envelope("hot-skew", clean, bad)
+    assert len(fails) == 1 and "ReplicaDistributionGoal" in fails[0]
+    with pytest.raises(KeyError):
+        sc.check_envelope("no-such-family", clean, clean)
+
+
+def test_scenario_options_from_config():
+    from ccx.config import CruiseControlConfig
+
+    cfg = CruiseControlConfig({
+        "optimizer.scenario.seed": 13,
+        "optimizer.scenario.windows": 6,
+        "optimizer.scenario.families": "hot-skew,broker-failures",
+    })
+    o = sc.ScenarioOptions.from_config(cfg)
+    assert o.seed == 13 and o.windows == 6
+    assert o.families == ("hot-skew", "broker-failures")
+    cfg = CruiseControlConfig({"optimizer.scenario.families": "bogus"})
+    with pytest.raises(ValueError, match="unknown scenario families"):
+        sc.ScenarioOptions.from_config(cfg)
+
+
+# ----- warm-path envelope run per family (tier-1, small scale) ---------------
+
+
+@pytest.mark.parametrize("family", sc.FAMILIES)
+def test_family_recovers_warm_verified_inside_envelope(
+    family, converged_base
+):
+    """The tier-1 envelope rung: every family's windows, served through
+    the warm pipeline at small scale, come back verified, warm-started
+    and inside the family's pinned quality envelope."""
+    applied, applied_model, clean = converged_base
+    session = f"scn-{family}"
+    incr.STORE.drop(session)
+    incr.remember(session, 1, applied_model, CFG)
+    opts = small_opts()
+    gen = 1
+    for w in sc.generate(family, applied, sc.ScenarioOptions(windows=2)):
+        m2 = arrays_to_model(w.arrays)
+        res = optimize(
+            m2, CFG, GOALS, opts, warm_start=incr.STORE.get(session)
+        )
+        assert res.verification.ok, (family, w.label,
+                                     res.verification.failures)
+        assert (res.incremental or {}).get("warmStart") is True, (
+            family, w.label, res.incremental
+        )
+        after = sc.goals_after(
+            res.to_json(include_stats=False).get("goalSummary")
+        )
+        assert sc.check_envelope(family, clean, after) == [], (
+            family, w.label
+        )
+        gen += 1
+        incr.remember(session, gen, res.model, CFG)
+    incr.STORE.drop(session)
+
+
+# ----- chaos composition (slow): structural damage + injected fault ----------
+
+
+@pytest.mark.slow
+def test_scenario_window_with_fault_seam_armed_stacks(converged_base):
+    """The two robustness layers compose: a broker-failure window
+    (structural damage) with the warm-bank seam KILLED in the same
+    window still heals warm and verified — the injected bank failure
+    degrades exactly as documented (previous base stays resolvable; the
+    next window still warm-starts from it)."""
+    from ccx.common import faults
+
+    applied, applied_model, _ = converged_base
+    session = "scn-chaos"
+    incr.STORE.drop(session)
+    incr.remember(session, 1, applied_model, CFG)
+    ws = sc.generate(
+        "broker-failures", applied, sc.ScenarioOptions(windows=2)
+    )
+    opts = small_opts()
+    gen0 = incr.STORE.generation(session)
+    faults.FAULTS.arm("placement.bank:raise@1", seed=3)
+    try:
+        m2 = arrays_to_model(ws[0].arrays)
+        res = optimize(
+            m2, CFG, GOALS, opts, warm_start=incr.STORE.get(session)
+        )
+        # the structural damage healed warm and verified DESPITE the
+        # injected fault at the bank seam...
+        assert res.verification.ok
+        assert (res.incremental or {}).get("warmStart") is True
+        # ... and the kill landed where aimed: banking is bank-last, so
+        # the store still holds the PREVIOUS generation-consistent base
+        with pytest.raises(faults.InjectedFault):
+            incr.remember(session, 2, res.model, CFG)
+        assert incr.STORE.generation(session) == gen0
+    finally:
+        faults.FAULTS.disarm()
+    # disarmed: the NEXT (worse) window warm-starts from the old base
+    m3 = arrays_to_model(ws[1].arrays)
+    res = optimize(m3, CFG, GOALS, opts, warm_start=incr.STORE.get(session))
+    assert res.verification.ok
+    assert (res.incremental or {}).get("warmStart") is True
+    incr.STORE.drop(session)
+
+
+def test_broker_wave_extended_windows_always_change_state(converged_base):
+    """Beyond the 4-step plan (or with no add headroom left) the wave
+    must keep progressing through fresh victims — a re-demote/re-remove
+    of the same broker would be an EMPTY delta counted as a recovery
+    window (review pin, round 18)."""
+    applied, _, _ = converged_base
+    ws = sc.generate("broker-wave", applied, sc.ScenarioOptions(windows=8))
+    prev = applied
+    for w in ws:
+        changed = any(
+            isinstance(v, np.ndarray)
+            and (
+                v.shape != np.asarray(prev.get(k)).shape
+                or not np.array_equal(v, prev[k])
+            )
+            for k, v in w.arrays.items()
+        )
+        assert changed, f"{w.label} produced an empty delta"
+        prev = w.arrays
+    # demote victims never repeat, removals never hit demoted brokers
+    demoted = np.asarray(ws[-1].arrays["broker_excl_leadership"], bool)
+    B0 = np.asarray(applied["broker_rack"]).shape[0]
+    dead = (
+        np.asarray(applied["broker_alive"], bool)[:B0]
+        & ~np.asarray(ws[-1].arrays["broker_alive"], bool)[:B0]
+    )
+    assert not (demoted[:B0] & dead).any()
+
+
+def test_shape_key_matches_built_model_padding(converged_base):
+    """Parity pin for the generator's headless shape-key copy: the
+    buckets `scenarios.shape_key` predicts must be the ones
+    `build_model` + `max_partitions_per_topic` actually produce — if
+    the model's padding rules ever move, this is the tripwire (the
+    generator's own self-consistency assert cannot see it)."""
+    from ccx.search.state import max_partitions_per_topic
+
+    applied, _, _ = converged_base
+    for fam in sc.FAMILIES:
+        w = sc.generate(fam, applied, sc.ScenarioOptions(windows=2))[-1]
+        m = arrays_to_model(w.arrays)
+        key = sc.shape_key(w.arrays)
+        assert key == (
+            m.P, m.B, m.R, m.D, m.num_topics,
+            max_partitions_per_topic(m), m.num_racks,
+        ), (fam, key)

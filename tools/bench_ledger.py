@@ -52,6 +52,15 @@ This tool is the ledger and the tripwire:
   the chaos gate fails ANY unrecovered window, a stuck scheduler job,
   or a leaked registry/placement entry in the latest round — robustness
   is a gate, not a trend.
+* scenario: ``SCENARIO_r*.json`` (the adversarial scenario corpus —
+  ``bench.py --scenario``: per-FAMILY recovery walls of structural/
+  elasticity windows served through the warm path) gets one trend row
+  per (round, family); ``--check`` fails an unverified line, any family
+  with an unverified or cold-fallback window, a pinned-envelope miss,
+  fresh compiles in the measured matrix, an empty warm-recovered-
+  families set (the self-healing-at-warm-latency headline), and a
+  recovery-p99 regression >10% per (config, family, windows, seed,
+  backend, host_cores, effort) group.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -1082,6 +1091,191 @@ def render_chaos(crows: list[dict], partials: list[dict]) -> str:
     return "\n".join(out)
 
 
+# ----- scenario corpus (SCENARIO_r*.json) ------------------------------------
+
+
+def load_scenario(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``SCENARIO_r*.json`` under ``root`` —
+    the ``bench.py --scenario`` artifact: per-family recovery walls of
+    the adversarial structural/elasticity matrix served through the warm
+    path, next to the clean steady baseline and the pinned-envelope
+    verdicts banked in the same round. One row per (round, family) so
+    the regression gate prices each family's recovery independently."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "SCENARIO_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("scenario") \
+                or not line.get("families"):
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed scenario line (rc={wrapper.get('rc')})",
+            })
+            continue
+        clean = line.get("clean") or {}
+        shared = {
+            "source": name,
+            "round": rnd,
+            "config": line.get("config", "?"),
+            "n_windows": line.get("n_windows"),
+            "seed": line.get("seed"),
+            "backend": str(line.get("backend", "?")),
+            "host_cores": line.get("host_cores"),
+            "verified": bool(line.get("verified")),
+            "clean_p50": clean.get("p50_s"),
+            "cold": line.get("cold_s"),
+            "zero_compiles": bool(line.get("zero_measured_loop_compiles")),
+            "warm_recovered": line.get("warm_recovered_families") or [],
+            # pre-fix lines lack the key: the gate applied to them
+            "warm_gate_applicable": bool(
+                line.get("warm_gate_applicable", True)
+            ),
+            "effort": line.get("effort") or {},
+        }
+        for fam, f in sorted((line.get("families") or {}).items()):
+            rows.append({
+                **shared,
+                "family": fam,
+                "verb": f.get("verb"),
+                "windows": f.get("windows"),
+                "p50": f.get("p50_s"),
+                "p99": f.get("p99_s"),
+                "all_verified": bool(f.get("all_verified")),
+                "all_warm": bool(f.get("all_warm")),
+                "envelope_ok": bool(f.get("envelope_ok")),
+            })
+    return rows, partials
+
+
+def scenario_group_key(row: dict) -> str:
+    """Scenario rows compare per FAMILY at identical (config, family,
+    n_windows, seed, backend, host_cores, effort) — each family's
+    recovery wall is its own trend line (a broker-failure regression
+    must not hide behind a faster hot-skew)."""
+    return json.dumps(
+        [row["config"], row["family"], row["n_windows"], row["seed"],
+         row["backend"], row["host_cores"], row["effort"]],
+        sort_keys=True,
+    )
+
+
+def check_scenario(scrows: list[dict]) -> list[str]:
+    """The scenario gate (the messy cases are a GATE, not a trend): in
+    the LATEST banked scenario round, an unverified line fails, any
+    family with an unverified / cold-fallback window fails, an envelope
+    miss fails, fresh compiles in the measured matrix fail, an empty
+    warm-recovered-families set fails (the self-healing-at-warm-latency
+    headline), and a recovery-p99 regression >10% vs the best banked
+    comparable round fails PER FAMILY."""
+    failures: list[str] = []
+    if not scrows:
+        return failures
+    latest_round = max(r["round"] for r in scrows)
+    latest = [r for r in scrows if r["round"] == latest_round]
+    for r in latest:
+        tag = f"scenario round {r['round']} {r['config']} {r['family']}"
+        if not r["all_verified"]:
+            failures.append(f"{tag}: window(s) failed verification")
+        if not r["all_warm"]:
+            failures.append(
+                f"{tag}: window(s) fell back to a cold start — the warm "
+                "path did not serve the whole family"
+            )
+        if not r["envelope_ok"]:
+            failures.append(
+                f"{tag}: recovered quality left the pinned envelope"
+            )
+    # per-LINE gates (shared across a line's family rows): once per
+    # banked artifact, not once per family row
+    seen_sources: set[str] = set()
+    for r0 in latest:
+        if r0["source"] in seen_sources:
+            continue
+        seen_sources.add(r0["source"])
+        tag = f"scenario round {r0['round']} {r0['config']}"
+        if not r0["zero_compiles"]:
+            failures.append(
+                f"{tag}: fresh compiles in the measured matrix (the "
+                "shared-shape zero-compile contract broke)"
+            )
+        if not r0["warm_recovered"] and r0["warm_gate_applicable"]:
+            # a verb-less family subset (e.g. partition-change only)
+            # cannot satisfy the gate by construction — the line says so
+            # (warm_gate_applicable false) and is not failed for it
+            failures.append(
+                f"{tag}: NO anomaly-verb family recovered warm within "
+                "2x the clean steady p50 — the self-healing headline "
+                "is unbacked"
+            )
+        if not r0["verified"]:
+            failures.append(f"{tag}: UNVERIFIED scenario line banked")
+    groups: dict[str, list[dict]] = {}
+    for r in scrows:
+        groups.setdefault(scenario_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"]
+            and r["p99"] is not None
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(p["p99"] for p in prior)
+        if r["p99"] is not None and best:
+            limit = best * (1 + WALL_REGRESSION)
+            if r["p99"] > limit:
+                failures.append(
+                    f"scenario round {r['round']} {r['config']} "
+                    f"{r['family']}: recovery p99 {r['p99']:.2f}s "
+                    f"regressed >{WALL_REGRESSION:.0%} vs best banked "
+                    f"round ({best:.2f}s, limit {limit:.2f}s)"
+                )
+    return failures
+
+
+def render_scenario(scrows: list[dict], partials: list[dict]) -> str:
+    """The scenario section of the trend table."""
+    if not scrows and not partials:
+        return ""
+    out = ["", "scenario corpus (SCENARIO_r*.json):"]
+    headers = ["round", "config", "family", "win", "backend", "clean ms",
+               "p50 s", "p99 s", "warm", "env", "ok"]
+    body = []
+    for r in sorted(scrows, key=lambda r: (r["round"], r["family"])):
+        body.append([
+            _fmt(r["round"], 0), r["config"], r["family"],
+            _fmt(r["windows"], 0),
+            f"{r['backend']}/{r['host_cores']}c",
+            _fmt(
+                None if r["clean_p50"] is None else r["clean_p50"] * 1e3, 0
+            ),
+            _fmt(r["p50"], 2), _fmt(r["p99"], 2),
+            "yes" if r["all_warm"] else "NO",
+            "ok" if r["envelope_ok"] else "MISS",
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 # ----- trend table -----------------------------------------------------------
 
 
@@ -1362,6 +1556,7 @@ def main(argv=None) -> int:
     sfrows, sfpartials = load_steadyfleet(root)
     wrows, wpartials = load_wire(root)
     crows, cpartials = load_chaos(root)
+    scrows, scpartials = load_scenario(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
@@ -1371,6 +1566,7 @@ def main(argv=None) -> int:
             "steadyfleet": sfrows, "steadyfleetPartials": sfpartials,
             "wire": wrows, "wirePartials": wpartials,
             "chaos": crows, "chaosPartials": cpartials,
+            "scenario": scrows, "scenarioPartials": scpartials,
         }, indent=1))
         return 0
     if args.roofline:
@@ -1382,6 +1578,7 @@ def main(argv=None) -> int:
             + check_fleet(frows) + check_steady(srows)
             + check_steadyfleet(sfrows)
             + check_wire(wrows) + check_chaos(crows)
+            + check_scenario(scrows)
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
@@ -1397,7 +1594,8 @@ def main(argv=None) -> int:
               f"curve(s), {len(frows)} fleet line(s), {len(srows)} "
               f"steady line(s), {len(sfrows)} steady-fleet line(s), "
               f"{len(wrows)} wire line(s), {len(crows)} "
-              f"chaos line(s), no regression vs the best banked rounds")
+              f"chaos line(s), {len(scrows)} scenario family row(s), "
+              "no regression vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
     mc = render_multichip(mrows, mlegacy)
@@ -1406,9 +1604,11 @@ def main(argv=None) -> int:
     sf = render_steadyfleet(sfrows, sfpartials)
     wi = render_wire(wrows, wpartials)
     ch = render_chaos(crows, cpartials)
+    sn = render_scenario(scrows, scpartials)
     print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
           + (("\n" + st) if st else "") + (("\n" + sf) if sf else "")
-          + (("\n" + wi) if wi else "") + (("\n" + ch) if ch else ""))
+          + (("\n" + wi) if wi else "") + (("\n" + ch) if ch else "")
+          + (("\n" + sn) if sn else ""))
     return 0
 
 

@@ -165,6 +165,19 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # span/heartbeat trail in the same JSONL as the clean rungs.
   CCX_BENCH_CHAOS=1 timeout -k 60 2400 python bench.py
   echo "chaos rc=$?"
+  echo "--- scenario rung (adversarial structural/elasticity matrix; SCENARIO artifact) ---"
+  # the scenario corpus (ISSUE 15): every adversarial family — cascading
+  # broker failures, disk-full evacuation, hot-topic skew, broker
+  # add/demote/remove waves, partition-count changes — as cumulative
+  # delta-snapshot windows through the sidecar's WARM path, gated on
+  # per-window verification, per-family pinned quality envelopes, zero
+  # measured-matrix compiles, and >=1 anomaly-verb family recovering
+  # warm within 2x the clean steady p50. The campaign prices recovery
+  # latency for the messy cases right next to the clean rungs; the
+  # flight recorder stays armed (exported above), so every structural
+  # window's repair/warm-SA phases leave their span trail.
+  CCX_BENCH_SCENARIO=1 timeout -k 60 2400 python bench.py
+  echo "scenario rc=$?"
   echo "--- wire / result-path rung (streamed columnar warm round-trips; WIRE artifact) ---"
   # the result-path split (ISSUE 11): warm end-to-end sidecar round-trip
   # with the optimizer excluded — snapshot-up / diff / assembly /
