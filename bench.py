@@ -2719,6 +2719,190 @@ def run_mesh_bench(name: str) -> None:
     print(_state["final_json"], flush=True)
 
 
+def run_exchange_ab(name: str) -> None:
+    """``--exchange-ab`` / CCX_BENCH_EXCHANGE: seeded A/B of flat SA
+    chains vs the K-rung replica-exchange ladder (ISSUE 16) at EQUAL
+    total chains, steps and chunk budget — the evidence that exchange
+    beats independent restarts when each chunk must buy more search.
+
+    Four seeded anneal() drives on the ``name`` fixture (default B3 —
+    CPU-friendly, the fleet/scenario shape), taps armed:
+
+    1. FLAT baseline (n_temps=1) — cold then warm; the warm run's
+       convergence series fixes the plateau chunk and plateau cost;
+    2. LADDER (n_temps=K, exchange every chunk) — cold then warm at the
+       identical chain count/step budget/chunk size/seed;
+    3. K=1 bit-exactness probe: n_temps=1 with a non-default
+       exchange_interval must return the flat arm's placement
+       bit-for-bit AND reuse its compiled chunk (the ladder code is
+       absent at K=1, not disabled);
+    4. ladder RETUNE at a different step budget — must pay ZERO fresh
+       compiles (K is program shape, budgets/interval stay traced data).
+
+    The JSON line is the EXCHANGE_r*.json artifact (banked directly —
+    the rung is self-banking like no other because its gates are pure
+    A/B facts, not wall numbers) that ``tools/bench_ledger.py`` trends
+    and gates: ``ladder_better`` (the ladder reaches the flat arm's
+    plateau cost in fewer chunks, or ends strictly lex-better),
+    ``k1_bitexact`` and ``fresh_compiles_on_retune == 0`` must all hold.
+    The ladder arm's convergence block rides the line, so
+    ``tools/convergence_report.py`` prints the exchange-acceptance gauge
+    next to the plateau table.
+    """
+    import dataclasses as _dc
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from ccx.common import compilestats
+    from ccx.common.convergence import lex_improved, plateau_chunk
+    from ccx.goals.base import GoalConfig
+    from ccx.goals.stack import DEFAULT_GOAL_ORDER
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.search import telemetry
+    from ccx.search.annealer import AnnealOptions, anneal
+
+    n_temps = int(os.environ.get("CCX_EXCHANGE_TEMPS", "4"))
+    chains = int(os.environ.get("CCX_EXCHANGE_CHAINS", "16"))
+    steps = int(os.environ.get("CCX_EXCHANGE_STEPS", "1200"))
+    chunk = int(os.environ.get("CCX_EXCHANGE_CHUNK", "40"))
+    interval = int(os.environ.get("CCX_EXCHANGE_INTERVAL", "1"))
+    seed = int(os.environ.get("CCX_EXCHANGE_SEED", "17"))
+
+    telemetry.set_enabled(True)
+    m = random_cluster(bench_spec(name))
+    cfg = GoalConfig()
+    flat_opts = AnnealOptions(
+        n_chains=chains, n_steps=steps, moves_per_step=2, seed=seed,
+        chunk_steps=chunk,
+    )
+    ladder_opts = _dc.replace(
+        flat_opts, n_temps=n_temps, exchange_interval=interval
+    )
+
+    def drive(opts, label):
+        enter_phase(f"exchange:{name}:{label}")
+        anneal(m, cfg, DEFAULT_GOAL_ORDER, opts)  # cold (compiles)
+        t0 = _time.monotonic()
+        r = anneal(m, cfg, DEFAULT_GOAL_ORDER, opts)
+        jax.block_until_ready(r.model.assignment)
+        return r, _time.monotonic() - t0
+
+    r_flat, wall_flat = drive(flat_opts, "flat")
+    r_ladder, wall_ladder = drive(ladder_opts, "ladder")
+
+    flat_series = r_flat.convergence["series"]
+    ladder_series = r_ladder.convergence["series"]
+    flat_plateau = plateau_chunk(flat_series)
+    ladder_plateau = plateau_chunk(ladder_series)
+    flat_best = flat_series[flat_plateau]
+    # first chunk where the ladder is at least as good (lex) as the flat
+    # arm's plateau cost; None = never reached it
+    reached = next(
+        (
+            i for i, row in enumerate(ladder_series)
+            if not lex_improved(flat_best, row)
+        ),
+        None,
+    )
+    flat_final = [float(x) for x in np.asarray(r_flat.stack_after.costs)]
+    ladder_final = [
+        float(x) for x in np.asarray(r_ladder.stack_after.costs)
+    ]
+    ladder_better = (
+        reached is not None and reached < flat_plateau
+    ) or lex_improved(ladder_final, flat_final)
+
+    # 3) K=1 bit-exactness: same compiled chunk, same placement
+    enter_phase(f"exchange:{name}:k1")
+    k1_opts = _dc.replace(flat_opts, n_temps=1, exchange_interval=3)
+    r_k1 = anneal(m, cfg, DEFAULT_GOAL_ORDER, k1_opts)
+    k1_bitexact = bool(
+        np.array_equal(
+            np.asarray(r_k1.model.assignment),
+            np.asarray(r_flat.model.assignment),
+        )
+        and np.array_equal(
+            np.asarray(r_k1.model.is_leader),
+            np.asarray(r_flat.model.is_leader),
+        )
+    )
+
+    # 4) ladder retune: a different step budget must reuse the program
+    enter_phase(f"exchange:{name}:retune")
+    cs0 = compilestats.snapshot()
+    anneal(
+        m, cfg, DEFAULT_GOAL_ORDER,
+        _dc.replace(ladder_opts, n_steps=2 * chunk),
+    )
+    fresh = compilestats.delta(cs0, compilestats.snapshot()).get(
+        "backend_compiles", 0
+    )
+
+    exchange = r_ladder.convergence.get("exchange") or {}
+    attempted = sum(exchange.get("attempted") or [])
+    accepted = sum(exchange.get("accepted") or [])
+    out = {
+        "exchange_ab": True,
+        "rung": "exchange-ab",
+        "bench": name,
+        "backend": jax.default_backend(),
+        "chains": chains,
+        "steps": steps,
+        "chunk": chunk,
+        "n_temps": n_temps,
+        "interval": interval,
+        "seed": seed,
+        "value": round(wall_ladder, 3),
+        "flat": {
+            "wall_s": round(wall_flat, 3),
+            "plateau_chunk": flat_plateau,
+            "chunks": len(flat_series),
+            "final": flat_final,
+        },
+        "ladder": {
+            "wall_s": round(wall_ladder, 3),
+            "plateau_chunk": ladder_plateau,
+            "chunks": len(ladder_series),
+            "final": ladder_final,
+            "reached_flat_plateau_chunk": reached,
+            "exchange_attempted": attempted,
+            "exchange_accepted": accepted,
+            "exchange_accept_rate": (
+                round(accepted / attempted, 4) if attempted else None
+            ),
+        },
+        "ladder_better": bool(ladder_better),
+        "k1_bitexact": k1_bitexact,
+        "fresh_compiles_on_retune": int(fresh),
+        "verified": bool(
+            ladder_better and k1_bitexact and int(fresh) == 0
+        ),
+        # the ladder arm's convergence block, in the phase form the
+        # report/advisor tooling reads (exchange gauge + plateau table)
+        "convergence": {"phases": {"anneal": [r_ladder.convergence]}},
+    }
+    line = json.dumps(out)
+    import glob as _glob
+    import re as _re
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(mt.group(1))
+        for p in _glob.glob(os.path.join(repo, "EXCHANGE_r*.json"))
+        if (mt := _re.match(r"EXCHANGE_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    n_round = max(rounds, default=0) + 1
+    path = os.path.join(repo, f"EXCHANGE_r{n_round:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n_round, "parsed": out}, f)
+    log(f"[exchange] banked {path}")
+    _state["done"] = True
+    _state["final_json"] = line
+    print(_state["final_json"], flush=True)
+
+
 def main() -> None:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
@@ -2776,6 +2960,9 @@ def main() -> None:
         "--chaos-iters", type=int,
         default=int(os.environ.get("CCX_BENCH_CHAOS_ITERS", "14")),
     )
+    ap.add_argument("--exchange-ab", action="store_true",
+                    default=os.environ.get("CCX_BENCH_EXCHANGE") not in
+                    (None, "", "0"))
     ap.add_argument("--scenario", action="store_true",
                     default=os.environ.get("CCX_BENCH_SCENARIO") not in
                     (None, "", "0"))
@@ -2798,6 +2985,18 @@ def main() -> None:
     )
     cli, _unknown = ap.parse_known_args()
     samples = max(cli.samples, 1)
+
+    if cli.exchange_ab:
+        # replica-exchange A/B mode (EXCHANGE_r*.json artifact): flat
+        # chains vs the K-rung temperature ladder at equal total
+        # chains/steps/chunks, plus the K=1 bit-exactness and
+        # zero-recompile-on-retune probes. Persistent compile cache like
+        # the ladder.
+        enable_compile_cache()
+        name = os.environ.get("CCX_BENCH", "B3")
+        _state["name"] = name
+        run_exchange_ab(name)
+        return
 
     if cli.scenario:
         # scenario-corpus mode (SCENARIO_r*.json artifact): the

@@ -187,6 +187,39 @@ def phase_table(convergence: dict) -> list[dict]:
     return rows
 
 
+def ladder_summary(seg: dict) -> dict | None:
+    """Replica-exchange ladder roll-up for ONE telemetry segment (ISSUE
+    16): total/accepted exchange pairs, the overall acceptance rate, and
+    the ladder geometry the annealer attached (``nTemps``, ``interval``,
+    ``rungSize``, ``endTemps``). None for flat segments — no ``exchange``
+    series or nothing attempted — so every consumer can print it
+    conditionally without schema checks.
+
+    Exchange-acceptance rate is the classic ladder-health gauge: near 0
+    the rungs are too far apart to communicate (the ladder degenerates to
+    independent restarts), near 1 they are so close the exchange buys no
+    mixing; the 20-40% band is the usual target. The report prints it per
+    phase so a campaign retune can tune ``n_temps`` from evidence."""
+    ex = seg.get("exchange") or {}
+    attempted = ex.get("attempted") or []
+    total_att = sum(int(a) for a in attempted)
+    if total_att <= 0:
+        return None
+    accepted = ex.get("accepted") or []
+    total_acc = sum(int(a) for a in accepted)
+    out = {
+        "attempted": total_att,
+        "accepted": total_acc,
+        "acceptRate": round(total_acc / total_att, 4),
+        "sweeps": sum(1 for a in attempted if int(a) > 0),
+    }
+    ladder = seg.get("ladder") or {}
+    for k in ("nTemps", "interval", "rungSize", "t0", "endTemps"):
+        if k in ladder:
+            out[k] = ladder[k]
+    return out
+
+
 def total_wasted_fraction(convergence: dict) -> float:
     """Whole-run share of chunk budget past plateau, across every phase
     and segment — the single number the ledger's >WASTE_WARN warning

@@ -302,6 +302,27 @@ def analyzer_config_def() -> ConfigDef:
              "constant share. The schedule enters compiled programs as "
              "data — retunes never recompile the SA chunk.",
              between(-1, 1))
+    d.define("optimizer.exchange.n.temps", Type.INT, 1, Importance.LOW,
+             "Temperature rungs of the SA replica-exchange ladder "
+             "(AnnealOptions.n_temps). >1 partitions the chain batch "
+             "into K rungs on a geometric temperature ladder between t1 "
+             "and t0 and swaps chain STATES between neighboring rungs at "
+             "chunk boundaries (Metropolis on the soft-cost scalar, lex "
+             "tie-break; the lex-best chain never leaves the coldest "
+             "rung). A pure permutation of the batch axis: no new "
+             "compiled-program shapes. 1 = flat chains (bit-exact legacy "
+             "path). Requires optimizer.chunk.steps > 0.", at_least(1))
+    d.define("optimizer.exchange.interval", Type.INT, 1, Importance.LOW,
+             "Chunk boundaries between replica-exchange sweeps (1 = "
+             "every chunk). Enters compiled programs as data — retunes "
+             "never recompile the SA chunk.", at_least(1))
+    d.define("optimizer.bf16.scoring", Type.BOOLEAN, False, Importance.LOW,
+             "Opt-in bf16 scoring tier: rank-order-only intermediates "
+             "(band-pressure x usage pool scores feeding the coupled-swap "
+             "Gumbel picks) accumulate in bfloat16; every lex cost "
+             "vector and accept/exchange decision stays f32. A "
+             "throughput knob for the TPU MXU — leave False on CPU "
+             "correctness paths.")
     d.define("optimizer.swap.polish.iters", Type.INT, 150, Importance.LOW,
              "Iteration budget for the usage-coupled swap-polish phase "
              "(count-preserving replica swaps + pressure-coupled "

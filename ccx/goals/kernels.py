@@ -40,6 +40,19 @@ def _n_alive(m: TensorClusterModel) -> jnp.ndarray:
     return jnp.maximum(jnp.sum(_alive(m)), 1).astype(jnp.float32)
 
 
+def scoring_dtype(bf16: bool) -> jnp.dtype:
+    """dtype for RANK-ORDER-ONLY scoring intermediates (ISSUE 16).
+
+    The band-pressure tables and the coupled-swap pool scorer only ever
+    feed an argmax/Gumbel pick — nothing downstream reads their magnitude
+    — so with ``bf16_scoring`` armed they may accumulate in bfloat16 (MXU
+    native) and halve the scoring bandwidth. Every lex cost vector and
+    every accept/exchange decision stays f32: goal kernels, ``lex_accept``
+    and ``exchange_permutation`` must never route through this helper.
+    """
+    return jnp.bfloat16 if bf16 else jnp.float32
+
+
 # --------------------------------------------------------------------------
 # Structural feasibility (implicit in every reference goal's requirements):
 # replicas must not sit on dead brokers / dead disks, leadership must not sit

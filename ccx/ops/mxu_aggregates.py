@@ -63,6 +63,16 @@ TILE_N = 256
 #: already-traced shapes anyway — set the env before the process starts.
 _OPT_IN = os.environ.get("CCX_MXU_AGGREGATES") == "1"
 
+#: bf16 MATMUL OPERANDS (ISSUE 16, same read-once rule): the one-hot
+#: factors are exactly representable in bfloat16 and every accumulator
+#: keeps ``preferred_element_type=f32``, so the integer counts stay exact;
+#: only the float feature sums lose mantissa (rank-order consumers — the
+#: band-pressure tables — tolerate that by design, see
+#: ``ccx.goals.kernels.scoring_dtype``). Doubles MXU throughput on the
+#: feature matmuls; opt-in with the same not-yet-hardware-proven caution
+#: as the kernel itself.
+_BF16 = os.environ.get("CCX_MXU_BF16") == "1"
+
 
 def mxu_aggregates_enabled() -> bool:
     """True when broker_aggregates should take the Pallas path.
@@ -83,7 +93,7 @@ def mxu_aggregates_enabled() -> bool:
 
 
 def _kernel(seg_ref, top_ref, dsk_ref, lead_ref, dw_ref, feat_ref,
-            out_feat, out_tr, out_tl, out_disk, *, B, T, D):
+            out_feat, out_tr, out_tl, out_disk, *, B, T, D, op_dtype):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -95,37 +105,42 @@ def _kernel(seg_ref, top_ref, dsk_ref, lead_ref, dw_ref, feat_ref,
 
     seg = seg_ref[0, :]                                    # int32[TILE]
     # one-hot over brokers: invalid slots carry seg == B and never match
-    # (the drop-bucket trick of the XLA twin, without the extra column)
+    # (the drop-bucket trick of the XLA twin, without the extra column).
+    # ``op_dtype`` (f32 default, bf16 with CCX_MXU_BF16=1) is the matmul
+    # OPERAND dtype only — 0/1 one-hots are exact either way and every
+    # accumulator stays f32 via preferred_element_type.
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, B), 1)
-    oh_b = (seg[:, None] == iota_b).astype(jnp.float32)    # [TILE, B]
+    oh_b = (seg[:, None] == iota_b).astype(op_dtype)       # [TILE, B]
 
     # per-broker feature rows: [F, TILE] @ [TILE, B] on the MXU
     out_feat[:] += jnp.dot(
-        feat_ref[:], oh_b, preferred_element_type=jnp.float32
+        feat_ref[:].astype(op_dtype), oh_b,
+        preferred_element_type=jnp.float32,
     )
 
     # (topic x broker) counts: outer products accumulated as matmuls
     iota_t = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, T), 1)
-    oh_t = (top_ref[0, :][:, None] == iota_t).astype(jnp.float32)
+    oh_t = (top_ref[0, :][:, None] == iota_t).astype(op_dtype)
     out_tr[:] += jnp.dot(
         oh_t.T, oh_b, preferred_element_type=jnp.float32
     )
-    lead = lead_ref[0, :].astype(jnp.float32)
+    lead = lead_ref[0, :].astype(op_dtype)
     out_tl[:] += jnp.dot(
         (oh_t * lead[:, None]).T, oh_b, preferred_element_type=jnp.float32
     )
 
     # (broker x disk) load: [B, TILE] @ [TILE, D]
     iota_d = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, D), 1)
-    oh_d = (dsk_ref[0, :][:, None] == iota_d).astype(jnp.float32)
+    oh_d = (dsk_ref[0, :][:, None] == iota_d).astype(op_dtype)
     out_disk[:] += jnp.dot(
-        oh_b.T, oh_d * dw_ref[0, :][:, None],
+        oh_b.T, oh_d * dw_ref[0, :][:, None].astype(op_dtype),
         preferred_element_type=jnp.float32,
     )
 
 
 def broker_aggregates_mxu(
-    m: TensorClusterModel, interpret: bool | None = None
+    m: TensorClusterModel, interpret: bool | None = None,
+    bf16: bool | None = None,
 ):
     """BrokerAggregates via the one-hot-matmul kernel (see module docstring).
 
@@ -133,12 +148,19 @@ def broker_aggregates_mxu(
     integer counts; float sums agree up to reduction order (tile-major here,
     segment-major there). ``interpret`` defaults to the Pallas interpreter
     on non-TPU backends (the CPU test path; CCX_MXU_AGGREGATES=1 without a
-    TPU would otherwise fail to lower) and to compiled on TPU.
+    TPU would otherwise fail to lower) and to compiled on TPU. ``bf16``
+    (default: the ``CCX_MXU_BF16`` env, read at import) feeds the matmuls
+    bfloat16 OPERANDS with f32 accumulation — integer counts stay exact
+    (0/1 one-hots are bf16-representable), float feature sums become
+    rank-order-grade (see ``_BF16`` note).
     """
     from ccx.model.aggregates import BrokerAggregates
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if bf16 is None:
+        bf16 = _BF16
+    op_dtype = jnp.bfloat16 if bf16 else jnp.float32
 
     B, T, D = m.B, m.num_topics, m.D
     P, R = m.P, m.R
@@ -186,7 +208,7 @@ def broker_aggregates_mxu(
     import functools
 
     out_feat, out_tr, out_tl, out_disk = pl.pallas_call(
-        functools.partial(_kernel, B=B, T=T, D=D),
+        functools.partial(_kernel, B=B, T=T, D=D, op_dtype=op_dtype),
         grid=grid,
         in_specs=[
             row(),                                            # seg

@@ -393,6 +393,20 @@ def sharded_anneal(
     n_chain_ranks = mesh.shape[CHAINS_AXIS]
     if m.P % n_parts:
         raise ValueError(f"padded P={m.P} not divisible by parts={n_parts}")
+    if opts.n_temps > 1:
+        # the partition-axis engine builds its own chunk program (one
+        # owner-gather + psum per step) and does not carry the exchange
+        # sweep yet — run flat rather than abort; chains-mesh data
+        # parallelism (parts == 1) goes through annealer._run_chunk and
+        # gets the full ladder.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "sharded_anneal: replica-exchange ladder (n_temps=%d) is not "
+            "supported by the partition-axis-sharded engine; running flat",
+            opts.n_temps,
+        )
+        opts = _dc.replace(opts, n_temps=1)
     n_chains = round_up_chains(opts.n_chains, n_chain_ranks, "sharded_anneal")
     if n_chains != opts.n_chains:
         opts = _dc.replace(opts, n_chains=n_chains)

@@ -35,6 +35,7 @@ import numpy as np
 from ccx.common import costmodel
 from ccx.common.resources import Resource
 from ccx.goals.base import GOAL_REGISTRY, GoalConfig
+from ccx.goals.kernels import scoring_dtype
 from ccx.goals.stack import DEFAULT_GOAL_ORDER, StackResult, evaluate_stack, soft_weights
 from ccx.model.tensor_model import TensorClusterModel
 from ccx.search.state import (
@@ -133,6 +134,34 @@ class AnnealOptions:
     #: and the telemetry taps armed; 0 (default) is today's fixed-budget
     #: drive, bit-exact.
     plateau_window: int = 0
+    #: >1 arms the replica-exchange ladder (ISSUE 16): the chain batch is
+    #: partitioned into this many temperature rungs. Rung 0 runs the exact
+    #: legacy ``t0→t1`` cooling schedule; rung K-1 holds at ``t0``; the
+    #: rungs between cool toward a geometric ladder of end temperatures
+    #: between ``t1`` and ``t0`` (each rung scales the decay EXPONENT, so
+    #: every rung shares the one compiled chunk program — temperatures are
+    #: data, never shape). At chunk boundaries neighboring rungs exchange
+    #: chain STATES via the Metropolis criterion on the soft-cost scalar
+    #: (``exchange_permutation``) — a pure permutation of the batch axis:
+    #: no new shapes, no recompile classes, and the lex-best chain is
+    #: pinned to the coldest rung (never exchanged hotter). 1 (default)
+    #: traces the literal legacy program — bit-exact. Requires
+    #: chunk_steps > 0 (exchange needs chunk boundaries; monolithic runs
+    #: log a note and stay flat). Config: ``optimizer.exchange.n.temps``.
+    n_temps: int = 1
+    #: chunk boundaries between exchange events when the ladder is armed
+    #: (1 = every chunk). Traced data — the chunk runner's static key
+    #: zeroes it, so interval retunes reuse the compiled program. Config:
+    #: ``optimizer.exchange.interval``.
+    exchange_interval: int = 1
+    #: opt-in bf16 scoring tier (ISSUE 16): the usage-coupled endpoint
+    #: scorer (broker band-pressure tables x per-replica usage inside the
+    #: batched step) ranks its Gumbel pools in bfloat16 — rank-order-only
+    #: intermediates; the lex cost vector and every accept/exchange
+    #: decision stay f32. Pure-throughput knob for the MXU; False
+    #: (default) keeps CPU correctness paths bit-exact. Config:
+    #: ``optimizer.bf16.scoring``.
+    bf16_scoring: bool = False
     seed: int = 0
 
 
@@ -207,6 +236,9 @@ class ProposalParams:
     p_couple: float = 0.0
     #: static pool size per coupled endpoint draw
     couple_pool: int = 4
+    #: bf16 scoring tier (AnnealOptions.bf16_scoring): coupled-endpoint
+    #: pool scores rank in bfloat16; acceptance math stays f32.
+    bf16: bool = False
 
 
 def lead_swap_share(p_leadership: float) -> float:
@@ -1078,6 +1110,11 @@ def _anneal_step_batched(
         # on already-gathered views, no extra carried-buffer reads --------
         press = broker_pressure(m, ss.agg, cfg)
         uw = usage_weights()
+        # bf16 scoring tier (ISSUE 16): the pool scores only feed an
+        # argmax/Gumbel rank — cast the pressure-table x usage products to
+        # the scoring dtype and return to f32 only at the logits, so the
+        # Gumbel noise, acceptance and cost vectors never leave f32.
+        sdt = scoring_dtype(pp.bf16)
 
         def pool_scores(vp, over: bool):
             b = jnp.clip(vp.assign, 0, B - 1)                    # [C, R]
@@ -1090,12 +1127,13 @@ def _anneal_step_batched(
             u_lead = vp.lead_load @ uw                           # [C]
             u_foll = vp.foll_load @ uw
             u = jnp.where(is_l, u_lead[:, None], u_foll[:, None])  # [C, R]
+            u = u.astype(sdt)
             if over:
-                sc = press.usage_over[b] * u * ok
+                sc = press.usage_over[b].astype(sdt) * u * ok
             else:
-                sc = press.usage_under[b] * (1.0 / (1.0 + u)) * ok
+                sc = press.usage_under[b].astype(sdt) * (1.0 / (1.0 + u)) * ok
             slot = jnp.argmax(sc, axis=1).astype(jnp.int32)
-            rs_logit = jnp.log(jnp.max(sc, axis=1) + 1e-12)
+            rs_logit = jnp.log(jnp.max(sc, axis=1).astype(jnp.float32) + 1e-12)
             # leadership-swap variant: endpoint quality is the LEADER
             # broker's leader-bytes band pressure x the leader's bytes-in
             lsafe = jnp.clip(vp.leader, 0, R - 1)[:, None]
@@ -1103,12 +1141,13 @@ def _anneal_step_batched(
             has_lead = vp.pvalid & (
                 jnp.take_along_axis(vp.assign, lsafe, axis=1)[:, 0] >= 0
             )
-            lbytes = vp.lead_load[:, Resource.NW_IN]
+            lbytes = vp.lead_load[:, Resource.NW_IN].astype(sdt)
             if over:
-                lsc = press.lbi_over[lb] * lbytes
+                lsc = press.lbi_over[lb].astype(sdt) * lbytes
             else:
-                lsc = press.lbi_under[lb] * (1.0 / (1.0 + lbytes))
-            ls_logit = jnp.log(jnp.where(has_lead, lsc, 0.0) + 1e-12)
+                lsc = press.lbi_under[lb].astype(sdt) * (1.0 / (1.0 + lbytes))
+            lsc = jnp.where(has_lead, lsc.astype(jnp.float32), 0.0)
+            ls_logit = jnp.log(lsc + 1e-12)
             return rs_logit, ls_logit, slot
 
         rs_a, ls_a, slot_a = jax.vmap(lambda vp: pool_scores(vp, True))(
@@ -1374,6 +1413,7 @@ def _build_step(
         p_lead_swap=lead_swap_share(opts.p_leadership),
         p_couple=opts.swap_coupling if allow_inter else 0.0,
         couple_pool=opts.couple_pool,
+        bf16=opts.bf16_scoring,
     )
     from ccx.search.state import make_cost_vector_fn
 
@@ -1594,6 +1634,142 @@ def drive_chunks(run_one, carry, *, total: int, chunk: int, probe=None,
     return carry
 
 
+def ladder_rungs(n_temps: int, n_chains: int) -> np.ndarray:
+    """int32[n_chains] rung index per chain under the replica-exchange
+    ladder: equal-sized contiguous blocks of ``n_chains // K`` chains, rung
+    0 coldest. When K does not divide the batch (``round_up_chains`` makes
+    this rare) the remainder chains fold into the hottest rung — they run
+    the rung-(K-1) schedule but sit OUTSIDE the exchange pairing, so the
+    pairing stays a clean bijection."""
+    K = max(int(n_temps), 1)
+    size = max(int(n_chains) // K, 1)
+    return np.minimum(np.arange(int(n_chains)) // size, K - 1).astype(np.int32)
+
+
+def ladder_fracs(n_temps: int, n_chains: int) -> np.ndarray:
+    """f32[n_chains] decay-exponent fraction per chain: rung k cools as
+    ``T_k(t) = t0 * decay**(t * (1 - k/(K-1)))``, i.e. rung 0 is the exact
+    legacy schedule, rung K-1 holds at ``t0``, and the rung END
+    temperatures form the geometric ladder ``t1^(1-k/(K-1)) * t0^(k/(K-1))``
+    between ``t1`` and ``t0``. A static per-chain constant — temperatures
+    stay traced data and every rung shares the one compiled chunk."""
+    K = max(int(n_temps), 1)
+    if K == 1:
+        return np.ones(int(n_chains), np.float32)
+    rung = ladder_rungs(K, n_chains).astype(np.float64)
+    return (1.0 - rung / (K - 1)).astype(np.float32)
+
+
+def ladder_end_temps(opts: AnnealOptions) -> list[float]:
+    """Host-side end-of-schedule temperature per rung (telemetry/report)."""
+    K = max(int(opts.n_temps), 1)
+    if K == 1:
+        return [float(opts.t1)]
+    return [
+        float(opts.t1 ** (1.0 - k / (K - 1)) * opts.t0 ** (k / (K - 1)))
+        for k in range(K)
+    ]
+
+
+def _lex_lt_rows(a: jnp.ndarray, b: jnp.ndarray, mask=None) -> jnp.ndarray:
+    """bool[n]: row ``a[i]`` lexicographically beats ``b[i]`` under the
+    ``goal_tols`` significance rule (optionally restricted to a goal
+    ``mask``). Rowwise twin of the scalar test inside ``lex_accept``."""
+    d = a - b
+    sig = jnp.abs(d) > goal_tols(b)
+    if mask is not None:
+        sig = sig & mask[None, :]
+    first = jnp.argmax(sig, axis=1)
+    any_sig = jnp.any(sig, axis=1)
+    return any_sig & (jnp.take_along_axis(d, first[:, None], axis=1)[:, 0] < 0)
+
+
+def exchange_permutation(
+    cost_vec: jnp.ndarray,      # f32[n, G] per-chain lex cost vectors
+    temps: jnp.ndarray,         # f32[n] per-chain current temperature
+    key: jnp.ndarray,           # PRNG key for the Metropolis draws
+    *,
+    n_temps: int,
+    hard_arr: jnp.ndarray,      # bool[G]
+    weights: jnp.ndarray,       # f32[G] soft tier weights
+    parity,                     # 0: pair rungs (0,1),(2,3)…; 1: (1,2),(3,4)…
+):
+    """One replica-exchange sweep as a PERMUTATION of the chain axis.
+
+    Neighboring rungs pair elementwise (rung r chain j ↔ rung r+1 chain j,
+    alternating even/odd rung pairings by ``parity`` so the whole ladder
+    mixes over successive sweeps). Each pair swaps WHOLE chain states —
+    every SearchState leaf, RNG keys included — so the move is invisible
+    to everything but the temperature a chain will see next: replica
+    counts, leader invariants and devmem accounting are untouched by
+    construction, and no shapes change (zero new compile classes).
+
+    Decision per pair, evaluated at the cold member:
+    1. hard tiers significantly differ (``goal_tols``) → deterministic:
+       swap iff the hot member is hard-lex-better (hard goals behave as
+       the lex gate in ``lex_accept`` — never Metropolis'd);
+    2. soft scalars significantly differ → standard Metropolis exchange
+       ``log u < (1/T_cold - 1/T_hot) * (E_cold - E_hot)`` on the
+       tier-weighted soft-cost scalar;
+    3. tie → full-vector lex: swap iff the hot member is lex-better.
+    The lex-best chain overrides all three: it is never exchanged away
+    from its rung toward hotter, and always exchanged colder — the coldest
+    rung can only gain it, never lose it.
+
+    Returns ``(perm int32[n], attempted, accepted)``; apply with
+    ``jax.tree.map(lambda x: x[perm], states)``. ``perm`` is an involution
+    (pairs swap or stay), hence always a valid permutation.
+    """
+    n, G = cost_vec.shape
+    K = max(int(n_temps), 1)
+    size = max(n // K, 1)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rung = jnp.minimum(idx // size, K - 1)
+    in_ladder = idx < K * size  # remainder chains sit outside the pairing
+    low = ((rung - parity) % 2) == 0
+    partner_rung = jnp.where(low, rung + 1, rung - 1)
+    valid = in_ladder & (partner_rung >= 0) & (partner_rung < K)
+    partner = jnp.clip(partner_rung, 0, K - 1) * size + (idx % size)
+    partner = jnp.where(valid, partner, idx)
+
+    soft_w = jnp.where(hard_arr, 0.0, weights)
+    E = cost_vec @ soft_w                       # f32[n] soft-cost scalar
+    cv_p = cost_vec[partner]
+
+    # the lex-best chain (same elimination as telemetry.lex_best_row)
+    alive = jnp.ones((n,), bool)
+    for g in range(G):
+        col = jnp.where(alive, cost_vec[:, g], jnp.inf)
+        mn = jnp.min(col)
+        alive = alive & (col <= mn + 1e-6 + 1e-6 * jnp.abs(mn))
+    is_best = idx == jnp.argmax(alive)
+
+    hard_sig = jnp.any(
+        (jnp.abs(cost_vec - cv_p) > goal_tols(cost_vec)) & hard_arr[None, :],
+        axis=1,
+    )
+    hot_hard_better = _lex_lt_rows(cv_p, cost_vec, mask=hard_arr)
+
+    E_p = E[partner]
+    inv_t = 1.0 / jnp.maximum(temps, 1e-30)
+    dlog = (inv_t - inv_t[partner]) * (E - E_p)
+    u = jax.random.uniform(key, (n,), minval=1e-12, maxval=1.0)
+    metro = jnp.log(u) < dlog
+    soft_tie = jnp.abs(E - E_p) <= 1e-6 + 1e-6 * jnp.abs(E)
+    hot_lex_better = _lex_lt_rows(cv_p, cost_vec)
+
+    d = jnp.where(soft_tie, hot_lex_better, metro)
+    d = jnp.where(hard_sig, hot_hard_better, d)
+    d = jnp.where(is_best, False, d)            # never demote the best
+    d = jnp.where(is_best[partner], True, d)    # always promote the best
+    d = d & valid & low                         # decided at the cold member
+    swap = d | d[partner]
+    perm = jnp.where(swap, partner, idx)
+    attempted = jnp.sum((valid & low).astype(jnp.int32))
+    accepted = jnp.sum(d.astype(jnp.int32))
+    return perm, attempted, accepted
+
+
 @costmodel.instrument("sa-chunk", iters=lambda k: k["chunk"])
 @functools.partial(
     jax.jit,
@@ -1611,6 +1787,7 @@ def _run_chunk(
     decay: jnp.ndarray,
     swap_ramp: jnp.ndarray,
     n_total: jnp.ndarray,
+    ex_interval=None,
     tap=None,
     *,
     goal_names: tuple[str, ...],
@@ -1646,13 +1823,33 @@ def _run_chunk(
     full cost vector, chain-summed cumulative move counters, and the
     temperature at the chunk's last live step. None (taps off) traces the
     identical pre-telemetry program, so taps-off results are bit-exact.
+
+    ``opts.n_temps > 1`` arms the replica-exchange ladder (ISSUE 16): each
+    chain's temperature follows its rung's schedule (``ladder_fracs`` — a
+    static per-chain exponent fraction, so temperatures remain traced
+    data) and the chunk ends with one ``exchange_permutation`` sweep of
+    the batch axis, gated on the traced ``ex_interval`` (every
+    ``ex_interval``-th chunk; the static key zeroes it, so interval
+    retunes reuse the program). K == 1 traces the literal legacy program
+    — the ladder code is absent, not disabled — so flat runs stay
+    bit-exact by construction.
     """
     step, _ = _build_step(
         m, goal_names, cfg, opts, p_real, b_real, max_pt, swap_ramp=swap_ramp
     )
+    K_t = max(int(opts.n_temps), 1)
+    n_batch = states.cost_vec.shape[0]
+    frac = (
+        jnp.asarray(ladder_fracs(K_t, n_batch)) if K_t > 1 else None
+    )
 
     def body(ss: SearchState, t: jnp.ndarray) -> tuple[SearchState, None]:
         def active(s):
+            if K_t > 1:
+                temp = opts.t0 * decay ** (t.astype(jnp.float32) * frac)
+                return jax.vmap(step, in_axes=(0, 0, None, None, None))(
+                    s, temp, t, evac, n_evac
+                )
             temp = opts.t0 * decay**t
             return jax.vmap(step, in_axes=(0, None, None, None, None))(
                 s, temp, t, evac, n_evac
@@ -1662,18 +1859,43 @@ def _run_chunk(
         return ss, None
 
     states, _ = jax.lax.scan(body, states, t_offset + jnp.arange(chunk))
+    t_last = jnp.maximum(jnp.minimum(t_offset + chunk, n_total) - 1, 0)
+    n_ex_att = n_ex_acc = jnp.zeros((), jnp.int32)
+    if K_t > 1:
+        hard_mask = tuple(GOAL_REGISTRY[g].hard for g in goal_names)
+        interval = jnp.maximum(
+            jnp.asarray(
+                1 if ex_interval is None else ex_interval, jnp.int32
+            ),
+            1,
+        )
+        chunk_ord = t_offset // chunk
+        do_ex = (((chunk_ord + 1) % interval) == 0) & (t_offset < n_total)
+        parity = (chunk_ord // interval) % 2
+        perm, att, acc = exchange_permutation(
+            states.cost_vec,
+            opts.t0 * decay ** (t_last.astype(jnp.float32) * frac),
+            jax.random.fold_in(states.key[0], t_offset),
+            n_temps=K_t,
+            hard_arr=jnp.asarray(hard_mask),
+            weights=soft_weights(hard_mask),
+            parity=parity,
+        )
+        perm = jnp.where(do_ex, perm, jnp.arange(n_batch, dtype=jnp.int32))
+        n_ex_att = jnp.where(do_ex, att, 0)
+        n_ex_acc = jnp.where(do_ex, acc, 0)
+        states = jax.tree.map(lambda x: x[perm], states)
     if tap is not None:
         from ccx.search import telemetry
 
-        t_last = jnp.maximum(
-            jnp.minimum(t_offset + chunk, n_total) - 1, 0
-        )
         tap = telemetry.record(
             tap,
             telemetry.lex_best_row(states.cost_vec),
             jnp.sum(states.n_prop_kind, axis=0),
             jnp.sum(states.n_acc_kind, axis=0),
             opts.t0 * decay**t_last,
+            n_ex_att,
+            n_ex_acc,
         )
     return states, tap
 
@@ -1723,22 +1945,39 @@ def best_chain_index(cost_vecs: np.ndarray) -> int:
     return int(order[0])
 
 
-def round_up_chains(n_chains: int, ranks: int, where: str) -> int:
-    """Next multiple of ``ranks`` >= ``n_chains``, with a logged note.
+#: (n_chains, ranks, n_temps) shapes whose padding note already logged —
+#: the warm drive calls round_up_chains every window, and one note per
+#: SHAPE is signal where one per call was log spam.
+_ROUNDED_SHAPES: set = set()
+
+
+def round_up_chains(
+    n_chains: int, ranks: int, where: str, n_temps: int = 1
+) -> int:
+    """Next multiple of ``ranks * n_temps`` >= ``n_chains``, noted once.
 
     A campaign retune (or an odd device count) used to abort with a hard
     ``ValueError`` when the chain count did not divide the mesh; rounding
     up instead costs a few extra chains (more search, same wall — chains
-    are the embarrassingly-parallel axis) and never kills a window."""
-    if ranks <= 1 or n_chains % ranks == 0:
-        return max(n_chains, ranks)
-    rounded = ((n_chains + ranks - 1) // ranks) * ranks
-    import logging
+    are the embarrassingly-parallel axis) and never kills a window. Under
+    the replica-exchange ladder the multiple is K x ranks so every rung
+    stays equal-sized across the sharded mesh path (a ragged hottest rung
+    would silently sit out the exchange pairing). The padding note logs
+    once per (n_chains, ranks, n_temps) shape, not per call."""
+    mult = max(int(ranks), 1) * max(int(n_temps), 1)
+    if mult <= 1 or n_chains % mult == 0:
+        return max(n_chains, mult)
+    rounded = ((n_chains + mult - 1) // mult) * mult
+    shape = (int(n_chains), int(ranks), int(n_temps))
+    if shape not in _ROUNDED_SHAPES:
+        _ROUNDED_SHAPES.add(shape)
+        import logging
 
-    logging.getLogger(__name__).warning(
-        "%s: n_chains=%d not divisible by mesh chain ranks %d; "
-        "rounding up to %d", where, n_chains, ranks, rounded,
-    )
+        logging.getLogger(__name__).warning(
+            "%s: n_chains=%d not divisible by %d (mesh chain ranks %d x "
+            "temperature rungs %d); rounding up to %d",
+            where, n_chains, mult, ranks, n_temps, rounded,
+        )
     return rounded
 
 
@@ -1808,8 +2047,12 @@ def anneal(
     )
 
     n_chains = opts.n_chains
-    if mesh is not None:
-        n_chains = round_up_chains(n_chains, mesh.size, "anneal")
+    n_temps = max(int(opts.n_temps), 1) if opts.chunk_steps > 0 else 1
+    if mesh is not None or n_temps > 1:
+        n_chains = round_up_chains(
+            n_chains, mesh.size if mesh is not None else 1, "anneal",
+            n_temps=n_temps,
+        )
     keys = jax.random.split(jax.random.PRNGKey(opts.seed), n_chains)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -1844,10 +2087,14 @@ def anneal(
         # plateau_window is a host-side drive knob (PlateauExit), never
         # program shape — zero it in the static key so arming/retuning
         # the plateau exit reuses the compiled chunk (pinned)
+        # exchange_interval is traced data (like the budget/schedule) —
+        # zero it in the static key so interval retunes reuse the chunk;
+        # n_temps/bf16_scoring stay: they ARE program shape (ladder
+        # in_axes / scoring dtype).
         opts_key = dataclasses.replace(
             opts, n_steps=0, seed=0,
             p_swap_end=1.0 if opts.p_swap_end >= 0 else -1.0,
-            plateau_window=0,
+            plateau_window=0, exchange_interval=0,
         )
         states = _init_chains(
             m, keys, goal_names=goal_names, cfg=cfg, max_pt=max_pt
@@ -1871,11 +2118,16 @@ def anneal(
                 tap, NamedSharding(mesh, PartitionSpec())
             )
 
+        ex_interval_j = jnp.asarray(
+            max(int(opts.exchange_interval), 1), jnp.int32
+        )
+
         def run_one(carry, off):
             states, tp = carry
             return _run_chunk(
                 states, m, evac_j, n_evac_j,
-                jnp.asarray(off, jnp.int32), decay_j, ramp, n_j, tp,
+                jnp.asarray(off, jnp.int32), decay_j, ramp, n_j,
+                ex_interval_j, tp,
                 goal_names=goal_names, cfg=cfg, opts=opts_key,
                 p_real=p_real, b_real=b_real, max_pt=max_pt,
                 chunk=int(opts.chunk_steps),
@@ -1910,8 +2162,18 @@ def anneal(
             run_one, (states, tap), total=n, chunk=opts.chunk_steps,
             probe=probe, plateau=plateau,
         )
+        ladder_meta = None
+        if n_temps > 1:
+            ladder_meta = {
+                "nTemps": n_temps,
+                "interval": max(int(opts.exchange_interval), 1),
+                "rungSize": n_chains // n_temps,
+                "t0": float(opts.t0),
+                "endTemps": ladder_end_temps(opts),
+            }
         convergence = telemetry.decode(
-            tap, goal_names, chunk_size=opts.chunk_steps, budget=n
+            tap, goal_names, chunk_size=opts.chunk_steps, budget=n,
+            ladder=ladder_meta,
         )
         plateau_info = (
             plateau.to_json(
@@ -1921,6 +2183,14 @@ def anneal(
             else None
         )
     else:
+        if opts.n_temps > 1:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "anneal: n_temps=%d needs chunk_steps > 0 (exchange runs "
+                "at chunk boundaries); monolithic run stays flat",
+                opts.n_temps,
+            )
         states = _run_chains(
             m, keys, jnp.asarray(evac), jnp.asarray(n_evac, jnp.int32),
             goal_names=goal_names, cfg=cfg, opts=opts,

@@ -46,8 +46,10 @@ import os
 from ccx.common.convergence import plateau_chunk, wasted_fraction  # noqa: F401
 
 #: row layout past the G goal costs: 3 proposal counters, 3 acceptance
-#: counters (state.MOVE_KIND_NAMES order), temperature
-EXTRA = 7
+#: counters (state.MOVE_KIND_NAMES order), temperature, and the
+#: replica-exchange attempt/accept counters (zero rows for flat engines —
+#: greedy/polish and K=1 SA never attempt an exchange)
+EXTRA = 9
 
 #: env off-switch for bench/tools/subprocess paths (the config key
 #: ``observability.convergence`` wins when the facade set it explicitly)
@@ -134,7 +136,8 @@ def lex_best_row(cost_vecs):
     return cost_vecs[jnp.argmax(alive)]
 
 
-def record(tap, cost_vec, n_prop, n_acc, temperature):
+def record(tap, cost_vec, n_prop, n_acc, temperature,
+           ex_attempted=None, ex_accepted=None):
     """Traced per-chunk write: one ``dynamic_update_slice`` row (clamped
     to the last row once the buffer is full — see module docstring), count
     always advanced so ``decode`` can report the true chunk total.
@@ -142,16 +145,28 @@ def record(tap, cost_vec, n_prop, n_acc, temperature):
     The cumulative move counters share the f32 row with the costs, so
     they are exact only below 2**24 (~16.7M) — two orders of magnitude
     above any banked rung's proposal total; past that, per-chunk deltas
-    quantize (the counters are advisory trend evidence, never gated)."""
+    quantize (the counters are advisory trend evidence, never gated).
+
+    ``ex_attempted``/``ex_accepted`` are THIS chunk's replica-exchange
+    pair counts (not cumulative — an exchange sweep is a chunk-boundary
+    event, so the per-chunk value is already the natural unit). Engines
+    without a ladder omit them and write zeros."""
     import jax
     import jax.numpy as jnp
 
     buf, n = tap
+    zero = jnp.zeros((), jnp.float32)
     row = jnp.concatenate([
         jnp.asarray(cost_vec, jnp.float32),
         jnp.asarray(n_prop, jnp.float32),
         jnp.asarray(n_acc, jnp.float32),
         jnp.asarray(temperature, jnp.float32)[None],
+        jnp.asarray(
+            zero if ex_attempted is None else ex_attempted, jnp.float32
+        )[None],
+        jnp.asarray(
+            zero if ex_accepted is None else ex_accepted, jnp.float32
+        )[None],
     ])
     idx = jnp.minimum(n, buf.shape[0] - 1)
     buf = jax.lax.dynamic_update_slice(
@@ -164,13 +179,18 @@ def record(tap, cost_vec, n_prop, n_acc, temperature):
 
 
 def decode(tap, goal_names, chunk_size: int | None = None,
-           budget: int | None = None) -> dict | None:
+           budget: int | None = None, ladder: dict | None = None) -> dict | None:
     """Materialize a tap into the JSON-ready convergence segment that
     rides ``AnnealResult``/``GreedyResult`` → ``OptimizerResult.
     convergence``. One device→host transfer, at the point the engine
     already syncs on its result. Counters are CUMULATIVE (per-chunk deltas
     are a host-side diff — keeping the device write a pure copy of the
-    carried counters)."""
+    carried counters).
+
+    ``ladder`` (optional — the annealer passes it when n_temps > 1)
+    attaches the replica-exchange ladder metadata verbatim; the per-chunk
+    exchange attempt/accept series appears whenever any chunk attempted a
+    pair (flat engines write zero columns and stay schema-stable)."""
     import numpy as np
 
     if tap is None:
@@ -198,6 +218,14 @@ def decode(tap, goal_names, chunk_size: int | None = None,
             round(float(buf[i, G + 6]), 6) for i in range(rows)
         ],
     }
+    ex_att = [int(buf[i, G + 7]) for i in range(rows)]
+    if any(ex_att):
+        out["exchange"] = {
+            "attempted": ex_att,
+            "accepted": [int(buf[i, G + 8]) for i in range(rows)],
+        }
+    if ladder is not None:
+        out["ladder"] = dict(ladder)
     if chunk_size:
         out["chunk"] = int(chunk_size)
     if budget is not None:

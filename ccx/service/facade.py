@@ -219,6 +219,9 @@ class CruiseControl:
             p_swap=self.config["optimizer.swap.p.swap"],
             p_swap_end=self.config["optimizer.swap.p.swap.end"],
             swap_coupling=self.config["optimizer.swap.coupling"],
+            n_temps=self.config["optimizer.exchange.n.temps"],
+            exchange_interval=self.config["optimizer.exchange.interval"],
+            bf16_scoring=self.config["optimizer.bf16.scoring"],
         )
         polish = GreedyOptions(
             n_candidates=self.config["optimizer.polish.candidates"],

@@ -624,6 +624,12 @@ class OptimizerSidecar:
                 p_swap=float(o.get("p_swap", 0.15)),
                 p_swap_end=float(o.get("p_swap_end", -1.0)),
                 swap_coupling=float(o.get("swap_coupling", 0.5)),
+                # replica-exchange ladder (ISSUE 16): K and the bf16 tier
+                # are program shape — a client changing them pays one new
+                # chunk compile; the interval is traced data (free retune)
+                n_temps=int(o.get("n_temps", 1)),
+                exchange_interval=int(o.get("exchange_interval", 1)),
+                bf16_scoring=bool(o.get("bf16_scoring", False)),
             ),
             polish=GreedyOptions(
                 n_candidates=int(o.get("polish_candidates", 256)),

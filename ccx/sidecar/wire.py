@@ -95,6 +95,7 @@ PROPOSE_OPTION_KEYS = frozenset({
     # SA engine
     "chains", "steps", "moves_per_step", "seed", "chunk_steps",
     "p_swap", "p_swap_end", "swap_coupling",
+    "n_temps", "exchange_interval", "bf16_scoring",
     # greedy polish / leadership pass (chunked descent engine)
     "polish_candidates", "polish_max_iters", "polish_patience",
     "polish_batch_moves", "polish_swap_fraction", "polish_chunk_iters",

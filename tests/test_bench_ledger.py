@@ -1046,3 +1046,93 @@ def test_scenario_verbless_subset_skips_warm_gate(tmp_path):
         "NO anomaly-verb family" in f
         for f in bench_ledger.check_scenario(rows)
     )
+
+
+# ----- exchange family (EXCHANGE_r*.json) ------------------------------------
+
+
+def _exchange_line(*, ladder_better=True, k1=True, fresh=0, verified=None,
+                   accept=0.25):
+    if verified is None:
+        verified = ladder_better and k1 and not fresh
+    return {
+        "exchange_ab": True, "rung": "exchange-ab", "bench": "B3",
+        "backend": "cpu", "chains": 16, "steps": 12000, "chunk": 150,
+        "n_temps": 4, "interval": 1, "seed": 17, "value": 58.3,
+        "flat": {"wall_s": 105.8, "plateau_chunk": 79, "chunks": 80},
+        "ladder": {
+            "wall_s": 58.3, "plateau_chunk": 79, "chunks": 80,
+            "reached_flat_plateau_chunk": 79,
+            "exchange_attempted": 480, "exchange_accepted": 122,
+            "exchange_accept_rate": accept,
+        },
+        "ladder_better": ladder_better, "k1_bitexact": k1,
+        "fresh_compiles_on_retune": fresh, "verified": verified,
+    }
+
+
+def _bank_exchange(tmp_path, n, line):
+    (tmp_path / f"EXCHANGE_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_exchange_gate_green_on_banked_artifacts():
+    xrows, xpartials = bench_ledger.load_exchange(str(REPO))
+    if not xrows and not xpartials:
+        pytest.skip("no EXCHANGE artifacts banked yet")
+    assert xpartials == []
+    assert bench_ledger.check_exchange(xrows) == []
+
+
+def test_exchange_rows_parse(tmp_path):
+    _bank_exchange(tmp_path, 1, _exchange_line())
+    rows, partials = bench_ledger.load_exchange(str(tmp_path))
+    assert partials == []
+    (r,) = rows
+    assert r["round"] == 1 and r["bench"] == "B3" and r["n_temps"] == 4
+    assert r["flat_plateau"] == 79 and r["accept_rate"] == 0.25
+    assert r["ladder_better"] and r["k1_bitexact"] and r["verified"]
+    assert r["fresh_compiles"] == 0
+
+
+def test_exchange_green_round_passes_check(tmp_path):
+    _bank_exchange(tmp_path, 1, _exchange_line())
+    rows, _ = bench_ledger.load_exchange(str(tmp_path))
+    assert bench_ledger.check_exchange(rows) == []
+
+
+def test_exchange_contract_points_fail_check(tmp_path):
+    _bank_exchange(tmp_path, 1, _exchange_line(
+        ladder_better=False, k1=False, fresh=2))
+    rows, _ = bench_ledger.load_exchange(str(tmp_path))
+    failures = bench_ledger.check_exchange(rows)
+    assert any("did NOT beat" in f for f in failures)
+    assert any("bit-exact" in f for f in failures)
+    assert any("fresh compile" in f for f in failures)
+    assert any("UNVERIFIED" in f for f in failures)
+
+
+def test_exchange_only_latest_round_gates(tmp_path):
+    # a failed older round is history once a green round lands on top
+    _bank_exchange(tmp_path, 1, _exchange_line(ladder_better=False))
+    _bank_exchange(tmp_path, 2, _exchange_line())
+    rows, _ = bench_ledger.load_exchange(str(tmp_path))
+    assert bench_ledger.check_exchange(rows) == []
+
+
+def test_exchange_unparseable_is_partial_not_row(tmp_path):
+    _bank_exchange(tmp_path, 1, {"rc": 124})  # wedged run: no schema
+    rows, partials = bench_ledger.load_exchange(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+    assert "no completed exchange line" in partials[0]["why"]
+    # a partial never trips the gate by itself
+    assert bench_ledger.check_exchange(rows) == []
+
+
+def test_exchange_render_table(tmp_path):
+    _bank_exchange(tmp_path, 1, _exchange_line())
+    rows, partials = bench_ledger.load_exchange(str(tmp_path))
+    out = bench_ledger.render_exchange(rows, partials)
+    assert "replica exchange A/B" in out
+    assert "25%" in out and "yes" in out

@@ -1,0 +1,271 @@
+"""Replica-exchange ladder tests (ISSUE 16).
+
+Three contract points, mirrored from the bench gates:
+
+* K=1 is the legacy program — the degenerate ladder must be bit-exact
+  against a flat run (same placement, same costs), because ``_run_chunk``
+  traces the literal legacy body when ``opts.n_temps == 1``.
+* An exchange sweep is a PURE PERMUTATION of the chain axis — whole
+  states swap, so replica counts, leader invariants and device-memory
+  accounting are untouched by construction; the permutation is an
+  involution and the lex-best chain can never be demoted toward hotter.
+* The ladder composes with the rest of the chunked drive: plateau-exit
+  still fires, ``round_up_chains`` rounds to K x ranks, and the opt-in
+  bf16 scoring tier keeps hard feasibility on the CPU correctness path.
+"""
+
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccx.goals.base import GoalConfig
+from ccx.goals.kernels import scoring_dtype
+from ccx.goals.stack import DEFAULT_GOAL_ORDER
+from ccx.model.fixtures import RandomClusterSpec, random_cluster
+from ccx.search.annealer import (
+    AnnealOptions,
+    anneal,
+    exchange_permutation,
+    ladder_end_temps,
+    ladder_fracs,
+    ladder_rungs,
+    round_up_chains,
+)
+from ccx.verify import verify_optimization
+
+CFG = GoalConfig()
+
+SPEC = RandomClusterSpec(
+    n_brokers=8, n_racks=4, n_topics=6, n_partitions=96, seed=11
+)
+#: chunked so the ladder path is armed; small so the suite stays fast
+CHUNKED = AnnealOptions(n_chains=8, n_steps=240, chunk_steps=60, seed=3)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return random_cluster(SPEC)
+
+
+# ----- K=1 bit-exactness -----------------------------------------------------
+
+
+def test_k1_ladder_bitexact_vs_flat(model):
+    flat = anneal(model, CFG, DEFAULT_GOAL_ORDER, CHUNKED)
+    k1 = anneal(
+        model, CFG, DEFAULT_GOAL_ORDER,
+        # a different exchange_interval must not perturb K=1 either: the
+        # interval is traced data the K=1 program never reads
+        dataclasses.replace(CHUNKED, n_temps=1, exchange_interval=3),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(flat.model.assignment), np.asarray(k1.model.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(flat.model.leader_slot), np.asarray(k1.model.leader_slot)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(flat.stack_after.costs), np.asarray(k1.stack_after.costs)
+    )
+
+
+# ----- the exchange sweep is a pure permutation ------------------------------
+
+
+def _perm(cost, temps, *, n_temps, parity=0, hard=None, seed=0):
+    n, G = cost.shape
+    hard_arr = jnp.zeros(G, bool) if hard is None else jnp.asarray(hard)
+    weights = jnp.ones(G, jnp.float32)
+    perm, att, acc = exchange_permutation(
+        jnp.asarray(cost, jnp.float32),
+        jnp.asarray(temps, jnp.float32),
+        jax.random.PRNGKey(seed),
+        n_temps=n_temps,
+        hard_arr=hard_arr,
+        weights=weights,
+        parity=parity,
+    )
+    return np.asarray(perm), int(att), int(acc)
+
+
+def test_exchange_is_involution_and_permutation():
+    rng = np.random.default_rng(0)
+    cost = rng.uniform(0.0, 10.0, size=(8, 3))
+    temps = np.repeat([0.001, 0.01, 0.1, 0.3], 2)
+    for parity in (0, 1):
+        for seed in range(5):
+            perm, att, acc = _perm(
+                cost, temps, n_temps=4, parity=parity, seed=seed
+            )
+            assert sorted(perm) == list(range(8))        # permutation
+            np.testing.assert_array_equal(perm[perm], np.arange(8))
+            assert acc <= att
+    # parity 0 pairs rungs (0,1),(2,3): 4 cold-side members; parity 1
+    # pairs (1,2): 2
+    assert _perm(cost, temps, n_temps=4, parity=0)[1] == 4
+    assert _perm(cost, temps, n_temps=4, parity=1)[1] == 2
+
+
+def test_lex_best_never_leaves_cold_rung():
+    # chain 0 (cold rung) is strictly best on every goal: no seed and no
+    # parity may move it
+    cost = np.full((8, 3), 5.0)
+    cost[0] = 0.0
+    cost[1:] += np.arange(7)[:, None]  # break ties so argmax is stable
+    temps = np.repeat([0.001, 0.01, 0.1, 0.3], 2)
+    for parity in (0, 1):
+        for seed in range(8):
+            perm, _, _ = _perm(
+                cost, temps, n_temps=4, parity=parity, seed=seed
+            )
+            assert perm[0] == 0
+
+
+def test_lex_best_in_hot_rung_is_always_promoted():
+    # the best chain sits in rung 1 (index 2); at parity 0 its partner is
+    # rung 0 (index 0) — promotion is deterministic, any seed
+    cost = np.full((8, 3), 5.0)
+    cost[2] = 0.0
+    cost[[0, 1, 3, 4, 5, 6, 7]] += np.arange(7)[:, None]
+    temps = np.repeat([0.001, 0.01, 0.1, 0.3], 2)
+    for seed in range(8):
+        perm, _, acc = _perm(cost, temps, n_temps=4, parity=0, seed=seed)
+        assert perm[0] == 2 and perm[2] == 0
+        assert acc >= 1
+
+
+def test_hard_tier_precedence_is_deterministic():
+    # goal 0 is hard; the hot member of pair (0, 2) is hard-better while
+    # its soft tiers are far worse — the swap must happen (hard goals are
+    # never Metropolis'd), and the reverse pair (1, 3) must never swap
+    cost = np.array([
+        [1.0, 0.0, 0.0],   # rung 0: hard violation
+        [0.0, 0.0, 0.0],   # rung 0: hard-clean
+        [0.0, 9.0, 9.0],   # rung 1: hard-clean, soft-awful
+        [1.0, 9.0, 9.0],   # rung 1: hard violation
+    ])
+    temps = np.array([0.001, 0.001, 0.3, 0.3])
+    for seed in range(8):
+        perm, _, _ = _perm(
+            cost, temps, n_temps=2, parity=0,
+            hard=[True, False, False], seed=seed,
+        )
+        assert perm[0] == 2 and perm[2] == 0
+        assert perm[1] == 1 and perm[3] == 3
+
+
+def test_remainder_chains_sit_outside_the_ladder():
+    # n=10, K=4 -> rung size 2; chains 8..9 fold into the hottest rung's
+    # temperature but never pair: fixed points of every sweep
+    rng = np.random.default_rng(1)
+    cost = rng.uniform(0.0, 10.0, size=(10, 3))
+    cost[8] = cost[9] = 0.0  # even as lex-best they must not move
+    temps = np.concatenate([np.repeat([0.001, 0.01, 0.1, 0.3], 2), [0.3, 0.3]])
+    for parity in (0, 1):
+        for seed in range(4):
+            perm, _, _ = _perm(
+                cost, temps, n_temps=4, parity=parity, seed=seed
+            )
+            assert perm[8] == 8 and perm[9] == 9
+            assert sorted(perm) == list(range(10))
+
+
+def test_ladder_shape_helpers():
+    np.testing.assert_array_equal(
+        ladder_rungs(4, 8), [0, 0, 1, 1, 2, 2, 3, 3]
+    )
+    np.testing.assert_array_equal(ladder_rungs(1, 4), [0, 0, 0, 0])
+    # remainder chains land in the hottest rung
+    np.testing.assert_array_equal(
+        ladder_rungs(4, 10), [0, 0, 1, 1, 2, 2, 3, 3, 3, 3]
+    )
+    fr = ladder_fracs(4, 8)
+    np.testing.assert_allclose(
+        fr, [1, 1, 2 / 3, 2 / 3, 1 / 3, 1 / 3, 0, 0], rtol=1e-6
+    )
+    np.testing.assert_array_equal(ladder_fracs(1, 4), [1, 1, 1, 1])
+    opts = AnnealOptions(t0=0.3, t1=1e-4, n_temps=4)
+    ends = ladder_end_temps(opts)
+    assert ends[0] == pytest.approx(1e-4) and ends[-1] == pytest.approx(0.3)
+    assert all(a < b for a, b in zip(ends, ends[1:]))  # geometric, rising
+
+
+# ----- exchange preserves search invariants end to end -----------------------
+
+
+def test_ladder_anneal_keeps_invariants_and_improves(model):
+    res = anneal(
+        model, CFG, DEFAULT_GOAL_ORDER,
+        dataclasses.replace(CHUNKED, n_temps=4, exchange_interval=1),
+    )
+    assert res.improved
+    verify_optimization(model, res.model, CFG)
+
+
+# ----- plateau-exit still fires under the ladder -----------------------------
+
+
+def test_plateau_exit_fires_under_ladder(model):
+    res = anneal(
+        model, CFG, DEFAULT_GOAL_ORDER,
+        dataclasses.replace(
+            CHUNKED, n_steps=6000, chunk_steps=60, n_temps=4,
+            plateau_window=2,
+        ),
+    )
+    assert res.plateau is not None
+    assert res.plateau["exited"]
+    assert res.plateau["chunksRun"] < res.plateau["chunksBudget"]
+
+
+# ----- round_up_chains: K x ranks multiple, logged once per shape ------------
+
+
+def test_round_up_chains_k_times_ranks(caplog):
+    assert round_up_chains(10, 1, "test", n_temps=4) == 12
+    assert round_up_chains(8, 2, "test", n_temps=4) == 8
+    assert round_up_chains(5, 8, "test") == 8      # legacy behavior intact
+    assert round_up_chains(2, 1, "test") == 2
+    with caplog.at_level(logging.INFO, logger="ccx.search.annealer"):
+        round_up_chains(7, 2, "test", n_temps=3)
+        round_up_chains(7, 2, "test", n_temps=3)   # same shape: logged once
+    msgs = [r for r in caplog.records if "rounding n_chains" in r.message]
+    assert len(msgs) <= 1
+
+
+# ----- bf16 scoring tier -----------------------------------------------------
+
+
+def test_scoring_dtype_gate():
+    assert scoring_dtype(False) == jnp.float32
+    assert scoring_dtype(True) == jnp.bfloat16
+
+
+def test_bf16_scoring_keeps_feasibility(model):
+    """bf16 is a rank-order tier for proposal scoring only — accept and
+    lex stay f32, so a bf16 run must still verify and improve."""
+    res = anneal(
+        model, CFG, DEFAULT_GOAL_ORDER,
+        dataclasses.replace(CHUNKED, bf16_scoring=True),
+    )
+    assert res.improved
+    verify_optimization(model, res.model, CFG)
+
+
+def test_bf16_off_is_bitexact(model):
+    """bf16_scoring=False must be the identity: the casts fold away."""
+    a = anneal(model, CFG, DEFAULT_GOAL_ORDER, CHUNKED)
+    b = anneal(
+        model, CFG, DEFAULT_GOAL_ORDER,
+        dataclasses.replace(CHUNKED, bf16_scoring=False),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.model.assignment), np.asarray(b.model.assignment)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.stack_after.costs), np.asarray(b.stack_after.costs)
+    )

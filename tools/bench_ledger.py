@@ -61,6 +61,14 @@ This tool is the ledger and the tripwire:
   families set (the self-healing-at-warm-latency headline), and a
   recovery-p99 regression >10% per (config, family, windows, seed,
   backend, host_cores, effort) group.
+* exchange: ``EXCHANGE_r*.json`` (the replica-exchange ladder A/B —
+  ``bench.py --exchange-ab``: flat chain batch vs K-rung temperature
+  ladder at the same seeded budget, the K=1 bit-exactness probe and
+  the interval-retune recompile probe) gets a trend section;
+  ``--check`` fails a latest round where the ladder did not beat the
+  flat batch, a non-bit-exact K=1 run, any fresh compile on an
+  exchange-interval retune, or an unverified line — the ladder's
+  contract points are gates, not trends.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -1276,6 +1284,141 @@ def render_scenario(scrows: list[dict], partials: list[dict]) -> str:
     return "\n".join(out)
 
 
+# ----- replica exchange (EXCHANGE_r*.json) -----------------------------------
+
+
+def load_exchange(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``EXCHANGE_r*.json`` under ``root`` —
+    the ``bench.py --exchange-ab`` artifact: seeded CPU A/B of the flat
+    SA chain batch vs the replica-exchange ladder at the same chain and
+    step budget, plus the K=1 bit-exactness probe and the retune
+    recompile probe measured in the same round."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "EXCHANGE_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("exchange_ab"):
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed exchange line (rc={wrapper.get('rc')})",
+            })
+            continue
+        flat = line.get("flat") or {}
+        lad = line.get("ladder") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "bench": line.get("bench", "?"),
+            "backend": str(line.get("backend", "?")),
+            "chains": line.get("chains"),
+            "steps": line.get("steps"),
+            "chunk": line.get("chunk"),
+            "n_temps": line.get("n_temps"),
+            "interval": line.get("interval"),
+            "seed": line.get("seed"),
+            "flat_wall": flat.get("wall_s"),
+            "flat_plateau": flat.get("plateau_chunk"),
+            "ladder_wall": lad.get("wall_s"),
+            "ladder_plateau": lad.get("plateau_chunk"),
+            "reached": lad.get("reached_flat_plateau_chunk"),
+            "accept_rate": lad.get("exchange_accept_rate"),
+            "ladder_better": bool(line.get("ladder_better")),
+            "k1_bitexact": bool(line.get("k1_bitexact")),
+            "fresh_compiles": line.get("fresh_compiles_on_retune"),
+            "verified": bool(line.get("verified")),
+        })
+    return rows, partials
+
+
+def exchange_group_key(row: dict) -> str:
+    """Exchange rows compare at identical (bench, chains, steps, chunk,
+    n_temps, interval, seed, backend) — the A/B verdict is only
+    meaningful against the same seeded budget and ladder shape."""
+    return json.dumps(
+        [row["bench"], row["chains"], row["steps"], row["chunk"],
+         row["n_temps"], row["interval"], row["seed"], row["backend"]],
+        sort_keys=True,
+    )
+
+
+def check_exchange(xrows: list[dict]) -> list[str]:
+    """The exchange gate (the ladder's three contract points are GATES,
+    not trends): in the LATEST banked exchange round, a line where the
+    ladder did not beat the flat batch fails, a K=1 run that is not
+    bit-exact against the legacy flat path fails, ANY fresh compile on
+    an exchange-interval retune fails (the interval is traced data, a
+    retune must reuse the cached program), and an unverified line
+    fails."""
+    failures: list[str] = []
+    if not xrows:
+        return failures
+    latest_round = max(r["round"] for r in xrows)
+    for r in (r for r in xrows if r["round"] == latest_round):
+        tag = f"exchange round {r['round']} {r['bench']}"
+        if not r["ladder_better"]:
+            failures.append(
+                f"{tag}: replica-exchange ladder (K={r['n_temps']}) did "
+                "NOT beat the flat chain batch at the same budget"
+            )
+        if not r["k1_bitexact"]:
+            failures.append(
+                f"{tag}: K=1 ladder is NOT bit-exact vs the legacy flat "
+                "path (the degenerate ladder must trace the same program)"
+            )
+        if r["fresh_compiles"]:
+            failures.append(
+                f"{tag}: {r['fresh_compiles']} fresh compile(s) on an "
+                "exchange-interval retune — the interval must stay "
+                "traced data"
+            )
+        if not r["verified"]:
+            failures.append(f"{tag}: UNVERIFIED exchange line banked")
+    return failures
+
+
+def render_exchange(xrows: list[dict], partials: list[dict]) -> str:
+    """The replica-exchange section of the trend table."""
+    if not xrows and not partials:
+        return ""
+    out = ["", "replica exchange A/B (EXCHANGE_r*.json):"]
+    headers = ["round", "bench", "K", "chains", "steps", "backend",
+               "flat plat", "ladder plat", "reached", "accept",
+               "better", "K=1 exact", "retune", "ok"]
+    body = []
+    for r in sorted(xrows, key=lambda r: r["round"]):
+        body.append([
+            _fmt(r["round"], 0), r["bench"], _fmt(r["n_temps"], 0),
+            _fmt(r["chains"], 0), _fmt(r["steps"], 0),
+            r["backend"],
+            _fmt(r["flat_plateau"], 0), _fmt(r["ladder_plateau"], 0),
+            _fmt(r["reached"], 0),
+            "-" if r["accept_rate"] is None
+            else f"{r['accept_rate'] * 100:.0f}%",
+            "yes" if r["ladder_better"] else "NO",
+            "yes" if r["k1_bitexact"] else "NO",
+            "0" if not r["fresh_compiles"] else f"{r['fresh_compiles']}!",
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 # ----- trend table -----------------------------------------------------------
 
 
@@ -1557,6 +1700,7 @@ def main(argv=None) -> int:
     wrows, wpartials = load_wire(root)
     crows, cpartials = load_chaos(root)
     scrows, scpartials = load_scenario(root)
+    xrows, xpartials = load_exchange(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
@@ -1567,6 +1711,7 @@ def main(argv=None) -> int:
             "wire": wrows, "wirePartials": wpartials,
             "chaos": crows, "chaosPartials": cpartials,
             "scenario": scrows, "scenarioPartials": scpartials,
+            "exchange": xrows, "exchangePartials": xpartials,
         }, indent=1))
         return 0
     if args.roofline:
@@ -1578,7 +1723,7 @@ def main(argv=None) -> int:
             + check_fleet(frows) + check_steady(srows)
             + check_steadyfleet(sfrows)
             + check_wire(wrows) + check_chaos(crows)
-            + check_scenario(scrows)
+            + check_scenario(scrows) + check_exchange(xrows)
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
@@ -1595,6 +1740,7 @@ def main(argv=None) -> int:
               f"steady line(s), {len(sfrows)} steady-fleet line(s), "
               f"{len(wrows)} wire line(s), {len(crows)} "
               f"chaos line(s), {len(scrows)} scenario family row(s), "
+              f"{len(xrows)} exchange A/B line(s), "
               "no regression vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
@@ -1605,10 +1751,11 @@ def main(argv=None) -> int:
     wi = render_wire(wrows, wpartials)
     ch = render_chaos(crows, cpartials)
     sn = render_scenario(scrows, scpartials)
+    xn = render_exchange(xrows, xpartials)
     print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
           + (("\n" + st) if st else "") + (("\n" + sf) if sf else "")
           + (("\n" + wi) if wi else "") + (("\n" + ch) if ch else "")
-          + (("\n" + sn) if sn else ""))
+          + (("\n" + sn) if sn else "") + (("\n" + xn) if xn else ""))
     return 0
 
 

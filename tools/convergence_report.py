@@ -48,6 +48,7 @@ if _REPO not in sys.path:  # standalone runs start with tools/ as path[0]
 
 from ccx.common.convergence import (  # noqa: E402
     WASTE_WARN,
+    ladder_summary,
     phase_table,
     plateau_chunk,
     total_wasted_fraction,
@@ -74,6 +75,10 @@ def _load_lines(root: str, paths: list[str]) -> list[dict]:
             # window's convergence block rides the line, so the advisor
             # prices warm-start plateau budgets next to the cold rungs'
             + glob.glob(os.path.join(root, "STEADY_r*.json"))
+            # EXCHANGE_r*.json (bench --exchange-ab, ISSUE 16): the
+            # ladder arm's convergence block rides the line, so the
+            # exchange-acceptance gauge prints next to the plateau table
+            + glob.glob(os.path.join(root, "EXCHANGE_r*.json"))
         )
     rows: list[dict] = []
     for path in paths:
@@ -112,6 +117,18 @@ def _load_lines(root: str, paths: list[str]) -> list[dict]:
     return rows
 
 
+def _ladder_rows(convergence: dict) -> list[dict]:
+    """Per-phase replica-exchange roll-ups (empty for flat runs)."""
+    rows: list[dict] = []
+    for phase, segs in (convergence.get("phases") or {}).items():
+        for s in segs:
+            ls = ladder_summary(s)
+            if ls:
+                ls["phase"] = phase
+                rows.append(ls)
+    return rows
+
+
 def analyze(rows: list[dict]) -> list[dict]:
     out = []
     for r in rows:
@@ -121,6 +138,7 @@ def analyze(rows: list[dict]) -> list[dict]:
             "backend": r.get("backend"),
             "wall": r.get("wall"),
             "phases": phase_table(r["convergence"]),
+            "ladder": _ladder_rows(r["convergence"]),
             "totalWastedFraction": round(
                 total_wasted_fraction(r["convergence"]), 4
             ),
@@ -176,6 +194,23 @@ def render(analyzed: list[dict]) -> str:
             f"  total: {tw * 100:.0f}% of chunk budget spent past "
             f"plateau{flag}"
         )
+        for ls in a.get("ladder") or []:
+            geom = ""
+            if ls.get("nTemps"):
+                geom = (
+                    f"K={ls['nTemps']}"
+                    + (f" x{ls['rungSize']} chains" if ls.get("rungSize")
+                       else "")
+                    + (f", every {ls['interval']} chunk(s)"
+                       if ls.get("interval") else "")
+                    + ": "
+                )
+            out.append(
+                f"  exchange ladder [{ls['phase']}] {geom}"
+                f"{ls['accepted']}/{ls['attempted']} pairs swapped "
+                f"({ls['acceptRate'] * 100:.0f}% accept over "
+                f"{ls['sweeps']} sweeps; 20-40% is the healthy band)"
+            )
         out.append(
             "  proposed = budget units through the plateau chunk x1.25, "
             "capped at the configured budget"

@@ -178,6 +178,17 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # window's repair/warm-SA phases leave their span trail.
   CCX_BENCH_SCENARIO=1 timeout -k 60 2400 python bench.py
   echo "scenario rc=$?"
+  echo "--- replica-exchange rung (temperature-ladder A/B; EXCHANGE artifact) ---"
+  # the replica-exchange ladder (ISSUE 16): flat SA chain batch vs the
+  # K-rung temperature ladder at the same seeded chain/step budget —
+  # chunks-to-plateau and final lex quality side by side, plus the K=1
+  # bit-exactness probe (the degenerate ladder must trace the legacy
+  # program) and the interval-retune probe (the exchange interval is
+  # traced data; retuning it must hit the compile cache). Banks the
+  # EXCHANGE artifact the ledger gates on ladder_better / k1_bitexact /
+  # zero fresh compiles.
+  CCX_BENCH_EXCHANGE=1 timeout -k 60 2400 python bench.py
+  echo "exchange rc=$?"
   echo "--- wire / result-path rung (streamed columnar warm round-trips; WIRE artifact) ---"
   # the result-path split (ISSUE 11): warm end-to-end sidecar round-trip
   # with the optimizer excluded — snapshot-up / diff / assembly /
