@@ -73,6 +73,16 @@ path, gated on 100% recovered-and-verified windows, zero stuck
 scheduler jobs, zero leaked registry/placement entries, bounded
 recovery latency, and a zero-fresh-compile disarmed epilogue
 (CCX_BENCH_CHAOS_ITERS windows, default 14; CCX_FAULTS_SEED).
+``--plan`` / CCX_BENCH_PLAN runs the movement-planning A/B (PLAN_r*.json
+artifact; ccx.search.movement): the wave planner vs the legacy
+executor's naive greedy batching, priced under the same round-barrier
+fluid model — planned-vs-naive makespan and peak per-broker inflow on
+the cold B5 diff AND across the disk-full-evacuation scenario family
+(CCX_PLAN_EVAC_BENCH base, default B3), plus the warm re-plan-on-delta
+loop measured at ZERO fresh compiles and the device planner pinned
+bit-exact to the numpy oracle (CCX_PLAN_CAP / CCX_PLAN_MAX_WAVES /
+CCX_PLAN_WAVE_BYTES_MB / CCX_PLAN_THROTTLE_MBPS / CCX_PLAN_SEED /
+CCX_PLAN_EVAC_WINDOWS).
 ``--scenario`` / CCX_BENCH_SCENARIO runs the adversarial scenario corpus
 (SCENARIO_r*.json artifact; ccx.bench.scenarios): every family —
 cascading broker failures, disk-full evacuation, hot-topic skew, broker
@@ -2903,6 +2913,321 @@ def run_exchange_ab(name: str) -> None:
     print(_state["final_json"], flush=True)
 
 
+def run_plan(name: str, evac_name: str, evac_windows: int) -> None:
+    """``--plan`` / CCX_BENCH_PLAN: the movement-planning A/B (ISSUE 17)
+    — the PLAN_r*.json artifact ``tools/bench_ledger.py`` trends and
+    gates.
+
+    Both arms price the SAME schedule model (the round-barrier fluid
+    model in ``ccx.search.movement``: a wave/batch completes before the
+    next starts, duration = the slowest broker's max(in, out) bytes over
+    the throttle rate), so the numbers are directly comparable:
+
+    1. COLD DIFF A/B on the ``name`` fixture (default B5): one
+       smoke-budget optimize with the planner armed, then the wave
+       planner (compiled device program, pinned bit-exact against the
+       numpy oracle on every output array) vs ``naive_schedule`` — the
+       legacy executor's task-id greedy under the same per-broker cap;
+    2. WARM RE-PLAN LOOP: wave 0 lands as a delta (applied to the
+       assignment), re-diff, re-plan the remainder — run once as prewarm
+       (the shrinking diff walks the pow2 row buckets and compiles each
+       once), then run AGAIN measured with a compilestats probe that
+       must report ZERO fresh compiles;
+    3. EVACUATION FAMILY A/B: the disk-full-evacuation scenario family
+       (``ccx.bench.scenarios``) on the ``evac_name`` base — per
+       cumulative window: graft the previous window's converged
+       placement, smoke optimize, planned-vs-naive on that window's
+       diff; the family aggregate (total makespan, max peak inflow) is
+       the gate — this is exactly the workload class where scheduling
+       dominates recovery time.
+
+    ``verified`` = planned beats (<=) naive on makespan AND peak inflow
+    for both the cold diff and the evacuation aggregate, device==oracle
+    bit-exact, every optimize verified, zero fresh compiles in the
+    measured re-plan loop.
+    """
+    import dataclasses as _dc
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from ccx.bench import scenarios as sc
+    from ccx.common import compilestats
+    from ccx.common.resources import Resource
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.model.snapshot import arrays_to_model, model_to_arrays
+    from ccx.optimizer import optimize
+    from ccx.proposals import diff_columnar
+    from ccx.search.movement import (
+        PlanOptions,
+        movement_cost,
+        naive_schedule,
+        plan_movement,
+    )
+
+    cap = int(os.environ.get("CCX_PLAN_CAP", "5"))
+    max_waves = int(os.environ.get("CCX_PLAN_MAX_WAVES", "64"))
+    wave_mb = float(os.environ.get("CCX_PLAN_WAVE_BYTES_MB", "0"))
+    throttle = float(os.environ.get("CCX_PLAN_THROTTLE_MBPS", "0"))
+    seed = int(os.environ.get("CCX_PLAN_SEED", "7"))
+    eps = 1e-3
+
+    popts_dev = PlanOptions(
+        broker_cap=cap, wave_bytes=wave_mb, max_waves=max_waves,
+        throttle_mb_per_sec=throttle, backend="device",
+    )
+    popts_np = _dc.replace(popts_dev, backend="numpy")
+
+    def plan_brief(plan) -> dict:
+        return {
+            "nWaves": int(plan.n_waves),
+            "nMoves": plan.n_moves,
+            "bytesMoved": round(plan.bytes_moved, 3),
+            "peakInflowMb": round(plan.peak_inflow, 3),
+            "makespanSeconds": round(plan.makespan_seconds, 3),
+            "overflowRows": int(plan.overflow_rows),
+            "backend": plan.backend,
+        }
+
+    def ab(dcols, bytes_pp, B: int, popts) -> tuple:
+        """One planned-vs-naive A/B: (plan, oracle-match, result dict)."""
+        t0 = _time.monotonic()
+        plan = plan_movement(dcols, bytes_pp, B, popts)
+        plan_wall = _time.monotonic() - t0
+        oracle = plan_movement(dcols, bytes_pp, B, popts_np)
+        match = bool(
+            np.array_equal(plan.wave, oracle.wave)
+            and np.array_equal(plan.wave_bytes, oracle.wave_bytes)
+            and np.array_equal(plan.wave_inflow_peak, oracle.wave_inflow_peak)
+            and np.array_equal(
+                plan.wave_outflow_peak, oracle.wave_outflow_peak
+            )
+        )
+        naive = naive_schedule(
+            dcols, bytes_pp, B, cap=cap, throttle_mb_per_sec=throttle
+        )
+        better = bool(
+            plan.makespan_seconds <= naive["makespanSeconds"] + eps
+            and plan.peak_inflow <= naive["peakInflowMb"] + eps
+        )
+        cols = dcols.cols if hasattr(dcols, "cols") else dcols
+        out = {
+            "rows": int(np.asarray(cols["partition"]).shape[0]),
+            "planned": plan_brief(plan),
+            "naive": {
+                "rounds": naive["rounds"],
+                "makespanSeconds": round(naive["makespanSeconds"], 3),
+                "peakInflowMb": round(naive["peakInflowMb"], 3),
+                "nMoves": naive["nMoves"],
+            },
+            "planned_better": better,
+            "oracle_match": match,
+            "plan_wall_s": round(plan_wall, 3),
+        }
+        return plan, match, out
+
+    # ----- 1. cold diff A/B ------------------------------------------------
+    enter_phase(f"plan:{name}:cold")
+    m0 = random_cluster(bench_spec(name))
+    goal_names, oopts, _ = build_opts(name, "smoke")
+    oopts = _dc.replace(
+        oopts, plan_enabled=True, plan_broker_cap=cap,
+        plan_max_waves=max_waves, plan_wave_bytes_mb=wave_mb,
+        plan_throttle_mb_per_sec=throttle,
+    )
+    t0 = _time.monotonic()
+    res = optimize(m0, goal_names=goal_names, opts=oopts)
+    cold_s = _time.monotonic() - t0
+    bytes_pp = np.asarray(m0.leader_load[Resource.DISK], np.float32)
+    B = int(m0.B)
+    log(f"[plan] cold optimize {cold_s:.1f}s diff rows {res.diff.n} "
+        f"verified={res.verification.ok} "
+        f"shipped plan: {res.plan.summary_json() if res.plan else None}")
+
+    enter_phase(f"plan:{name}:ab")
+    plan0, cold_match, cold_ab = ab(res.diff, bytes_pp, B, popts_dev)
+    log(f"[plan] cold A/B planned {cold_ab['planned']['makespanSeconds']} "
+        f"vs naive {cold_ab['naive']['makespanSeconds']} (makespan), "
+        f"peak {cold_ab['planned']['peakInflowMb']} vs "
+        f"{cold_ab['naive']['peakInflowMb']}, oracle_match={cold_match}")
+
+    # the movement-cost lex tier's own oracle check (f32 device
+    # reductions vs f64 host sums: relative tolerance, not bit-exact)
+    bm_d, pk_d = movement_cost(m0, res.model, backend="device")
+    bm_n, pk_n = movement_cost(m0, res.model, backend="numpy")
+    cost_match = bool(
+        abs(bm_d - bm_n) <= 1e-3 * max(abs(bm_n), 1.0)
+        and abs(pk_d - pk_n) <= 1e-3 * max(abs(pk_n), 1.0)
+    )
+
+    # ----- 2. warm re-plan loop (zero fresh compiles) ----------------------
+    def replan_loop() -> tuple:
+        """Apply wave 0 as a delta, re-diff, re-plan — until only
+        zero-byte rows (leader/disk-only) remain. Deterministic, so the
+        prewarm run and the measured run walk identical row buckets."""
+        import jax.numpy as jnp
+
+        a_cur = np.asarray(m0.assignment).copy()
+        dcols = diff_columnar(m0, res.model)
+        plan = plan_movement(dcols, bytes_pp, B, popts_dev)
+        iters = 0
+        walls: list[float] = []
+        while plan.n_waves > 1 and iters < 2 * max_waves:
+            part = np.asarray(dcols["partition"])
+            new = np.asarray(dcols["newReplicas"])
+            w0 = np.asarray(plan.wave) == 0
+            a_cur[part[w0], : new.shape[1]] = new[w0]
+            mid = m0.replace(assignment=jnp.asarray(a_cur))
+            dcols = diff_columnar(mid, res.model)
+            t0 = _time.monotonic()
+            plan = plan_movement(dcols, bytes_pp, B, popts_dev)
+            walls.append(_time.monotonic() - t0)
+            iters += 1
+        return iters, walls
+
+    enter_phase(f"plan:{name}:replan-prewarm")
+    prewarm_iters, _ = replan_loop()
+    enter_phase(f"plan:{name}:replan")
+    cs0 = compilestats.snapshot()
+    t0 = _time.monotonic()
+    replan_iters, replan_walls = replan_loop()
+    replan_s = _time.monotonic() - t0
+    fresh = compilestats.delta(cs0, compilestats.snapshot()).get(
+        "backend_compiles", 0
+    )
+    log(f"[plan] re-plan loop {replan_iters} iters {replan_s:.2f}s "
+        f"fresh_compiles={fresh}")
+
+    # ----- 3. disk-full-evacuation family A/B ------------------------------
+    enter_phase(f"plan:{evac_name}:evac-base")
+    m_e = random_cluster(bench_spec(evac_name))
+    egoals, eopts, _ = build_opts(evac_name, "smoke")
+    eopts = _dc.replace(
+        eopts, plan_enabled=True, plan_broker_cap=cap,
+        plan_max_waves=max_waves, plan_wave_bytes_mb=wave_mb,
+        plan_throttle_mb_per_sec=throttle,
+    )
+    res_clean = optimize(m_e, goal_names=egoals, opts=eopts)
+    applied = model_to_arrays(res_clean.model)
+    sopts = sc.ScenarioOptions(
+        seed=seed, windows=evac_windows, families=("disk-evacuation",),
+    )
+    cur = {
+        k: applied[k] for k in ("assignment", "leader_slot", "replica_disk")
+    }
+    windows_out: list[dict] = []
+    evac_ok = bool(res_clean.verification.ok)
+    evac_oracle = True
+    planned_ms = naive_ms = 0.0
+    planned_pk = naive_pk = 0.0
+    n_move_windows = 0
+    enter_phase(f"plan:{evac_name}:evac")
+    for w in sc.generate("disk-evacuation", applied, sopts):
+        arrays = dict(w.arrays)
+        arrays.update(cur)  # cumulative: previous window's placement
+        m_w = arrays_to_model(arrays)
+        r = optimize(m_w, goal_names=egoals, opts=eopts)
+        out_arrays = model_to_arrays(r.model)
+        cur = {
+            k: out_arrays[k]
+            for k in ("assignment", "leader_slot", "replica_disk")
+        }
+        evac_ok = evac_ok and bool(r.verification.ok)
+        row = {"label": w.label, "rows": int(r.diff.n),
+               "verified": bool(r.verification.ok)}
+        if r.diff.n:
+            bytes_w = np.asarray(
+                m_w.leader_load[Resource.DISK], np.float32
+            )
+            _, match_w, ab_w = ab(r.diff, bytes_w, int(m_w.B), popts_np)
+            row.update(ab_w)
+            evac_oracle = evac_oracle and match_w
+            planned_ms += ab_w["planned"]["makespanSeconds"]
+            naive_ms += ab_w["naive"]["makespanSeconds"]
+            planned_pk = max(planned_pk, ab_w["planned"]["peakInflowMb"])
+            naive_pk = max(naive_pk, ab_w["naive"]["peakInflowMb"])
+            n_move_windows += 1
+        windows_out.append(row)
+        log(f"[plan] evac window {w.label!r}: rows {row['rows']} "
+            f"planned {row.get('planned', {}).get('makespanSeconds')} "
+            f"naive {row.get('naive', {}).get('makespanSeconds')}")
+    evac_better = bool(
+        n_move_windows >= 1
+        and planned_ms <= naive_ms + eps
+        and planned_pk <= naive_pk + eps
+    )
+
+    planned_better = bool(cold_ab["planned_better"] and evac_better)
+    oracle_match = bool(cold_match and evac_oracle and cost_match)
+    verified = bool(
+        planned_better and oracle_match and int(fresh) == 0
+        and res.verification.ok and evac_ok
+    )
+    out = {
+        "plan": True,
+        "rung": "plan",
+        "bench": name,
+        "backend": jax.default_backend(),
+        "broker_cap": cap,
+        "max_waves": max_waves,
+        "wave_bytes_mb": wave_mb,
+        "throttle_mb_per_sec": throttle,
+        "seed": seed,
+        # headline = the planned cold-diff makespan (relative byte units
+        # at throttle<=0) — the number the ledger trends for regressions
+        "value": cold_ab["planned"]["makespanSeconds"],
+        "cold_s": round(cold_s, 3),
+        "cold_verified": bool(res.verification.ok),
+        "cold_ab": cold_ab,
+        "cost_tier": {
+            "device": [round(bm_d, 3), round(pk_d, 3)],
+            "numpy": [round(bm_n, 3), round(pk_n, 3)],
+            "match": cost_match,
+        },
+        "replan": {
+            "iters": int(replan_iters),
+            "prewarm_iters": int(prewarm_iters),
+            "wall_s": round(replan_s, 3),
+            "plan_walls_s": [round(x, 4) for x in replan_walls],
+            "fresh_compiles": int(fresh),
+        },
+        "evacuation": {
+            "bench": evac_name,
+            "windows": windows_out,
+            "move_windows": n_move_windows,
+            "planned_makespan": round(planned_ms, 3),
+            "naive_makespan": round(naive_ms, 3),
+            "planned_peak": round(planned_pk, 3),
+            "naive_peak": round(naive_pk, 3),
+            "planned_better": evac_better,
+            "verified": evac_ok,
+        },
+        "planned_better": planned_better,
+        "oracle_match": oracle_match,
+        "fresh_compiles_in_replan": int(fresh),
+        "verified": verified,
+    }
+    line = json.dumps(out)
+    import glob as _glob
+    import re as _re
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(mt.group(1))
+        for p in _glob.glob(os.path.join(repo, "PLAN_r*.json"))
+        if (mt := _re.match(r"PLAN_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    n_round = max(rounds, default=0) + 1
+    path = os.path.join(repo, f"PLAN_r{n_round:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n_round, "parsed": out}, f)
+    log(f"[plan] banked {path}")
+    _state["done"] = True
+    _state["final_json"] = line
+    print(_state["final_json"], flush=True)
+
+
 def main() -> None:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
@@ -2963,6 +3288,13 @@ def main() -> None:
     ap.add_argument("--exchange-ab", action="store_true",
                     default=os.environ.get("CCX_BENCH_EXCHANGE") not in
                     (None, "", "0"))
+    ap.add_argument("--plan", action="store_true",
+                    default=os.environ.get("CCX_BENCH_PLAN") not in
+                    (None, "", "0"))
+    ap.add_argument(
+        "--plan-evac-windows", type=int,
+        default=int(os.environ.get("CCX_PLAN_EVAC_WINDOWS", "4")),
+    )
     ap.add_argument("--scenario", action="store_true",
                     default=os.environ.get("CCX_BENCH_SCENARIO") not in
                     (None, "", "0"))
@@ -2996,6 +3328,22 @@ def main() -> None:
         name = os.environ.get("CCX_BENCH", "B3")
         _state["name"] = name
         run_exchange_ab(name)
+        return
+
+    if cli.plan:
+        # movement-planning mode (PLAN_r*.json artifact): the wave
+        # planner vs the legacy executor's naive greedy batching on the
+        # cold diff and the disk-full-evacuation family, plus the
+        # zero-compile warm re-plan loop and the device/oracle pin.
+        # Persistent compile cache like the ladder.
+        enable_compile_cache()
+        name = os.environ.get("CCX_BENCH", "B5")
+        _state["name"] = name
+        run_plan(
+            name,
+            evac_name=os.environ.get("CCX_PLAN_EVAC_BENCH", "B3"),
+            evac_windows=max(cli.plan_evac_windows, 1),
+        )
         return
 
     if cli.scenario:

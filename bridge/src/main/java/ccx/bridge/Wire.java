@@ -55,6 +55,13 @@ public final class Wire {
    */
   public static final String FIELD_RESULT_SEGMENT = "resultSegment";
 
+  // Movement plan (round 20, additive: absent fields mean the Propose ran
+  // plan-off — pre-round-20 decoding is unchanged).
+  /** Result field carrying the wave schedule as one canonical msgpack blob. */
+  public static final String FIELD_PLAN_COLUMNAR = "planColumnar";
+  /** CRC32 of the plan blob (verify when present, like the proposals crc). */
+  public static final String FIELD_PLAN_COLUMNAR_CRC32 = "planColumnarCrc32";
+
   // Structured error codes (error-frame "code" / INVALID_ARGUMENT prefix).
   public static final String ERR_UNSUPPORTED_VERSION = "unsupported-wire-version";
   public static final String ERR_MALFORMED = "malformed-request";

@@ -501,6 +501,48 @@ def analyzer_config_def() -> ConfigDef:
              "broker-failures, disk-evacuation, hot-skew, broker-wave, "
              "partition-change). Env twin: CCX_SCENARIO_FAMILIES "
              "(comma-separated).")
+    d.define("optimizer.plan.enabled", Type.BOOLEAN, False, Importance.LOW,
+             "Movement planning (ccx.search.movement, ISSUE 17): wave-"
+             "schedule every proposal's columnar diff into throttle-"
+             "respecting execution waves (per-broker concurrent-move caps "
+             "+ per-wave byte budgets) and surface the schedule as the "
+             "additive OptimizerResult.plan block the executor consumes "
+             "(wave = batch). Off (default) is bit-exact with the "
+             "pre-plan pipeline and compiles nothing new; warm windows "
+             "re-plan the remaining waves as completions arrive as delta "
+             "snapshots.")
+    d.define("optimizer.plan.cost.tier", Type.BOOLEAN, False,
+             Importance.LOW,
+             "Append the movement-cost tier to the lexicographic "
+             "portfolio adoption: a quality TIE between candidate "
+             "placements resolves toward the one moving fewer bytes / "
+             "pressing brokers less (bytes moved, then peak per-broker "
+             "inbound bytes, computed on device from the same assignment "
+             "tensors the columnar diff masks). Off (default) keeps the "
+             "plain lex rule bit-exact and never compiles the cost "
+             "program.")
+    d.define("optimizer.plan.max.waves", Type.INT, 64, Importance.LOW,
+             "Wave-axis size of the compiled scheduler state (static "
+             "program shape — changing it recompiles the planner; caps "
+             "and budgets below are traced data and retune for free). A "
+             "diff that fits no feasible wave overflows into the last "
+             "one and is reported (plan.overflowRows).", at_least(2))
+    d.define("optimizer.plan.broker.cap", Type.INT, 5, Importance.LOW,
+             "Per-broker concurrent-move cap per wave (source or "
+             "destination), the planning image of "
+             "num.concurrent.partition.movements.per.broker / the "
+             "concurrency adjuster's live cap. Traced data.", at_least(1))
+    d.define("optimizer.plan.wave.bytes.mb", Type.DOUBLE, 0.0,
+             Importance.LOW,
+             "Per-broker per-wave byte budget in model load units (MB) — "
+             "the ReplicationThrottleHelper image: at throttle rate R "
+             "and target wave duration T set ~R*T. <=0 = uncapped "
+             "(count caps only). Traced data.")
+    d.define("optimizer.plan.throttle.mbps", Type.DOUBLE, 0.0,
+             Importance.LOW,
+             "Per-broker replication rate (MB/s) pricing the projected "
+             "wave durations (plan.waveSeconds / makespanSeconds). <=0 "
+             "reports relative byte units. Traced data.")
     d.define("optimizer.repair.backend", Type.STRING, "device",
              Importance.LOW,
              "hard_repair loop driver: 'device' runs the whole sweep loop "

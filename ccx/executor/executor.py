@@ -92,6 +92,15 @@ class ExecutionConcurrencyManager:
         ]
         #: returns {broker_id: {metric_name: value}} of recent broker health
         self.broker_metrics_fn = broker_metrics_fn
+        self.adjustments_up = 0
+        self.adjustments_down = 0
+        self.last_adjustment = "none"
+        REGISTRY.set_gauge(
+            "executor.concurrency-cap", self.cap,
+            labels={"type": "inter-broker"},
+            help="Current per-broker concurrent movement cap "
+                 "(auto-tuned by the concurrency adjuster)",
+        )
 
     def adjust(self, metadata: ClusterMetadata) -> int:
         if not self.enabled:
@@ -105,11 +114,43 @@ class ExecutionConcurrencyManager:
                 if vals.get("UNDER_REPLICATED_PARTITIONS", 0) > 0:
                     unhealthy = True
                     break
+        prev = self.cap
         if unhealthy:
             self.cap = max(self.min_cap, self.cap // 2)
         else:
             self.cap = min(self.max_cap, self.cap + 1)
+        if self.cap < prev:
+            self.adjustments_down += 1
+            self.last_adjustment = "down"
+            REGISTRY.counter(
+                "executor.concurrency-adjust-down",
+                "Concurrency-adjuster cap decreases (cluster unhealthy)",
+            ).inc()
+        elif self.cap > prev:
+            self.adjustments_up += 1
+            self.last_adjustment = "up"
+            REGISTRY.counter(
+                "executor.concurrency-adjust-up",
+                "Concurrency-adjuster cap increases (cluster healthy)",
+            ).inc()
+        REGISTRY.set_gauge(
+            "executor.concurrency-cap", self.cap,
+            labels={"type": "inter-broker"},
+            help="Current per-broker concurrent movement cap "
+                 "(auto-tuned by the concurrency adjuster)",
+        )
         return self.cap
+
+    def observability_json(self) -> dict:
+        return {
+            "enabled": bool(self.enabled),
+            "cap": self.cap,
+            "minCap": self.min_cap,
+            "maxCap": self.max_cap,
+            "adjustmentsUp": self.adjustments_up,
+            "adjustmentsDown": self.adjustments_down,
+            "lastAdjustment": self.last_adjustment,
+        }
 
 
 class Executor:
@@ -154,6 +195,24 @@ class Executor:
             out["triggeredUserTaskId"] = self._last_uuid
         return out
 
+    def observability_json(self) -> dict:
+        """The ``executor`` block on GET /observability: live state, the
+        concurrency adjuster's auto-tune trail, and whether the current (or
+        last) execution is consuming a device-scheduled movement plan."""
+        wave_map = (
+            self._manager.planner.wave_by_partition
+            if self._manager is not None else {}
+        )
+        return {
+            "state": self._state.value,
+            "concurrency": self.concurrency.observability_json(),
+            "plan": {
+                "consuming": bool(wave_map),
+                "waves": (max(wave_map.values()) + 1) if wave_map else 0,
+                "plannedPartitions": len(wave_map),
+            },
+        }
+
     # ----- entry (ref executeProposals) ------------------------------------
 
     def execute_proposals(
@@ -163,6 +222,7 @@ class Executor:
         uuid: str | None = None,
         replication_throttle: int | None = None,
         background: bool = False,
+        plan: object | None = None,
     ) -> ExecutionTaskManager:
         if not self._reservation.acquire(blocking=False):
             raise OngoingExecutionException(
@@ -178,7 +238,7 @@ class Executor:
                 else self.config["default.replication.throttle"]
             )
             self._manager = ExecutionTaskManager(
-                proposals, self.strategy, self.caps, metadata
+                proposals, self.strategy, self.caps, metadata, plan=plan
             )
         except BaseException:
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
@@ -212,8 +272,11 @@ class Executor:
         assert mgr is not None
         throttle = ReplicationThrottleHelper(self.admin, self._replication_throttle)
         brokers = [b.broker_id for b in mgr.metadata.brokers] if mgr.metadata else []
-        throttle.set_throttles(brokers)
         try:
+            # set_throttles inside the try: if the alter-configs RPC itself
+            # fails, the finally still resets state + releases the
+            # reservation (ref C27 exception-safety around the execute path).
+            throttle.set_throttles(brokers)
             self._state = (
                 ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
             )
@@ -227,9 +290,13 @@ class Executor:
                 self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
                 self._move_leadership(mgr)
         finally:
-            throttle.clear_throttles(brokers)
-            self._state = ExecutorState.NO_TASK_IN_PROGRESS
-            self._reservation.release()
+            # Throttles come off on success AND error paths; state and
+            # reservation recover even when clear_throttles itself raises.
+            try:
+                throttle.clear_throttles(brokers)
+            finally:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                self._reservation.release()
 
     def _abort_pending(self, mgr: ExecutionTaskManager, type_: TaskType) -> None:
         now = self.clock()

@@ -91,12 +91,26 @@ class ExecutionTaskTracker:
 
 
 class ExecutionTaskPlanner:
-    """Hands out ready batches under the caps (ref C24)."""
+    """Hands out ready batches under the caps (ref C24).
+
+    When a device-scheduled movement plan (``ccx.search.movement``) rides the
+    proposal, ``wave_by_partition`` maps dense partition index -> wave, and
+    ``inter_broker_batch`` serves waves as barriers: while any task of wave W
+    is in progress, only waves <= W may start. Per-broker caps and the
+    cluster-wide budget remain as defense in depth; with no plan the batching
+    is exactly the legacy greedy (test-pinned)."""
 
     def __init__(self, strategy: ReplicaMovementStrategy,
-                 caps: ExecutionCaps) -> None:
+                 caps: ExecutionCaps,
+                 wave_by_partition: dict[int, int] | None = None) -> None:
         self.strategy = strategy
         self.caps = caps
+        self.wave_by_partition = wave_by_partition or {}
+
+    def _wave_of(self, task: ExecutionTask) -> int:
+        # Partitions absent from the plan (RF changes folded in later, plan
+        # truncation) default to wave 0 so they are never starved.
+        return self.wave_by_partition.get(int(task.proposal.partition), 0)
 
     def inter_broker_batch(
         self,
@@ -106,7 +120,8 @@ class ExecutionTaskPlanner:
     ) -> list[ExecutionTask]:
         """Next inter-broker tasks to start: strategy order, skipping tasks
         whose source or destination broker is at its concurrent-movement cap,
-        bounded by the cluster-wide in-flight cap."""
+        bounded by the cluster-wide in-flight cap. With a movement plan, the
+        candidate set is first narrowed to the active wave (see class doc)."""
         cap = per_broker_cap if per_broker_cap is not None else self.caps.per_broker_inter
         in_progress = tracker.tasks_of(
             TaskType.INTER_BROKER_REPLICA_ACTION, TaskState.IN_PROGRESS
@@ -121,6 +136,12 @@ class ExecutionTaskPlanner:
             tracker.tasks_of(TaskType.INTER_BROKER_REPLICA_ACTION, TaskState.PENDING),
             metadata,
         )
+        if self.wave_by_partition and pending:
+            if in_progress:
+                active = min(self._wave_of(t) for t in in_progress)
+            else:
+                active = min(self._wave_of(t) for t in pending)
+            pending = [t for t in pending if self._wave_of(t) <= active]
         for t in pending:
             if len(batch) >= budget:
                 break
@@ -171,13 +192,29 @@ class ExecutionTaskManager:
         strategy: ReplicaMovementStrategy,
         caps: ExecutionCaps,
         metadata: ClusterMetadata | None = None,
+        plan: object | None = None,
     ) -> None:
         self.metadata = metadata
         self.tasks = tasks_from_proposals(proposals, metadata)
         self.tracker = ExecutionTaskTracker(self.tasks)
-        self.planner = ExecutionTaskPlanner(strategy, caps)
+        self.planner = ExecutionTaskPlanner(
+            strategy, caps, wave_by_partition=_plan_wave_map(plan)
+        )
 
     def mark(self, tasks: list[ExecutionTask], state: TaskState,
              now_ms: int = -1) -> None:
         for t in tasks:
             t.transition(state, now_ms)
+
+
+def _plan_wave_map(plan: object | None) -> dict[int, int]:
+    """dense partition index -> wave, from a ``MovementPlan`` (or any object
+    exposing int arrays ``partition``/``wave``). ``None``/empty -> {} (legacy
+    greedy batching)."""
+    if plan is None:
+        return {}
+    parts = getattr(plan, "partition", None)
+    waves = getattr(plan, "wave", None)
+    if parts is None or waves is None:
+        return {}
+    return {int(p): int(w) for p, w in zip(parts, waves)}

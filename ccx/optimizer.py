@@ -128,6 +128,13 @@ class OptimizerResult:
     #: computing them costs an aggregate pass + host transfer, which must not
     #: tax callers (bench hot path) that never read the stats.
     input_model: TensorClusterModel | None = None
+    #: movement plan (ccx.search.movement.MovementPlan, ISSUE 17): the
+    #: diff wave-scheduled under executor caps/throttle budgets. Present
+    #: only when ``optimizer.plan.enabled`` — absent ⇒ legacy executor
+    #: greedy batching (fixtures byte-stable). Summary rides ``to_json``
+    #: as the additive ``plan`` block; the row-aligned wave arrays ride
+    #: the columnar result path (``planColumnar``, wire round 20).
+    plan: object | None = None
     #: warm-path only: the f32[6, B] band-pressure DEVICE stack of the
     #: shipped placement under the shipped metrics — the next window's
     #: delta cache, computed by the fused ``incremental.warm_finish``
@@ -254,6 +261,13 @@ class OptimizerResult:
                 k: round(v, 3) for k, v in self.phase_seconds.items()
             },
             "moveCounters": self.move_counters,
+            # additive (wire round 20): present only with the planner
+            # armed (optimizer.plan.enabled) — legacy fixtures byte-stable
+            **(
+                {"plan": self.plan.summary_json()}
+                if self.plan is not None
+                else {}
+            ),
             **({"spanTree": self.span_tree} if self.span_tree else {}),
             **({"costModel": self.cost_model} if self.cost_model else {}),
             **({"mesh": self.mesh} if self.mesh else {}),
@@ -436,6 +450,31 @@ class OptimizeOptions:
     incremental: IncrementalOptions = dataclasses.field(
         default_factory=IncrementalOptions
     )
+    #: movement planning (ccx.search.movement; config ``optimizer.plan.*``):
+    #: wave-schedule the columnar diff into throttle-respecting execution
+    #: waves and surface them as the additive ``OptimizerResult.plan``
+    #: block. Default OFF — the plan-off path is bit-exact with the
+    #: pre-plan pipeline and compiles nothing new.
+    plan_enabled: bool = False
+    #: append the movement-cost tier (bytes moved, peak per-broker inflow
+    #: vs the input placement) to the lexicographic portfolio adoption —
+    #: a quality TIE between candidates resolves toward the cheaper
+    #: schedule. Default OFF (bit-exact; the cost programs never compile).
+    plan_cost_tier: bool = False
+    #: wave-planner shape/limits (PlanOptions mirrors): static wave-axis
+    #: size of the compiled scheduler state — raising it is a new program
+    #: shape, so it is config, not per-request data
+    plan_max_waves: int = 64
+    #: per-broker concurrent-move cap per wave (mirrors
+    #: ``num.concurrent.partition.movements.per.broker``); traced data
+    plan_broker_cap: int = 5
+    #: per-broker per-wave byte budget in model load units (MB), the
+    #: replication-throttle image; <=0 = uncapped (count caps only);
+    #: traced data
+    plan_wave_bytes_mb: float = 0.0
+    #: projected per-broker replication rate for wave-duration seconds;
+    #: <=0 reports relative byte units; traced data (never shape)
+    plan_throttle_mb_per_sec: float = 0.0
 
 
 def prewarm_options(opts: OptimizeOptions) -> OptimizeOptions:
@@ -548,6 +587,65 @@ def _lex_better(a: StackResult, b: StackResult) -> bool:
         if x > y + tol:
             return False
     return False
+
+
+def _movement_lex_better(
+    a_stack, a_model, b_stack, b_model, m, opts: "OptimizeOptions"
+) -> bool:
+    """``_lex_better`` with the movement-cost tier appended (ISSUE 17,
+    ``optimizer.plan.cost.tier``): the quality tiers decide first — only
+    a full lexicographic TIE falls through to (bytes moved, peak
+    per-broker inflow) of each candidate vs the input placement ``m``,
+    so equally-good placements resolve toward the cheaper execution.
+    With the gate off this IS ``_lex_better`` (bit-exact, and the
+    movement-cost program is never traced, let alone compiled)."""
+    if _lex_better(a_stack, b_stack):
+        return True
+    if not opts.plan_cost_tier or _lex_better(b_stack, a_stack):
+        return False
+    from ccx.search.movement import movement_cost
+
+    tol = 1e-6
+    ca = movement_cost(m, a_model)
+    cb = movement_cost(m, b_model)
+    for x, y in zip(ca, cb):
+        if x < y - tol:
+            return True
+        if x > y + tol:
+            return False
+    return False
+
+
+def _compute_plan(m, dcols, opts: "OptimizeOptions"):
+    """The plan phase (``optimizer.plan.enabled``): wave-schedule the
+    shipped diff under executor caps/throttle budgets (ccx.search.
+    movement). Planning is advisory bookkeeping for the executor — any
+    failure logs and ships the proposal without a plan (legacy greedy
+    batching), never fails the optimize."""
+    import numpy as np
+
+    from ccx.common.resources import Resource
+    from ccx.search.movement import PlanOptions, plan_movement
+
+    try:
+        return plan_movement(
+            dcols,
+            np.asarray(m.leader_load[Resource.DISK]),
+            int(m.B),
+            PlanOptions(
+                broker_cap=opts.plan_broker_cap,
+                wave_bytes=opts.plan_wave_bytes_mb,
+                max_waves=opts.plan_max_waves,
+                throttle_mb_per_sec=opts.plan_throttle_mb_per_sec,
+            ),
+        )
+    except Exception:  # noqa: BLE001 — plan must never fail a proposal
+        import logging
+
+        logging.getLogger(__name__).exception(
+            "movement planning failed; shipping proposal without a plan"
+        )
+        return None
 
 
 def optimize(
@@ -861,7 +959,13 @@ def _optimize(
         with _phase("portfolio"):
             cold = greedy_optimize(m, cfg, goal_names, opts.polish)
             _tally(cold, "portfolio")
-            if _lex_better(cold.stack_after, stack_after):
+            # with optimizer.plan.cost.tier armed, a quality tie between
+            # the portfolio candidates resolves toward the one that moves
+            # fewer bytes / presses brokers less (ISSUE 17); off = the
+            # plain lex rule, bit-exact
+            if _movement_lex_better(
+                cold.stack_after, cold.model, stack_after, model, m, opts
+            ):
                 model = cold.model
                 stack_after = cold.stack_after
                 # the returned plan is the cold-greedy one (started from the
@@ -1006,6 +1110,13 @@ def _optimize(
         # the columns ARE the result's canonical representation — rows
         # derive lazily if a consumer asks
         dcols = columnar_diff(m, model)
+    plan = None
+    if opts.plan_enabled:
+        # executor-aware movement planning (ISSUE 17): wave-schedule the
+        # diff where it already lives; additive — plan-off ships today's
+        # exact result and compiles nothing new
+        with _phase("plan"):
+            plan = _compute_plan(m, dcols, opts)
     with _phase("verify"):
         verification = verify_optimization(
             m,
@@ -1086,6 +1197,7 @@ def _optimize(
         mesh=mesh_info,
         convergence=convergence,
         input_model=m,
+        plan=plan,
     )
 
 
@@ -1206,6 +1318,16 @@ def _optimize_warm(
                 bank_press = None  # scanned off the unshipped model
                 n_engine_moves = 0  # moves not in the output
                 info["reverted"] = "verification"
+    plan = None
+    if opts.plan_enabled:
+        # re-plan-on-delta (ISSUE 17): every warm window plans ITS diff —
+        # as each executed wave's completion arrives as a delta snapshot,
+        # the next window's diff covers only the remaining movement, so
+        # the remaining waves are rescheduled fresh under the live caps.
+        # Computed after any verification revert: the plan always covers
+        # the diff that actually ships.
+        with _phase("plan"):
+            plan = _compute_plan(m, dcols, opts)
     if costmodel.capture_enabled() and costmodel.pending_count():
         with _phase("cost-capture", pending=costmodel.pending_count()):
             costmodel.capture_pending()
@@ -1237,6 +1359,7 @@ def _optimize_warm(
         incremental=info,
         input_model=m,
         warm_pressure=bank_press,
+        plan=plan,
     )
 
 

@@ -1,0 +1,642 @@
+"""Movement planning — a device-scheduled execution plan on every proposal.
+
+A proposal's real-world cost is not its final placement but the bytes it
+moves and how long the cluster stays degraded while they move. Today the
+executor batches the columnar diff with a naive host greedy under fixed
+per-broker caps (``ExecutionTaskPlanner.inter_broker_batch``); this module
+turns the same diff into **execution waves** — a throttle-respecting
+schedule computed where the diff already lives (on device), surfaced on
+``OptimizerResult.plan`` and consumed by the executor (wave = batch).
+
+Two planning products:
+
+* ``movement_cost(before, after)`` — the movement-cost tier for the lex
+  objective: (total bytes moved, peak per-broker inbound bytes), computed
+  from the same assignment tensors the columnar diff masks. Gated by
+  ``optimizer.plan.cost.tier``; when the gate is off this module is never
+  imported on the hot path (bit-exact, zero new recompile classes).
+
+* ``plan_movement(diff, ...)`` — the wave planner: orders the diff rows
+  into waves under per-broker concurrent-move caps (mirroring
+  ``ExecutionConcurrencyManager``'s per-broker cap) and per-wave
+  per-broker byte budgets (mirroring ``ReplicationThrottleHelper``'s
+  replication throttle), greedily minimizing makespan and peak inflow:
+  rows in largest-bytes-first (LPT) order, each placed by the
+  lexicographic wave rule in ``_plan_numpy`` — avoid raising the
+  schedule-wide peak inflow, then least bottleneck growth, then lowest
+  resulting destination inflow, earliest wave on full ties. The
+  compiled device program and the numpy reference oracle implement the
+  SAME deterministic greedy (bit-identical wave assignments,
+  test-pinned); any device surprise degrades to the oracle — a plan must
+  never fail a proposal.
+
+Scheduling unit = one diff ROW (partition): ``alter_partition_reassignments``
+starts every destination replica of a partition fetching at once, so the
+executor cannot start a partition's destinations in different waves.
+A row's cost is its per-replica disk footprint (the DISK resource row is
+role-independent — ``model/tensor_model.py``); each destination broker
+receives that many bytes, each vacated source broker sends them.
+
+Both the planned schedule and the naive executor baseline are priced
+under the same round-barrier fluid model: a wave/batch completes before
+the next starts, and its duration is the slowest broker's
+``max(inbound, outbound) / throttle_rate``. That is the executor's
+worst-case poll-loop behavior and makes planned-vs-naive makespans
+directly comparable (bench.py --plan banks the A/B).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+
+import numpy as np
+
+#: env override: ``CCX_DEVICE_PLAN=0`` routes every plan through the host
+#: numpy oracle; ``=1`` forces the compiled device program regardless of
+#: diff size; unset applies the size gate below
+ENV_DEVICE_PLAN = "CCX_DEVICE_PLAN"
+
+#: diff-row floor for the device planner by default: below it the numpy
+#: oracle finishes in milliseconds and a compile is pure loss (mirrors
+#: ``ccx.proposals.DEVICE_DIFF_MIN_P`` rationale — test fixtures touch
+#: dozens of tiny shapes; serving diffs bucket to a handful of big ones)
+DEVICE_PLAN_MIN_ROWS = 4096
+
+#: floor of the padded-row compile bucket (pow2 bucketing, one compiled
+#: program per bucket — a fluctuating warm drift-diff size must never
+#: recompile mid-steady-loop)
+PLAN_ROWS_FLOOR = 1024
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanOptions:
+    """Wave-planner knobs (config ``optimizer.plan.*``).
+
+    ``broker_cap`` mirrors ``num.concurrent.partition.movements.per.
+    broker`` (a broker participates in at most this many concurrent
+    partition movements per wave, as source or destination).
+    ``wave_bytes`` is the per-broker per-wave byte budget in model load
+    units (MB) — the replication-throttle image: at throttle rate R and a
+    target wave duration T, set ``wave_bytes ≈ R*T``; <=0 = uncapped
+    (count caps only). ``throttle_mb_per_sec`` prices the projected wave
+    durations; <=0 reports makespan in relative byte units (rate 1)."""
+
+    broker_cap: int = 5
+    wave_bytes: float = 0.0
+    max_waves: int = 64
+    throttle_mb_per_sec: float = 0.0
+    #: None = env/size gate; "numpy"/"device" force a path
+    backend: str | None = None
+
+
+@dataclasses.dataclass
+class MovementPlan:
+    """A scheduled execution plan over one columnar diff.
+
+    ``wave`` is ALIGNED with the diff's row order (``wave[i]`` schedules
+    diff row i) — the executor's tasks are built from the same rows, so
+    consumption is an O(1) lookup per task. Rows with no inter-broker
+    movement (pure leadership / intra-broker disk rows) carry wave 0 and
+    zero scheduled bytes."""
+
+    wave: np.ndarray              #: int32[N], aligned with diff rows
+    partition: np.ndarray         #: int32[N], the diff's partition column
+    moves: np.ndarray             #: int32[N] replicas entering new brokers
+    move_bytes: np.ndarray        #: float32[N] bytes per moving replica
+    wave_bytes: np.ndarray        #: float32[W] total bytes entering per wave
+    wave_inflow_peak: np.ndarray  #: float32[W] max per-broker inbound bytes
+    wave_outflow_peak: np.ndarray  #: float32[W] max per-broker outbound bytes
+    n_waves: int
+    #: rows that fit no feasible wave and were forced into the last one
+    #: (max_waves too small for the diff at these caps)
+    overflow_rows: int
+    backend: str
+    opts: PlanOptions
+
+    _wave_of: dict | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    # ----- derived metrics --------------------------------------------------
+
+    @property
+    def n_moves(self) -> int:
+        return int(self.moves.sum())
+
+    @property
+    def bytes_moved(self) -> float:
+        return float(self.wave_bytes.sum())
+
+    @property
+    def peak_inflow(self) -> float:
+        """Max per-broker inbound bytes of any single wave — the
+        concurrent-inflow pressure the schedule ever puts on one broker."""
+        return float(self.wave_inflow_peak.max(initial=0.0))
+
+    @property
+    def wave_seconds(self) -> np.ndarray:
+        """Projected duration per wave under the round-barrier fluid
+        model: the slowest broker's max(in, out) bytes over the throttle
+        rate (rate <= 0 → relative byte units)."""
+        rate = self.opts.throttle_mb_per_sec
+        peak = np.maximum(self.wave_inflow_peak, self.wave_outflow_peak)
+        return peak / np.float32(rate if rate > 0 else 1.0)
+
+    @property
+    def makespan_seconds(self) -> float:
+        return float(self.wave_seconds.sum())
+
+    def wave_of(self, partition: int) -> int | None:
+        """Wave index for a dense partition index (None = not in plan)."""
+        if self._wave_of is None:
+            self._wave_of = dict(
+                zip(self.partition.tolist(), self.wave.tolist())
+            )
+        return self._wave_of.get(partition)
+
+    # ----- serialization ----------------------------------------------------
+
+    def summary_json(self) -> dict:
+        """The additive ``plan`` result block: scalars + per-wave profile
+        (never the per-row arrays — those ride the columnar wire blob)."""
+        return {
+            "nWaves": int(self.n_waves),
+            "nMoves": self.n_moves,
+            "bytesMoved": round(self.bytes_moved, 3),
+            "peakInflowMb": round(self.peak_inflow, 3),
+            "makespanSeconds": round(self.makespan_seconds, 3),
+            "overflowRows": int(self.overflow_rows),
+            "backend": self.backend,
+            "brokerCap": int(self.opts.broker_cap),
+            "waveBytesBudgetMb": float(self.opts.wave_bytes),
+            "throttleMbPerSec": float(self.opts.throttle_mb_per_sec),
+            "waveBytesMb": [round(float(x), 3) for x in self.wave_bytes],
+            "waveInflowPeakMb": [
+                round(float(x), 3) for x in self.wave_inflow_peak
+            ],
+            "waveSeconds": [round(float(x), 3) for x in self.wave_seconds],
+        }
+
+    def wire_cols(self) -> dict[str, np.ndarray]:
+        """The flat typed arrays for the columnar result path (wire round
+        20, ``planColumnar``): the row-aligned wave/partition columns plus
+        the per-wave profiles, ``pack_arrays``-ready."""
+        return {
+            "wave": self.wave.astype(np.int32),
+            "partition": self.partition.astype(np.int32),
+            "moves": self.moves.astype(np.int32),
+            "moveBytes": self.move_bytes.astype(np.float32),
+            "waveBytes": self.wave_bytes.astype(np.float32),
+            "waveInflowPeak": self.wave_inflow_peak.astype(np.float32),
+            "waveOutflowPeak": self.wave_outflow_peak.astype(np.float32),
+        }
+
+
+# ----- movement-cost tier ----------------------------------------------------
+
+
+def _cost_numpy(a0, a1, pvalid, bytes_pp, B: int):
+    a0 = np.asarray(a0)
+    a1 = np.asarray(a1)
+    member = (a1[:, :, None] == a0[:, None, :]).any(axis=2)
+    dst = (a1 >= 0) & ~member & np.asarray(pvalid)[:, None]
+    b = np.where(dst, np.asarray(bytes_pp, np.float32)[:, None], np.float32(0))
+    inflow = np.zeros(B, np.float32)
+    np.add.at(inflow, np.clip(a1, 0, B - 1).reshape(-1), b.reshape(-1))
+    return float(b.sum(dtype=np.float64)), float(inflow.max(initial=0.0))
+
+
+_COST_PROGRAM = None
+
+
+def _cost_program():
+    global _COST_PROGRAM
+    if _COST_PROGRAM is not None:
+        return _COST_PROGRAM
+    import jax
+    import jax.numpy as jnp
+
+    from ccx.common import costmodel
+
+    @costmodel.instrument("plan-movement-cost")
+    @functools.partial(jax.jit, static_argnames=("B",))
+    def _cost(a0, a1, pvalid, bytes_pp, *, B):
+        member = (a1[:, :, None] == a0[:, None, :]).any(axis=2)
+        dst = (a1 >= 0) & ~member & pvalid[:, None]
+        b = jnp.where(dst, bytes_pp[:, None], jnp.float32(0))
+        inflow = jnp.zeros((B,), jnp.float32).at[
+            jnp.clip(a1, 0, B - 1).reshape(-1)
+        ].add(b.reshape(-1))
+        return b.sum(), inflow.max()
+
+    _COST_PROGRAM = _cost
+    return _cost
+
+
+def movement_cost(before, after, backend: str | None = None):
+    """The movement-cost lex tier for a candidate placement: ``(bytes
+    moved, peak per-broker inbound bytes)`` of ``before -> after``, from
+    the same assignment tensors the columnar diff masks. Device-computed
+    at serving scale (same ``DEVICE_DIFF_MIN_P``-style gate as the diff),
+    numpy reference below it; any device surprise degrades to numpy."""
+    from ccx.common.resources import Resource
+
+    B = int(before.B)
+    bytes_pp = before.leader_load[Resource.DISK]
+    if backend is None:
+        env = os.environ.get(ENV_DEVICE_PLAN)
+        if env == "0":
+            backend = "numpy"
+        elif env == "1":
+            backend = "device"
+        else:
+            from ccx.proposals import DEVICE_DIFF_MIN_P
+
+            backend = (
+                "device" if int(before.P) >= DEVICE_DIFF_MIN_P else "numpy"
+            )
+    if backend == "device":
+        try:
+            bm, pk = _cost_program()(
+                before.assignment, after.assignment,
+                before.partition_valid, bytes_pp, B=B,
+            )
+            return float(bm), float(pk)
+        except Exception:  # noqa: BLE001 — degrade to the host reference
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "device movement_cost failed; falling back to numpy"
+            )
+    return _cost_numpy(
+        np.asarray(before.assignment), np.asarray(after.assignment),
+        np.asarray(before.partition_valid), np.asarray(bytes_pp), B,
+    )
+
+
+# ----- wave planner ----------------------------------------------------------
+
+
+def _prepare(cols: dict, bytes_pp: np.ndarray | None):
+    """Host-side planning inputs from the diff columns: per-row source /
+    destination broker slots (-1 pad), per-replica bytes, and the
+    deterministic processing order (largest-bytes-first, partition-index
+    tie-break — the LPT rule both backends replay identically)."""
+    old = np.asarray(cols["oldReplicas"], np.int32)
+    new = np.asarray(cols["newReplicas"], np.int32)
+    part = np.asarray(cols["partition"], np.int32)
+    if old.size == 0:
+        z = np.zeros((0,), np.int32)
+        return z.reshape(0, 1), z.reshape(0, 1), np.zeros(0, np.float32), z
+    in_old = (new[:, :, None] == old[:, None, :]).any(axis=2)
+    in_new = (old[:, :, None] == new[:, None, :]).any(axis=2)
+    dst = np.where((new >= 0) & ~in_old, new, -1).astype(np.int32)
+    src = np.where((old >= 0) & ~in_new, old, -1).astype(np.int32)
+    if bytes_pp is not None:
+        b = np.asarray(bytes_pp, np.float32)[part]
+    else:
+        b = np.ones(part.shape[0], np.float32)
+    # rows with no inter-broker movement cost nothing and pin to wave 0
+    b = np.where((dst >= 0).any(axis=1), b, np.float32(0)).astype(np.float32)
+    order = np.lexsort((part, -b)).astype(np.int32)
+    return src, dst, b, order
+
+
+def _plan_numpy(src, dst, b, order, W: int, B: int, cap: int, budget: float):
+    """The reference greedy (the correctness pin): for each row in LPT
+    order, among the waves where every involved broker is below the
+    concurrent-move cap and the row's bytes fit the per-broker byte
+    budget (a broker with nothing scheduled in a wave always admits one
+    row, so an over-budget single row still schedules), pick the wave
+    whose round-barrier bottleneck — ``max_b max(in, out)`` — grows the
+    LEAST, earliest wave on ties. That is LPT least-loaded packing: big
+    rows land first where they raise no wave's duration, which minimizes
+    the fluid-model makespan AND spreads concurrent inflow instead of
+    piling the largest rows onto one broker's wave-0 cap. No feasible
+    wave → the last wave, counted as overflow. float32 accumulation
+    throughout; cross-broker reductions happen once on the host
+    (``plan_movement``) — bit-identical to the compiled device program."""
+    n = order.shape[0]
+    cnt = np.zeros((W, B), np.int32)
+    inb = np.zeros((W, B), np.float32)
+    outb = np.zeros((W, B), np.float32)
+    peak = np.zeros(W, np.float32)  # per-wave bottleneck max_b max(in,out)
+    p_in = np.float32(0)  # schedule-wide peak per-broker inflow so far
+    inf = np.float32(np.inf)
+    wave = np.zeros(n, np.int32)
+    overflow = 0
+    bud = np.float32(budget)
+    for i in order.tolist():
+        d = dst[i][dst[i] >= 0]
+        s = src[i][src[i] >= 0]
+        bi = np.float32(b[i])
+        ok = (cnt[:, d] < cap).all(axis=1) & (cnt[:, s] < cap).all(axis=1)
+        ok &= ((inb[:, d] + bi <= bud) | (inb[:, d] <= 0)).all(axis=1)
+        ok &= ((outb[:, s] + bi <= bud) | (outb[:, s] <= 0)).all(axis=1)
+        if ok.any():
+            cand_in = (
+                (inb[:, d] + bi).max(axis=1) if d.size
+                else np.zeros(W, np.float32)
+            )
+            cand_out = (
+                (outb[:, s] + bi).max(axis=1) if s.size
+                else np.zeros(W, np.float32)
+            )
+            cand = np.maximum(cand_in, cand_out)
+            # lexicographic wave choice, earliest wave on full ties:
+            # (1) never raise the schedule-wide peak inflow when some
+            #     feasible wave avoids it (a dominant source outflow must
+            #     not hide inflow stacking under a "free" makespan move);
+            # (2) least growth of that wave's round-barrier bottleneck —
+            #     the greedy-makespan term;
+            # (3) lowest resulting destination inflow (balance).
+            raise_in = np.where(ok, np.maximum(cand_in - p_in, 0), inf)
+            t1 = ok & (raise_in == raise_in.min())
+            grow = np.where(t1, np.maximum(peak, cand) - peak, inf)
+            t2 = t1 & (grow == grow.min())
+            w = int(np.argmin(np.where(t2, cand_in, inf)))
+        else:
+            w = W - 1
+            overflow += 1
+        cnt[w, d] += 1
+        cnt[w, s] += 1
+        inb[w, d] += bi
+        outb[w, s] += bi
+        new_in = inb[w, d].max() if d.size else np.float32(0)
+        new_out = outb[w, s].max() if s.size else np.float32(0)
+        peak[w] = max(peak[w], new_in, new_out)
+        p_in = max(p_in, new_in)
+        wave[i] = w
+    return wave, inb, outb, overflow
+
+
+_PLAN_PROGRAM = None
+
+
+def _plan_program():
+    """Lazy jitted wave scheduler: one ``fori_loop`` over the (traced)
+    row count — greedy state is [W, B] per-wave broker occupancy, the
+    loop body is the same feasibility test as the numpy oracle. Shape
+    class = (padded rows, R, W, B); caps/budgets are traced data, so a
+    cap or throttle retune never recompiles."""
+    global _PLAN_PROGRAM
+    if _PLAN_PROGRAM is not None:
+        return _PLAN_PROGRAM
+    import jax
+    import jax.numpy as jnp
+
+    from ccx.common import costmodel
+
+    @costmodel.instrument("plan-waves")
+    @functools.partial(jax.jit, static_argnames=("W", "B"))
+    def _waves(src, dst, b, order, n, cap, budget, *, W, B):
+        inf = jnp.float32(jnp.inf)
+
+        def body(i, state):
+            cnt, inb, outb, peak, p_in, wave, overflow = state
+            idx = order[i]
+            d, s = dst[idx], src[idx]
+            dval, sval = d >= 0, s >= 0
+            dcl = jnp.clip(d, 0, B - 1)
+            scl = jnp.clip(s, 0, B - 1)
+            bi = b[idx]
+            ok = (
+                jnp.where(dval[None, :], cnt[:, dcl] < cap, True).all(axis=1)
+                & jnp.where(sval[None, :], cnt[:, scl] < cap, True).all(axis=1)
+                & jnp.where(
+                    dval[None, :],
+                    (inb[:, dcl] + bi <= budget) | (inb[:, dcl] <= 0),
+                    True,
+                ).all(axis=1)
+                & jnp.where(
+                    sval[None, :],
+                    (outb[:, scl] + bi <= budget) | (outb[:, scl] <= 0),
+                    True,
+                ).all(axis=1)
+            )
+            feasible = ok.any()
+            cand_in = jnp.where(
+                dval[None, :], inb[:, dcl] + bi, 0.0
+            ).max(axis=1)
+            cand_out = jnp.where(
+                sval[None, :], outb[:, scl] + bi, 0.0
+            ).max(axis=1)
+            cand = jnp.maximum(cand_in, cand_out)
+            raise_in = jnp.where(
+                ok, jnp.maximum(cand_in - p_in, 0.0), inf
+            )
+            t1 = ok & (raise_in == raise_in.min())
+            grow = jnp.where(t1, jnp.maximum(peak, cand) - peak, inf)
+            t2 = t1 & (grow == grow.min())
+            best = jnp.argmin(
+                jnp.where(t2, cand_in, inf)
+            ).astype(jnp.int32)
+            w = jnp.where(feasible, best, W - 1).astype(jnp.int32)
+            cnt = cnt.at[w, dcl].add(dval.astype(jnp.int32))
+            cnt = cnt.at[w, scl].add(sval.astype(jnp.int32))
+            inb = inb.at[w, dcl].add(jnp.where(dval, bi, 0.0))
+            outb = outb.at[w, scl].add(jnp.where(sval, bi, 0.0))
+            new_in = jnp.where(dval, inb[w, dcl], 0.0).max()
+            new_out = jnp.where(sval, outb[w, scl], 0.0).max()
+            peak = peak.at[w].set(
+                jnp.maximum(peak[w], jnp.maximum(new_in, new_out))
+            )
+            p_in = jnp.maximum(p_in, new_in)
+            wave = wave.at[idx].set(w)
+            overflow = overflow + jnp.where(feasible, 0, 1)
+            return cnt, inb, outb, peak, p_in, wave, overflow
+
+        n_rows = src.shape[0]
+        state = (
+            jnp.zeros((W, B), jnp.int32),
+            jnp.zeros((W, B), jnp.float32),
+            jnp.zeros((W, B), jnp.float32),
+            jnp.zeros((W,), jnp.float32),
+            jnp.float32(0),
+            jnp.zeros((n_rows,), jnp.int32),
+            jnp.int32(0),
+        )
+        cnt, inb, outb, peak, p_in, wave, overflow = jax.lax.fori_loop(
+            0, n, body, state
+        )
+        return wave, inb, outb, overflow
+
+    _PLAN_PROGRAM = _waves
+    return _waves
+
+
+def _plan_device(src, dst, b, order, W: int, B: int, cap: int, budget: float):
+    n = order.shape[0]
+    rows_cap = _pow2_ceil(max(PLAN_ROWS_FLOOR, n))
+    pad = rows_cap - n
+    if pad:
+        src = np.pad(src, [(0, pad), (0, 0)], constant_values=-1)
+        dst = np.pad(dst, [(0, pad), (0, 0)], constant_values=-1)
+        b = np.pad(b, [(0, pad)])
+        order = np.pad(order, [(0, pad)])
+    wave, inb, outb, overflow = _plan_program()(
+        src, dst, b, order, np.int32(n), np.int32(cap),
+        np.float32(budget), W=W, B=B,
+    )
+    return (
+        np.asarray(wave)[:n], np.asarray(inb), np.asarray(outb),
+        int(overflow),
+    )
+
+
+def plan_movement(
+    diff,
+    bytes_per_partition: np.ndarray | None,
+    n_brokers: int,
+    opts: PlanOptions = PlanOptions(),
+) -> MovementPlan:
+    """Schedule a columnar diff into execution waves.
+
+    ``diff`` is a ``ccx.proposals.ColumnarDiff`` or its ``cols`` dict;
+    ``bytes_per_partition`` the f32[P] per-replica disk footprint (None =
+    unit bytes: pure count packing); ``n_brokers`` the broker-axis size
+    the per-wave occupancy state is shaped on. Backend selection mirrors
+    ``columnar_diff``: env ``CCX_DEVICE_PLAN``, else the device program
+    at/above ``DEVICE_PLAN_MIN_ROWS`` rows, numpy oracle below; any
+    device surprise degrades to the oracle."""
+    cols = diff.cols if hasattr(diff, "cols") else diff
+    src, dst, b, order = _prepare(cols, bytes_per_partition)
+    part = np.asarray(cols["partition"], np.int32)
+    n = part.shape[0]
+    W = max(int(opts.max_waves), 1)
+    cap = max(int(opts.broker_cap), 1)
+    budget = float(opts.wave_bytes) if opts.wave_bytes > 0 else np.inf
+    backend = opts.backend
+    if backend is None:
+        env = os.environ.get(ENV_DEVICE_PLAN)
+        if env == "0":
+            backend = "numpy"
+        elif env == "1":
+            backend = "device"
+        else:
+            backend = "device" if n >= DEVICE_PLAN_MIN_ROWS else "numpy"
+    if n == 0:
+        z = np.zeros(0, np.float32)
+        return MovementPlan(
+            wave=np.zeros(0, np.int32), partition=part,
+            moves=np.zeros(0, np.int32), move_bytes=z,
+            wave_bytes=z, wave_inflow_peak=z, wave_outflow_peak=z,
+            n_waves=0, overflow_rows=0, backend="empty", opts=opts,
+        )
+    if backend == "device":
+        try:
+            wave, inb, outb, overflow = _plan_device(
+                src, dst, b, order, W, int(n_brokers), cap, budget
+            )
+        except Exception:  # noqa: BLE001 — a plan must never fail a proposal
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "device wave planner failed; falling back to numpy"
+            )
+            backend = "numpy (device error)"
+            wave, inb, outb, overflow = _plan_numpy(
+                src, dst, b, order, W, int(n_brokers), cap, budget
+            )
+    else:
+        wave, inb, outb, overflow = _plan_numpy(
+            src, dst, b, order, W, int(n_brokers), cap, budget
+        )
+    # cross-broker reductions on the host, from the bit-identical [W, B]
+    # accumulators — the per-wave profiles can never drift between
+    # backends on reduction order
+    wb = inb.sum(axis=1, dtype=np.float32)
+    wip = inb.max(axis=1, initial=0.0)
+    wop = outb.max(axis=1, initial=0.0)
+    n_waves = int(wave.max(initial=0)) + 1
+    return MovementPlan(
+        wave=np.asarray(wave, np.int32),
+        partition=part,
+        moves=(dst >= 0).sum(axis=1).astype(np.int32),
+        move_bytes=np.asarray(b, np.float32),
+        wave_bytes=np.asarray(wb, np.float32)[:n_waves],
+        wave_inflow_peak=np.asarray(wip, np.float32)[:n_waves],
+        wave_outflow_peak=np.asarray(wop, np.float32)[:n_waves],
+        n_waves=n_waves,
+        overflow_rows=int(overflow),
+        backend=backend,
+        opts=opts,
+    )
+
+
+# ----- naive executor baseline ----------------------------------------------
+
+
+def naive_schedule(
+    diff,
+    bytes_per_partition: np.ndarray | None,
+    n_brokers: int,
+    cap: int = 5,
+    throttle_mb_per_sec: float = 0.0,
+    max_cluster_movements: int | None = None,
+) -> dict:
+    """The legacy executor's batching, priced under the same round-barrier
+    fluid model as the planner: repeated ``inter_broker_batch``-style
+    rounds (task-id order, skip rows whose src/dst broker is at the
+    per-broker cap, optional cluster-wide budget), each round's duration
+    = the slowest broker's max(in, out) bytes over the throttle rate.
+    This is the A/B baseline ``bench.py --plan`` banks against."""
+    cols = diff.cols if hasattr(diff, "cols") else diff
+    src, dst, b, _ = _prepare(cols, bytes_per_partition)
+    n = src.shape[0]
+    rate = np.float32(
+        throttle_mb_per_sec if throttle_mb_per_sec > 0 else 1.0
+    )
+    moving = [i for i in range(n) if (dst[i] >= 0).any()]
+    pending = list(moving)  # task-id (diff-row) order, like the tracker
+    rounds = 0
+    makespan = np.float32(0)
+    peak_inflow = np.float32(0)
+    round_seconds: list[float] = []
+    budget = (
+        int(max_cluster_movements) if max_cluster_movements else n + 1
+    )
+    while pending:
+        cnt = np.zeros(n_brokers, np.int32)
+        inb = np.zeros(n_brokers, np.float32)
+        outb = np.zeros(n_brokers, np.float32)
+        batch: list[int] = []
+        rest: list[int] = []
+        for i in pending:
+            d = dst[i][dst[i] >= 0]
+            s = src[i][src[i] >= 0]
+            if (
+                len(batch) < budget
+                and (cnt[d] < cap).all()
+                and (cnt[s] < cap).all()
+            ):
+                cnt[d] += 1
+                cnt[s] += 1
+                inb[d] += np.float32(b[i])
+                outb[s] += np.float32(b[i])
+                batch.append(i)
+            else:
+                rest.append(i)
+        if not batch:  # cap <= 0 pathology: avoid spinning forever
+            break
+        rounds += 1
+        peak_inflow = max(peak_inflow, np.float32(inb.max(initial=0.0)))
+        dur = np.float32(
+            max(inb.max(initial=0.0), outb.max(initial=0.0))
+        ) / rate
+        round_seconds.append(float(dur))
+        makespan = np.float32(makespan + dur)
+        pending = rest
+    return {
+        "rounds": rounds,
+        "makespanSeconds": float(makespan),
+        "peakInflowMb": float(peak_inflow),
+        "roundSeconds": [round(s, 3) for s in round_seconds],
+        "nMoves": int(sum((dst[i] >= 0).sum() for i in moving)),
+    }

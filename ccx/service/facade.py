@@ -318,6 +318,20 @@ class CruiseControl:
             incremental=self._incremental_options(
                 disabled=disk_only, leadership_only=leadership_only
             ),
+            # movement planning (ISSUE 17): wave-schedule inter-broker
+            # movement; meaningless on the leadership-/disk-only fast
+            # paths (no inter-broker moves to schedule)
+            plan_enabled=(
+                self.config["optimizer.plan.enabled"]
+                and not (leadership_only or disk_only)
+            ),
+            plan_cost_tier=self.config["optimizer.plan.cost.tier"],
+            plan_max_waves=self.config["optimizer.plan.max.waves"],
+            plan_broker_cap=self.config["optimizer.plan.broker.cap"],
+            plan_wave_bytes_mb=self.config["optimizer.plan.wave.bytes.mb"],
+            plan_throttle_mb_per_sec=self.config[
+                "optimizer.plan.throttle.mbps"
+            ],
         )
 
     def _incremental_options(self, disabled: bool = False,
@@ -545,6 +559,7 @@ class CruiseControl:
             self.executor.execute_proposals(
                 res.proposals, metadata, uuid=uuid,
                 replication_throttle=replication_throttle, background=True,
+                plan=res.plan,
             )
             out["executionStarted"] = True
         return out
@@ -768,6 +783,7 @@ class CruiseControl:
         a stuck device call releases the GIL."""
         out = TRACER.observability_json(threads=include_threads)
         out["deviceMemory"] = self._devmem_state()
+        out["executor"] = self.executor.observability_json()
         return out
 
     # ----- cached proposals (ref GoalOptimizer precompute, C14) -------------

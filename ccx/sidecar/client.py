@@ -311,6 +311,28 @@ class SidecarClient:
                     session=session, cluster_id=cluster_id,
                     frames=n_frames, segments=len(segments),
                 ) from e
+        if isinstance(result.get("planColumnar"), (bytes, bytearray)):
+            # movement plan (round 20, additive): decode the wave-schedule
+            # blob in place — consumers read result["planColumnar"] as a
+            # dict of flat arrays next to the scalar result["plan"] block
+            self._check_crc(
+                result["planColumnar"],
+                result.get("planColumnarCrc32"),
+                "movement plan blob", session, cluster_id, n_frames,
+                len(segments),
+            )
+            from ccx.model.snapshot import decode_msgpack
+
+            try:
+                result["planColumnar"] = decode_msgpack(
+                    result["planColumnar"]
+                )
+            except Exception as e:  # noqa: BLE001 — damaged in transit
+                raise wire.StreamTruncated(
+                    f"movement plan blob undecodable: {e}",
+                    session=session, cluster_id=cluster_id,
+                    frames=n_frames, segments=len(segments),
+                ) from e
         if isinstance(result.get("goalSummaryColumnar"), (bytes, bytearray)):
             self._check_crc(
                 result["goalSummaryColumnar"],

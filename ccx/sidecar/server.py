@@ -689,6 +689,14 @@ class OptimizerSidecar:
                 warm_t0=float(o.get("warm_t0", 1e-8)),
                 warm_leader_iters=int(o.get("warm_leader_iters", 0)),
             ),
+            # movement planning (round 20; plan-off default keeps the
+            # pre-round-20 result byte-stable)
+            plan_enabled=bool(o.get("plan_enabled", False)),
+            plan_cost_tier=bool(o.get("plan_cost_tier", False)),
+            plan_max_waves=int(o.get("plan_max_waves", 64)),
+            plan_broker_cap=int(o.get("plan_broker_cap", 5)),
+            plan_wave_bytes_mb=float(o.get("plan_wave_bytes_mb", 0.0)),
+            plan_throttle_mb_per_sec=float(o.get("plan_throttle_mbps", 0.0)),
         )
         # resolve the warm base: (session, base_generation) in the
         # process-wide placement store. Graceful degradation is the
@@ -913,6 +921,16 @@ class OptimizerSidecar:
         import zlib
 
         result["proposalsColumnarCrc32"] = zlib.crc32(blob) & 0xFFFFFFFF
+        if res.plan is not None and res.plan.n_waves > 0:
+            # movement plan (round 20, additive): the wave schedule rides
+            # the terminal frame as one canonical blob — per-row arrays
+            # are diff-sized (same N as the proposals blob) but only 4
+            # columns, so it stays small enough to skip segmentation
+            plan_blob = pack_arrays(res.plan.wire_cols())
+            result[wire.FIELD_PLAN_COLUMNAR] = plan_blob
+            result[wire.FIELD_PLAN_COLUMNAR_CRC32] = (
+                zlib.crc32(plan_blob) & 0xFFFFFFFF
+            )
         # wire-path self-pricing (bench.py --wire reads these): host
         # result assembly vs columnar blob packing, in seconds. Additive
         # and columnar-only — row-mode results (and the golden fixtures)

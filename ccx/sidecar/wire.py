@@ -115,7 +115,23 @@ PROPOSE_OPTION_KEYS = frozenset({
     "warm_swap_iters", "warm_swap_patience", "warm_swap_candidates",
     "warm_steps", "warm_chunk_steps", "warm_chains", "warm_moves",
     "plateau_window", "warm_t0", "warm_leader_iters",
+    # movement planning (round 20, additive): device-scheduled execution
+    # waves on the proposal + optional movement-cost tier on the lex
+    # objective. Absent ⇒ plan-off, pre-round-20 results byte-stable.
+    "plan_enabled", "plan_cost_tier", "plan_max_waves",
+    "plan_broker_cap", "plan_wave_bytes_mb", "plan_throttle_mbps",
 })
+
+#: movement-plan result fields (round 20, additive): when the Propose ran
+#: with ``plan_enabled``, the terminal result frame carries the wave
+#: schedule as one canonical msgpack blob of flat typed arrays
+#: (``wave/partition/moves/moveBytes`` per diff row +
+#: ``waveBytes/waveInflowPeak/waveOutflowPeak`` per wave) next to its
+#: crc32, and the ``result.plan`` scalar block (projected makespan, peak
+#: inflow, wave count) rides the json result. Absent ⇒ plan-off,
+#: pre-round-20 decoding unchanged (legacy fixtures byte-stable).
+FIELD_PLAN_COLUMNAR = "planColumnar"
+FIELD_PLAN_COLUMNAR_CRC32 = "planColumnarCrc32"
 
 
 class WireError(ValueError):

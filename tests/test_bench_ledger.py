@@ -1136,3 +1136,130 @@ def test_exchange_render_table(tmp_path):
     out = bench_ledger.render_exchange(rows, partials)
     assert "replica exchange A/B" in out
     assert "25%" in out and "yes" in out
+
+
+# ----- plan family (PLAN_r*.json — bench.py --plan) --------------------------
+
+
+def _plan_line(*, planned_better=True, oracle=True, fresh=0, verified=None,
+               makespan=113762.4):
+    if verified is None:
+        verified = planned_better and oracle and fresh == 0
+    return {
+        "plan": True, "rung": "plan", "bench": "B5", "backend": "cpu",
+        "broker_cap": 5, "max_waves": 64, "wave_bytes_mb": 0.0,
+        "throttle_mb_per_sec": 0.0, "seed": 7, "value": makespan,
+        "cold_s": 47.8, "cold_verified": True,
+        "cold_ab": {
+            "rows": 53821,
+            "planned": {
+                "nWaves": 64, "nMoves": 64828, "bytesMoved": 22946978.0,
+                "peakInflowMb": 14885.4, "makespanSeconds": makespan,
+                "overflowRows": 314, "backend": "device",
+            },
+            "naive": {
+                "rounds": 88, "makespanSeconds": 418418.6,
+                "peakInflowMb": 15296.2, "nMoves": 64828,
+            },
+            "planned_better": planned_better, "oracle_match": oracle,
+        },
+        "replan": {"iters": 128, "prewarm_iters": 128, "wall_s": 19.6,
+                   "fresh_compiles": fresh},
+        "evacuation": {
+            "bench": "B3", "move_windows": 4,
+            "planned_makespan": 55056.4, "naive_makespan": 74844.7,
+            "planned_peak": 5676.1, "naive_peak": 7700.1,
+            "planned_better": planned_better, "verified": True,
+        },
+        "planned_better": planned_better, "oracle_match": oracle,
+        "fresh_compiles_in_replan": fresh, "verified": verified,
+    }
+
+
+def _bank_plan(tmp_path, n, line):
+    (tmp_path / f"PLAN_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_plan_gate_green_on_banked_artifacts():
+    prows, ppartials = bench_ledger.load_plan(str(REPO))
+    if not prows and not ppartials:
+        pytest.skip("no PLAN artifacts banked yet")
+    assert ppartials == []
+    assert bench_ledger.check_plan(prows) == []
+
+
+def test_plan_rows_parse(tmp_path):
+    _bank_plan(tmp_path, 1, _plan_line())
+    rows, partials = bench_ledger.load_plan(str(tmp_path))
+    assert partials == []
+    (r,) = rows
+    assert r["round"] == 1 and r["bench"] == "B5" and r["rows"] == 53821
+    assert r["waves"] == 64 and r["broker_cap"] == 5
+    assert r["planned_makespan"] == 113762.4
+    assert r["naive_makespan"] == 418418.6
+    assert r["evac_bench"] == "B3"
+    assert r["planned_better"] and r["oracle_match"] and r["verified"]
+    assert r["fresh_compiles"] == 0
+
+
+def test_plan_green_round_passes_check(tmp_path):
+    _bank_plan(tmp_path, 1, _plan_line())
+    rows, _ = bench_ledger.load_plan(str(tmp_path))
+    assert bench_ledger.check_plan(rows) == []
+
+
+def test_plan_contract_points_fail_check(tmp_path):
+    _bank_plan(tmp_path, 1, _plan_line(
+        planned_better=False, oracle=False, fresh=3))
+    rows, _ = bench_ledger.load_plan(str(tmp_path))
+    failures = bench_ledger.check_plan(rows)
+    assert any("did NOT beat" in f for f in failures)
+    assert any("bit-exact" in f for f in failures)
+    assert any("fresh compile" in f for f in failures)
+    assert any("UNVERIFIED" in f for f in failures)
+
+
+def test_plan_makespan_regression_fails_check(tmp_path):
+    # >10% worse than the best banked same-config round is a regression
+    _bank_plan(tmp_path, 1, _plan_line(makespan=100000.0))
+    _bank_plan(tmp_path, 2, _plan_line(makespan=115000.0))
+    rows, _ = bench_ledger.load_plan(str(tmp_path))
+    failures = bench_ledger.check_plan(rows)
+    assert any("regressed" in f for f in failures)
+
+
+def test_plan_makespan_within_threshold_passes(tmp_path):
+    _bank_plan(tmp_path, 1, _plan_line(makespan=100000.0))
+    _bank_plan(tmp_path, 2, _plan_line(makespan=105000.0))
+    rows, _ = bench_ledger.load_plan(str(tmp_path))
+    assert bench_ledger.check_plan(rows) == []
+
+
+def test_plan_only_latest_round_gates(tmp_path):
+    _bank_plan(tmp_path, 1, _plan_line(planned_better=False))
+    _bank_plan(tmp_path, 2, _plan_line())
+    rows, _ = bench_ledger.load_plan(str(tmp_path))
+    assert bench_ledger.check_plan(rows) == []
+
+
+def test_plan_unparseable_is_partial_not_row(tmp_path):
+    _bank_plan(tmp_path, 1, {"rc": 124})  # wedged run: no schema
+    rows, partials = bench_ledger.load_plan(str(tmp_path))
+    assert rows == [] and len(partials) == 1
+    assert "no completed plan line" in partials[0]["why"]
+    assert bench_ledger.check_plan(rows) == []
+
+
+def test_plan_render_table(tmp_path):
+    _bank_plan(tmp_path, 1, _plan_line())
+    rows, partials = bench_ledger.load_plan(str(tmp_path))
+    out = bench_ledger.render_plan(rows, partials)
+    assert "movement planning A/B" in out
+    assert "113762" in out and "418419" in out and "yes" in out
+
+
+def test_plan_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_PLAN=1" in sh

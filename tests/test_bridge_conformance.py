@@ -123,6 +123,8 @@ def test_java_wire_constants_match_python():
         "FIELD_JOB": wire.FIELD_JOB,
         "FIELD_STREAM_RESULT": wire.FIELD_STREAM_RESULT,
         "FIELD_RESULT_SEGMENT": wire.FIELD_RESULT_SEGMENT,
+        "FIELD_PLAN_COLUMNAR": wire.FIELD_PLAN_COLUMNAR,
+        "FIELD_PLAN_COLUMNAR_CRC32": wire.FIELD_PLAN_COLUMNAR_CRC32,
         "ERR_UNSUPPORTED_VERSION": wire.ERR_UNSUPPORTED_VERSION,
         "ERR_MALFORMED": wire.ERR_MALFORMED,
         "ERR_BAD_SNAPSHOT": wire.ERR_BAD_SNAPSHOT,

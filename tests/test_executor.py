@@ -314,3 +314,141 @@ def test_dense_index_resolution_via_metadata():
     t = tasks[TaskType.INTER_BROKER_REPLICA_ACTION][0]
     assert t.proposal.new_replicas[0] == 30   # resolved to real id
     assert t.tp == TopicPartition("t0", 0)
+
+
+# ----- throttle exception-safety (ISSUE 17 satellite) -------------------------
+
+
+def test_throttle_set_failure_recovers_state():
+    """set_throttles raising must not wedge the executor: state resets to
+    NO_TASK and the reservation releases (the next execution can run)."""
+    sim = sim_cluster()
+    ex, admin = make_executor(
+        sim, **{"default.replication.throttle": 50_000_000}
+    )
+
+    orig_alter = admin.incremental_alter_configs
+
+    def boom(cfgs):
+        raise RuntimeError("alter-configs RPC failed")
+
+    admin.incremental_alter_configs = boom
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 0)
+    old = list(sim.partition(tp).replicas)
+    new = [b for b in range(4) if b not in old][:1] + old[1:]
+    with pytest.raises(RuntimeError):
+        ex.execute_proposals([proposal(0, old, new)], metadata)
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+    admin.incremental_alter_configs = orig_alter
+    mgr = ex.execute_proposals([proposal(0, old, new)], metadata)
+    assert all(t.state is TaskState.COMPLETED
+               for t in mgr.tracker.tasks_of(TaskType.INTER_BROKER_REPLICA_ACTION))
+
+
+def test_throttles_cleared_on_execution_error():
+    """The error-path pin: an exception mid-execution still clears the
+    replication throttles before the executor returns to NO_TASK."""
+    sim = sim_cluster()
+    ex, admin = make_executor(
+        sim, **{"default.replication.throttle": 50_000_000}
+    )
+
+    def boom(assignments):
+        raise RuntimeError("reassignment RPC failed")
+
+    admin.alter_partition_reassignments = boom
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 0)
+    old = list(sim.partition(tp).replicas)
+    new = [b for b in range(4) if b not in old][:1] + old[1:]
+    with pytest.raises(RuntimeError):
+        ex.execute_proposals([proposal(0, old, new)], metadata)
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+    for b in range(4):
+        assert THROTTLE_CONFIG not in admin.describe_configs([b])[b]
+
+
+# ----- concurrency-adjuster observability (ISSUE 17 satellite) ----------------
+
+
+def test_concurrency_adjuster_observability_and_metrics():
+    from ccx.common.metrics import REGISTRY
+
+    cfg = CruiseControlConfig({
+        "num.concurrent.partition.movements.per.broker": 4,
+        "executor.concurrency.adjuster.max.partition.movements.per.broker": 8,
+        "executor.concurrency.adjuster.min.partition.movements.per.broker": 1,
+    })
+    cm = ExecutionConcurrencyManager(cfg)
+    sim = sim_cluster()
+    admin = SimulatedAdminClient(sim)
+    cm.adjust(admin.describe_cluster())
+    assert cm.adjustments_up == 1 and cm.last_adjustment == "up"
+    sim.kill_broker(3)
+    unhealthy = admin.describe_cluster()
+    cm.adjust(unhealthy)
+    cm.adjust(unhealthy)
+    assert cm.adjustments_down == 2 and cm.last_adjustment == "down"
+    obs = cm.observability_json()
+    assert obs["cap"] == cm.cap
+    assert obs["adjustmentsUp"] == 1 and obs["adjustmentsDown"] == 2
+    assert obs["minCap"] == 1 and obs["maxCap"] == 8
+    text = REGISTRY.render_prometheus()
+    assert "executor_concurrency_cap" in text
+    assert "executor_concurrency_adjust_down_total" in text
+
+
+def test_executor_observability_block():
+    sim = sim_cluster()
+    ex, admin = make_executor(sim)
+    obs = ex.observability_json()
+    assert obs["state"] == "NO_TASK_IN_PROGRESS"
+    assert obs["plan"] == {
+        "consuming": False, "waves": 0, "plannedPartitions": 0,
+    }
+    assert obs["concurrency"]["enabled"] is False
+
+
+# ----- plan-consuming execution (ISSUE 17 tentpole) ---------------------------
+
+
+def test_executor_consumes_movement_plan_end_to_end():
+    """Waves become batches: with a 2-wave plan, reassignment RPCs start
+    wave-0 partitions strictly before wave-1 partitions."""
+    import numpy as np
+
+    sim = sim_cluster(n_brokers=6, partitions=4, rf=1)
+    ex, admin = make_executor(sim)
+    metadata = admin.describe_cluster()
+    ps, waves = [], {}
+    for i in range(4):
+        tp_ = TopicPartition("t0", i)
+        old = list(sim.partition(tp_).replicas)
+        new = [(old[0] + 1) % 6]
+        ps.append(ExecutionProposal(i, 0, tuple(old), tuple(new), old[0], new[0]))
+        waves[i] = 0 if i < 2 else 1
+
+    class _Plan:
+        partition = np.asarray(list(waves), np.int32)
+        wave = np.asarray(list(waves.values()), np.int32)
+
+    started = []
+    orig = admin.alter_partition_reassignments
+
+    def spy(assignments):
+        started.append(sorted(tp.partition for tp in assignments))
+        orig(assignments)
+
+    admin.alter_partition_reassignments = spy
+    mgr = ex.execute_proposals(ps, metadata, plan=_Plan())
+    assert all(t.state is TaskState.COMPLETED
+               for t in mgr.tracker.tasks_of(TaskType.INTER_BROKER_REPLICA_ACTION))
+    assert started[0] == [0, 1]
+    assert [1, 2] not in started  # waves never mix
+    later = [b for b in started[1:] if b]
+    assert any(2 in b or 3 in b for b in later)
+    obs = ex.observability_json()
+    assert obs["plan"]["consuming"] is True
+    assert obs["plan"]["waves"] == 2
+    assert obs["plan"]["plannedPartitions"] == 4

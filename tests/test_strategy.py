@@ -1,0 +1,239 @@
+"""Strategy-chain + plan-consuming batch tests (ISSUE 17 satellite: the
+previously untested ``ccx/executor/strategy.py`` orderings, chain
+composition and config wiring, plus the ``ExecutionTaskPlanner`` wave
+path vs the test-pinned legacy greedy fallback)."""
+
+import numpy as np
+
+from ccx.config import CruiseControlConfig
+from ccx.executor.admin import SimulatedAdminClient, SimulatedCluster
+from ccx.executor.execution_task import ExecutionTask, TaskState, TaskType
+from ccx.executor.strategy import (
+    BaseReplicaMovementStrategy,
+    ChainedStrategy,
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeMinIsrWithOfflineReplicasStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+    build_strategy_chain,
+)
+from ccx.executor.task_manager import (
+    ExecutionCaps,
+    ExecutionTaskManager,
+    _plan_wave_map,
+)
+from ccx.proposals import ExecutionProposal
+
+from tests.test_executor import executor_config, proposal, sim_cluster
+
+
+def _task(p):
+    return ExecutionTask(p, TaskType.INTER_BROKER_REPLICA_ACTION)
+
+
+def _tasks(props):
+    return [_task(p) for p in props]
+
+
+# ----- orderings --------------------------------------------------------------
+
+
+def test_base_strategy_is_task_id_order():
+    ts = _tasks([proposal(i, [0], [1]) for i in range(5)])
+    shuffled = [ts[3], ts[0], ts[4], ts[2], ts[1]]
+    assert BaseReplicaMovementStrategy().sorted_tasks(shuffled) == ts
+
+
+def test_large_and_small_first_orderings():
+    one_move = _task(proposal(0, [0, 1], [2, 1]))     # 1 replica enters
+    two_moves = _task(proposal(1, [0, 1], [2, 3]))    # 2 replicas enter
+    assert PrioritizeLargeReplicaMovementStrategy().sorted_tasks(
+        [one_move, two_moves]
+    ) == [two_moves, one_move]
+    assert PrioritizeSmallReplicaMovementStrategy().sorted_tasks(
+        [two_moves, one_move]
+    ) == [one_move, two_moves]
+
+
+def test_min_isr_offline_replicas_first():
+    sim = sim_cluster()
+    sim.kill_broker(3)
+    metadata = SimulatedAdminClient(sim).describe_cluster()
+    at_risk = _task(proposal(0, [3, 0], [1, 0]))   # source replica offline
+    healthy = _task(proposal(1, [0, 1], [2, 1]))
+    s = PrioritizeMinIsrWithOfflineReplicasStrategy()
+    assert s.sorted_tasks([healthy, at_risk], metadata) == [at_risk, healthy]
+    # without metadata the strategy is inert (stable order)
+    assert s.sorted_tasks([healthy, at_risk], None) == [healthy, at_risk]
+
+
+def test_postpone_urp_caches_per_generation():
+    sim = sim_cluster()
+    sim.kill_broker(3)
+    metadata = SimulatedAdminClient(sim).describe_cluster()
+    s = PostponeUrpReplicaMovementStrategy()
+    urp_tp = next(p.tp for p in metadata.under_replicated())
+    t = ExecutionTask(
+        proposal(0, [0], [1]), TaskType.INTER_BROKER_REPLICA_ACTION, urp_tp
+    )
+    assert s.key(t, metadata) == 1
+    assert s._cache is not None and s._cache[0] == metadata.generation
+    cached = s._cache
+    s.key(t, metadata)  # same generation: no rescan
+    assert s._cache is cached
+
+
+def test_chain_flattens_and_composes():
+    chain = ChainedStrategy([
+        PrioritizeSmallReplicaMovementStrategy(),
+        ChainedStrategy([
+            PrioritizeLargeReplicaMovementStrategy(),
+            BaseReplicaMovementStrategy(),
+        ]),
+    ])
+    assert len(chain.strategies) == 3
+    assert "PrioritizeSmall" in chain.name and "Base" in chain.name
+    # equal-size tasks fall through to task-id order
+    a = _task(proposal(0, [0, 1], [2, 1]))
+    b = _task(proposal(1, [0, 1], [3, 1]))
+    assert chain.sorted_tasks([b, a]) == [a, b]
+
+
+def test_build_strategy_chain_from_config():
+    cfg = executor_config(**{
+        "replica.movement.strategies":
+            "ccx.executor.strategy.PrioritizeLargeReplicaMovementStrategy",
+    })
+    chain = build_strategy_chain(cfg)
+    assert isinstance(chain, ChainedStrategy)
+    assert "PrioritizeLarge" in chain.name
+    assert "Base" in chain.name  # default tie-breaker always appended
+
+
+# ----- plan-consuming batches vs legacy greedy --------------------------------
+
+
+def _mgr(props, caps=None, plan=None):
+    return ExecutionTaskManager(
+        props, BaseReplicaMovementStrategy(),
+        caps or ExecutionCaps(per_broker_inter=5, max_cluster_movements=100),
+        plan=plan,
+    )
+
+
+class _FakePlan:
+    """Duck-typed MovementPlan: row-aligned partition/wave arrays."""
+
+    def __init__(self, mapping):
+        self.partition = np.asarray(list(mapping), np.int32)
+        self.wave = np.asarray(list(mapping.values()), np.int32)
+
+
+def test_plan_wave_map_extraction():
+    assert _plan_wave_map(None) == {}
+    assert _plan_wave_map(object()) == {}
+    assert _plan_wave_map(_FakePlan({3: 0, 7: 2})) == {3: 0, 7: 2}
+
+
+def test_no_plan_is_exact_legacy_greedy():
+    """The empty-plan fallback pin: batch sequences with plan=None and
+    with an empty plan are identical to the legacy planner's."""
+    ps = [proposal(i, [0], [i % 3 + 1]) for i in range(6)]
+    caps = ExecutionCaps(per_broker_inter=2, max_cluster_movements=100)
+    legacy, withempty = _mgr(ps, caps), _mgr(ps, caps, plan=_FakePlan({}))
+    while True:
+        b1 = legacy.planner.inter_broker_batch(legacy.tracker, None)
+        b2 = withempty.planner.inter_broker_batch(withempty.tracker, None)
+        assert [t.proposal.partition for t in b1] == [
+            t.proposal.partition for t in b2
+        ]
+        if not b1:
+            break
+        legacy.mark(b1, TaskState.IN_PROGRESS)
+        withempty.mark(b2, TaskState.IN_PROGRESS)
+        legacy.mark(b1, TaskState.COMPLETED)
+        withempty.mark(b2, TaskState.COMPLETED)
+
+
+def test_plan_waves_serve_as_barriers():
+    ps = [proposal(i, [0], [i % 3 + 1]) for i in range(6)]
+    plan = _FakePlan({0: 0, 1: 0, 2: 1, 3: 1, 4: 2, 5: 2})
+    mgr = _mgr(ps, plan=plan)
+    got_waves = []
+    while True:
+        batch = mgr.planner.inter_broker_batch(mgr.tracker, None)
+        if not batch:
+            break
+        got_waves.append(sorted(t.proposal.partition for t in batch))
+        mgr.mark(batch, TaskState.IN_PROGRESS)
+        mgr.mark(batch, TaskState.COMPLETED)
+    assert got_waves == [[0, 1], [2, 3], [4, 5]]
+
+
+def test_plan_wave_not_started_while_previous_in_flight():
+    ps = [proposal(i, [0], [1]) for i in range(4)]
+    plan = _FakePlan({0: 0, 1: 0, 2: 1, 3: 1})
+    mgr = _mgr(ps, plan=plan)
+    batch = mgr.planner.inter_broker_batch(mgr.tracker, None)
+    assert sorted(t.proposal.partition for t in batch) == [0, 1]
+    mgr.mark(batch, TaskState.IN_PROGRESS)
+    # wave 0 still in flight: wave 1 must not start
+    assert mgr.planner.inter_broker_batch(mgr.tracker, None) == []
+    mgr.mark([batch[0]], TaskState.COMPLETED)
+    # one wave-0 task still in flight: barrier holds
+    assert mgr.planner.inter_broker_batch(mgr.tracker, None) == []
+    mgr.mark([batch[1]], TaskState.COMPLETED)
+    nxt = mgr.planner.inter_broker_batch(mgr.tracker, None)
+    assert sorted(t.proposal.partition for t in nxt) == [2, 3]
+
+
+def test_plan_respects_caps_inside_wave():
+    """Defense in depth: per-broker caps still bound a wave's batch (a
+    stale plan computed under different caps cannot overrun them)."""
+    ps = [proposal(i, [0], [1]) for i in range(4)]
+    plan = _FakePlan({i: 0 for i in range(4)})
+    caps = ExecutionCaps(per_broker_inter=2, max_cluster_movements=100)
+    mgr = _mgr(ps, caps, plan=plan)
+    batch = mgr.planner.inter_broker_batch(mgr.tracker, None)
+    assert len(batch) == 2  # broker cap, not the 4-row wave
+    mgr.mark(batch, TaskState.IN_PROGRESS)
+    mgr.mark(batch, TaskState.COMPLETED)
+    rest = mgr.planner.inter_broker_batch(mgr.tracker, None)
+    assert sorted(t.proposal.partition for t in rest) == [2, 3]
+
+
+def test_unplanned_partitions_default_to_wave_zero():
+    ps = [proposal(0, [0], [1]), proposal(1, [0], [2])]
+    plan = _FakePlan({0: 1})  # partition 1 missing from the plan
+    mgr = _mgr(ps, plan=plan)
+    batch = mgr.planner.inter_broker_batch(mgr.tracker, None)
+    # absent rows are wave 0: partition 1 starts first, partition 0 waits
+    assert [t.proposal.partition for t in batch] == [1]
+
+
+def test_real_movement_plan_consumable():
+    """End-to-end typing: a MovementPlan built by ccx.search.movement
+    feeds the planner's wave map directly."""
+    from ccx.search.movement import PlanOptions, plan_movement
+
+    cols = {
+        "partition": np.asarray([4, 9], np.int32),
+        "oldReplicas": np.asarray([[0, 1], [1, 2]], np.int32),
+        "newReplicas": np.asarray([[2, 1], [3, 2]], np.int32),
+    }
+    plan = plan_movement(
+        cols, None, 4, PlanOptions(broker_cap=1, backend="numpy")
+    )
+    wave_map = _plan_wave_map(plan)
+    assert set(wave_map) == {4, 9}
+    ps = [proposal(4, [0, 1], [2, 1]), proposal(9, [1, 2], [3, 2])]
+    mgr = _mgr(ps, plan=plan)
+    served = []
+    while True:
+        batch = mgr.planner.inter_broker_batch(mgr.tracker, None)
+        if not batch:
+            break
+        served.extend(t.proposal.partition for t in batch)
+        mgr.mark(batch, TaskState.IN_PROGRESS)
+        mgr.mark(batch, TaskState.COMPLETED)
+    assert sorted(served) == [4, 9]

@@ -69,6 +69,17 @@ This tool is the ledger and the tripwire:
   flat batch, a non-bit-exact K=1 run, any fresh compile on an
   exchange-interval retune, or an unverified line — the ladder's
   contract points are gates, not trends.
+* plan: ``PLAN_r*.json`` (the movement-planning A/B — ``bench.py
+  --plan``: the wave planner vs the legacy executor's naive greedy
+  batching under the same round-barrier fluid pricing, on the cold
+  diff and on the disk-full-evacuation scenario family, plus the
+  device/oracle bit-exactness pin and the zero-compile warm re-plan
+  loop) gets a trend section; ``--check`` fails a latest round where
+  the planner did not beat naive on makespan AND peak inflow, a
+  device plan not bit-exact vs the numpy oracle, any fresh compile in
+  the measured re-plan loop, an unverified line, and a planned
+  cold-diff makespan regression >10% vs the best banked same-config
+  round.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -1419,6 +1430,173 @@ def render_exchange(xrows: list[dict], partials: list[dict]) -> str:
     return "\n".join(out)
 
 
+# ----- movement planning (PLAN_r*.json) --------------------------------------
+
+
+def load_plan(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``PLAN_r*.json`` under ``root`` — the
+    ``bench.py --plan`` artifact: the wave planner vs the legacy
+    executor's naive greedy batching (same round-barrier fluid pricing)
+    on the cold diff and the disk-full-evacuation scenario family, plus
+    the device/oracle bit-exactness pin and the zero-compile warm
+    re-plan loop measured in the same round."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "PLAN_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("plan"):
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed plan line (rc={wrapper.get('rc')})",
+            })
+            continue
+        cold = line.get("cold_ab") or {}
+        planned = cold.get("planned") or {}
+        naive = cold.get("naive") or {}
+        evac = line.get("evacuation") or {}
+        replan = line.get("replan") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "bench": line.get("bench", "?"),
+            "backend": str(line.get("backend", "?")),
+            "broker_cap": line.get("broker_cap"),
+            "max_waves": line.get("max_waves"),
+            "wave_bytes_mb": line.get("wave_bytes_mb"),
+            "throttle": line.get("throttle_mb_per_sec"),
+            "seed": line.get("seed"),
+            "rows": cold.get("rows"),
+            "waves": planned.get("nWaves"),
+            "planned_makespan": planned.get("makespanSeconds"),
+            "naive_makespan": naive.get("makespanSeconds"),
+            "planned_peak": planned.get("peakInflowMb"),
+            "naive_peak": naive.get("peakInflowMb"),
+            "evac_bench": evac.get("bench"),
+            "evac_planned_makespan": evac.get("planned_makespan"),
+            "evac_naive_makespan": evac.get("naive_makespan"),
+            "replan_iters": replan.get("iters"),
+            "fresh_compiles": line.get("fresh_compiles_in_replan"),
+            "planned_better": bool(line.get("planned_better")),
+            "oracle_match": bool(line.get("oracle_match")),
+            "verified": bool(line.get("verified")),
+        })
+    return rows, partials
+
+
+def plan_group_key(row: dict) -> str:
+    """Plan rows trend at identical (bench, evac bench, broker cap, max
+    waves, byte budget, throttle, seed, backend) — the makespan is a
+    pure function of the diff and the caps, so only same-config rounds
+    compare."""
+    return json.dumps(
+        [row["bench"], row["evac_bench"], row["broker_cap"],
+         row["max_waves"], row["wave_bytes_mb"], row["throttle"],
+         row["seed"], row["backend"]],
+        sort_keys=True,
+    )
+
+
+def check_plan(prows: list[dict]) -> list[str]:
+    """The movement-planning gates. In the LATEST banked round (the
+    contract points): a planner that does not beat the naive executor
+    batching on makespan AND peak inflow fails — for the cold diff and
+    for the evacuation family both; a device plan that is not bit-exact
+    against the numpy oracle fails; ANY fresh compile in the measured
+    re-plan loop fails (the shrinking diff must stay inside its
+    prewarmed pow2 buckets); an unverified line fails. Across rounds
+    (the trend): a planned cold-diff makespan more than 10% worse than
+    the best banked same-config round is a regression."""
+    failures: list[str] = []
+    if not prows:
+        return failures
+    latest_round = max(r["round"] for r in prows)
+    for r in (r for r in prows if r["round"] == latest_round):
+        tag = f"plan round {r['round']} {r['bench']}"
+        if not r["planned_better"]:
+            failures.append(
+                f"{tag}: wave planner did NOT beat the naive executor "
+                "batching on makespan+peak (cold diff and/or evacuation "
+                "family)"
+            )
+        if not r["oracle_match"]:
+            failures.append(
+                f"{tag}: device planner is NOT bit-exact vs the numpy "
+                "oracle"
+            )
+        if r["fresh_compiles"]:
+            failures.append(
+                f"{tag}: {r['fresh_compiles']} fresh compile(s) in the "
+                "measured re-plan loop — the shrinking diff must stay "
+                "inside its prewarmed row buckets"
+            )
+        if not r["verified"]:
+            failures.append(f"{tag}: UNVERIFIED plan line banked")
+    groups: dict[str, list[dict]] = {}
+    for r in prows:
+        groups.setdefault(plan_group_key(r), []).append(r)
+    for rs in groups.values():
+        latest = max(rs, key=lambda r: r["round"])
+        prior = [
+            r for r in rs
+            if r["round"] < latest["round"]
+            and r["verified"] and r["planned_makespan"]
+        ]
+        if not prior or not latest["planned_makespan"]:
+            continue
+        best = min(r["planned_makespan"] for r in prior)
+        if latest["planned_makespan"] > best * 1.10:
+            failures.append(
+                f"plan round {latest['round']} {latest['bench']}: planned "
+                f"makespan {latest['planned_makespan']:.1f} regressed "
+                f">10% vs best banked {best:.1f}"
+            )
+    return failures
+
+
+def render_plan(prows: list[dict], partials: list[dict]) -> str:
+    """The movement-planning section of the trend table."""
+    if not prows and not partials:
+        return ""
+    out = ["", "movement planning A/B (PLAN_r*.json):"]
+    headers = ["round", "bench", "backend", "rows", "waves", "cap",
+               "makespan", "naive", "peak", "naive pk", "evac", "evac nv",
+               "replan", "compiles", "better", "oracle", "ok"]
+    body = []
+    for r in sorted(prows, key=lambda r: r["round"]):
+        body.append([
+            _fmt(r["round"], 0), r["bench"], r["backend"],
+            _fmt(r["rows"], 0), _fmt(r["waves"], 0),
+            _fmt(r["broker_cap"], 0),
+            _fmt(r["planned_makespan"], 0), _fmt(r["naive_makespan"], 0),
+            _fmt(r["planned_peak"], 0), _fmt(r["naive_peak"], 0),
+            _fmt(r["evac_planned_makespan"], 0),
+            _fmt(r["evac_naive_makespan"], 0),
+            _fmt(r["replan_iters"], 0),
+            "0" if not r["fresh_compiles"] else f"{r['fresh_compiles']}!",
+            "yes" if r["planned_better"] else "NO",
+            "yes" if r["oracle_match"] else "NO",
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 # ----- trend table -----------------------------------------------------------
 
 
@@ -1701,6 +1879,7 @@ def main(argv=None) -> int:
     crows, cpartials = load_chaos(root)
     scrows, scpartials = load_scenario(root)
     xrows, xpartials = load_exchange(root)
+    prows, ppartials = load_plan(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
@@ -1712,6 +1891,7 @@ def main(argv=None) -> int:
             "chaos": crows, "chaosPartials": cpartials,
             "scenario": scrows, "scenarioPartials": scpartials,
             "exchange": xrows, "exchangePartials": xpartials,
+            "plan": prows, "planPartials": ppartials,
         }, indent=1))
         return 0
     if args.roofline:
@@ -1724,6 +1904,7 @@ def main(argv=None) -> int:
             + check_steadyfleet(sfrows)
             + check_wire(wrows) + check_chaos(crows)
             + check_scenario(scrows) + check_exchange(xrows)
+            + check_plan(prows)
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
@@ -1741,6 +1922,7 @@ def main(argv=None) -> int:
               f"{len(wrows)} wire line(s), {len(crows)} "
               f"chaos line(s), {len(scrows)} scenario family row(s), "
               f"{len(xrows)} exchange A/B line(s), "
+              f"{len(prows)} plan A/B line(s), "
               "no regression vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
@@ -1752,10 +1934,12 @@ def main(argv=None) -> int:
     ch = render_chaos(crows, cpartials)
     sn = render_scenario(scrows, scpartials)
     xn = render_exchange(xrows, xpartials)
+    pl = render_plan(prows, ppartials)
     print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
           + (("\n" + st) if st else "") + (("\n" + sf) if sf else "")
           + (("\n" + wi) if wi else "") + (("\n" + ch) if ch else "")
-          + (("\n" + sn) if sn else "") + (("\n" + xn) if xn else ""))
+          + (("\n" + sn) if sn else "") + (("\n" + xn) if xn else "")
+          + (("\n" + pl) if pl else ""))
     return 0
 
 

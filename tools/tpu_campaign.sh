@@ -178,6 +178,19 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # window's repair/warm-SA phases leave their span trail.
   CCX_BENCH_SCENARIO=1 timeout -k 60 2400 python bench.py
   echo "scenario rc=$?"
+  echo "--- movement-planning rung (wave planner vs naive batching A/B; PLAN artifact) ---"
+  # executor-aware movement planning (ISSUE 17): the compiled wave
+  # planner vs the legacy executor's naive greedy batching, priced under
+  # the same round-barrier fluid model — planned-vs-naive makespan and
+  # peak per-broker inflow on the cold B5 diff and across the
+  # disk-full-evacuation scenario family, the device planner pinned
+  # bit-exact to the numpy oracle, and the warm re-plan-on-delta loop
+  # measured at zero fresh compiles. Banks the PLAN artifact the ledger
+  # gates on planned_better / oracle_match / zero fresh compiles. The
+  # flight recorder stays armed (exported above), so the plan phases
+  # leave their span trail next to the scenario rung they complement.
+  CCX_BENCH_PLAN=1 timeout -k 60 2400 python bench.py
+  echo "plan rc=$?"
   echo "--- replica-exchange rung (temperature-ladder A/B; EXCHANGE artifact) ---"
   # the replica-exchange ladder (ISSUE 16): flat SA chain batch vs the
   # K-rung temperature ladder at the same seeded chain/step budget —
