@@ -92,6 +92,18 @@ per-window verification, per-family pinned quality envelopes, zero
 measured-loop compiles, and >=1 anomaly-verb family recovering warm
 within 2x the clean steady p50 (CCX_SCENARIO_WINDOWS windows/family,
 default 4; CCX_SCENARIO_SEED; CCX_SCENARIO_FAMILIES comma-list).
+``--soak`` / CCX_BENCH_SOAK runs the long-horizon closed-loop soak
+(SOAK_r*.json artifact; ccx.detector.stream + ccx.common.slo): N warm
+clusters x continuous drift on one simulated fleet clock, with
+scenario-family anomaly injections and chaos faults on ONE seeded
+schedule — every injected anomaly must be detected, healed
+(detector-initiated urgent re-propose, one verb per episode) and
+verified recovered; gated on zero unrecovered healing episodes,
+windowed SLO compliance, bounded time-to-heal p99, flat device-memory
+over the horizon, and zero measured-loop compiles
+(CCX_SOAK_CLUSTERS, default 2; CCX_SOAK_TICKS, default 96;
+CCX_SOAK_SEED; CCX_SOAK_LATENCY_BUDGET / CCX_SOAK_DWELL_TARGET SLO
+overrides).
 
 Observability: ``--samples N`` (or CCX_BENCH_SAMPLES) runs N warm samples
 per rung and puts min/median/max PLUS the raw "walls" sample list on the
@@ -2668,6 +2680,606 @@ def run_scenario(name: str, windows: int | None, seed: int | None,
     print(_state["final_json"], flush=True)
 
 
+#: the soak rung's injection kinds, cycled on the seeded schedule —
+#: one scenario-family structural anomaly (a cascading broker kill the
+#: detector must classify from the live ``broker_alive`` signal) and one
+#: chaos fault (a killed warm-base bank whose observable is the NEXT
+#: window's cold fallback). Both have DETERMINISTIC observables, so the
+#: "detector-initiated healing for every injection" gate is exact.
+SOAK_INJECTIONS = (
+    ("broker-kill", "broker_failure",
+     "scenario-family broker failure (dead broker on the live stream)"),
+    ("bank-kill", "cold_serve",
+     "chaos fault placement.bank:raise@1 (warm base lost -> cold "
+     "fallback)"),
+)
+
+
+def run_soak(name: str, n_clusters: int, n_ticks: int,
+             seed: int, drift: float = 0.01) -> None:
+    """``--soak`` / CCX_BENCH_SOAK: the long-horizon closed-loop SLO soak
+    (ISSUE 20; ROADMAP "long-horizon soak") — the first rung where the
+    DETECTOR, not the bench, initiates every heal.
+
+    N warm clusters (one shape bucket, one cold solve) drift
+    continuously on a simulated fleet clock
+    (``observability.slo.window.seconds`` per tick per cluster); the
+    live stream of each serving window — warm/verified outcome, wall,
+    dead-broker set, banked warm-pressure band, unified-ledger devmem
+    verdict, fault attribution — feeds ``ccx.detector.stream``, which
+    classifies, opens healing episodes, and fires the healer callback
+    (an URGENT warm re-propose through the sidecar) exactly once per
+    episode. The bench only injects and executes; detection, cause
+    attribution, verb firing and recovery verdicts are the detector's.
+    Phases:
+
+    1. one cold converge + per-cluster sessions seeded from the applied
+       clean state (scenario-rung trick: one program set, one cold wall);
+    2. prewarm: two drift windows per cluster, then a REPLAY of every
+       injection kind on a throwaway session (kill + restore structural
+       windows, bank-kill cold fallback) — the measured horizon pays
+       zero fresh compiles;
+    3. clean steady baseline (3 windows) — prices the SLO latency budget
+       when CCX_SOAK_LATENCY_BUDGET is unset;
+    4. the measured horizon: ``n_ticks`` ticks x N clusters, injections
+       on ONE seeded schedule (:data:`SOAK_INJECTIONS` cycled, target
+       cluster round-robin, kill restored after 2 ticks — a transient
+       fault the closed loop must detect, heal, and verify recovered);
+       the unified ledger is sampled every window.
+
+    ``verified`` is the conjunction of: >=30 simulated fleet-minutes,
+    every healing episode fired AND recovered (zero open at horizon
+    end), episode census == injection census per family
+    (detector-initiated, no spurious episodes), windowed SLO compliance
+    (warm-served, latency, violation-free dwell) at target, time-to-heal
+    p99 inside the schedule bound, FLAT devmem (budget respected every
+    sample, second-half peak within 5% + 1 MB of first-half peak), zero
+    measured-loop compiles, and no leaked sessions. The JSON line is the
+    SOAK_r*.json artifact ``tools/bench_ledger.py`` trends and gates.
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from ccx.common import compilestats, costmodel, faults
+    from ccx.common.devmem import DEVMEM
+    from ccx.config import CruiseControlConfig
+    from ccx.detector.stream import FAMILY_VERB, StreamDetector
+    from ccx.model.fixtures import bench_spec, random_cluster
+    from ccx.model.snapshot import (
+        delta_encode,
+        model_to_arrays,
+        pack_arrays,
+        to_msgpack,
+    )
+    from ccx.search import incremental as incr
+    from ccx.search.scheduler import FLEET
+    from ccx.sidecar.client import SidecarClient
+    from ccx.sidecar.server import OptimizerSidecar, make_grpc_server
+
+    if os.environ.get("CCX_COST_CAPTURE") != "0":
+        costmodel.set_capture(True)
+    warm_opts = _steady_options()
+    inject_start = int(os.environ.get("CCX_SOAK_INJECT_START", "10"))
+    inject_every = int(os.environ.get("CCX_SOAK_INJECT_EVERY", "12"))
+    inject_dur = 2  # violating ticks per injection (restore after)
+
+    enter_phase(f"soak:{name}:model")
+    spec = bench_spec(name)
+    m0 = random_cluster(spec)
+    goal_names, cold_opts, cold_effort = build_opts(name, "target")
+    cold_wire = _wire_options(cold_opts)
+
+    sidecar = OptimizerSidecar()
+    server, port = make_grpc_server(sidecar, address="127.0.0.1:0")
+    server.start()
+    client = SidecarClient(
+        f"127.0.0.1:{port}", retries=4, backoff_s=0.05, backoff_max_s=1.0,
+        deadline_s=120.0, retry_seed=seed,
+    )
+    log(f"[soak] sidecar on port {port} ({jax.default_backend()}), "
+        f"{n_clusters} clusters x {n_ticks} ticks, seed {seed}")
+
+    # ----- 1. one cold converge, per-cluster sessions ----------------------
+    enter_phase(f"soak:{name}:cold")
+    ref = f"soak-{name}-ref"
+    client.put_snapshot(None, session=ref, generation=1,
+                        packed=to_msgpack(m0))
+    t0 = time.monotonic()
+    cold_res = client.propose(
+        session=ref, goals=goal_names, columnar=True,
+        on_progress=lambda p: enter_phase(f"soak:{name}:{p}"),
+        **cold_wire,
+    )
+    cold_s = time.monotonic() - t0
+    log(f"[soak] cold propose {cold_s:.1f}s "
+        f"verified={cold_res['verified']}")
+    warm_base = incr.STORE.get(ref)
+    if warm_base is None:
+        raise SystemExit("[soak] sidecar banked no warm base — is "
+                         "CCX_INCREMENTAL=0 set?")
+    m_applied = m0.replace(
+        assignment=warm_base.assignment,
+        leader_slot=warm_base.leader_slot,
+        replica_disk=warm_base.replica_disk,
+    )
+    applied = model_to_arrays(m_applied)
+    incr.STORE.drop(ref)
+    p_real = int(np.asarray(m0.partition_valid).sum())
+    n_drift = max(int(p_real * drift), 1)
+
+    def session(i: int) -> str:
+        return f"soak-{name}-c{i}"
+
+    class _Cluster:
+        def __init__(self, i: int) -> None:
+            self.i = i
+            self.sess = session(i)
+            self.arrays = dict(applied)
+            self.gen = 1
+            self.base_gen = 1
+            self.rng = np.random.default_rng(seed * 1000 + i)
+            self._dead0 = {
+                int(b) for b in np.nonzero(
+                    ~np.asarray(applied["broker_alive"], bool)
+                )[0]
+            }
+            client.put_snapshot(None, session=self.sess, generation=1,
+                                packed=pack_arrays(applied),
+                                cluster_id=self.sess)
+            incr.remember(self.sess, 1, m_applied, sidecar.goal_config)
+
+        def put(self, new: dict) -> None:
+            client.put_snapshot(
+                None, session=self.sess, generation=self.gen + 1,
+                packed=pack_arrays(delta_encode(self.arrays, new)),
+                is_delta=True, base_generation=self.gen,
+            )
+            self.gen += 1
+            self.arrays = new
+
+        def propose(self) -> dict:
+            t0 = time.monotonic()
+            res = client.propose(
+                session=self.sess, goals=goal_names, columnar=True,
+                warm_start=True, base_generation=self.base_gen,
+                cluster_id=self.sess, **{**cold_wire, **warm_opts},
+            )
+            inc = res.get("incremental") or {}
+            w = {
+                "wall_s": round(time.monotonic() - t0, 3),
+                "verified": bool(res["verified"]),
+                "warm": bool(inc.get("warmStart")),
+                "cold_fallback": bool(inc.get("coldStart")),
+                "rows": int(res["numProposals"]),
+            }
+            if w["verified"]:
+                self.base_gen = self.gen
+            return w
+
+        def window(self, new: dict | None = None) -> dict:
+            """One serving window end to end; ``new`` overrides the
+            default metric drift (the injection seam)."""
+            if new is None:
+                new = drift_metrics(self.arrays, self.rng, p_real, n_drift)
+            try:
+                self.put(new)
+                return self.propose()
+            except Exception as e:  # noqa: BLE001 — an unserved window
+                # is an SLO miss + an open episode, not a dead soak;
+                # resync like a real client that exhausted retries
+                try:
+                    client.put_snapshot(
+                        None, session=self.sess, generation=self.gen + 1,
+                        packed=pack_arrays(self.arrays),
+                    )
+                    self.gen += 1
+                    self.base_gen = self.gen
+                except Exception:  # noqa: BLE001
+                    pass
+                return {
+                    "wall_s": None, "verified": False, "warm": False,
+                    "cold_fallback": False, "rows": 0,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+
+        def dead_brokers(self) -> tuple:
+            """Brokers dead NOW that were alive at the converged
+            baseline — the bench fixtures model steady-state clusters
+            with a standing dead set, and monitoring alarms on the
+            DEVIATION, not the baseline."""
+            alive = np.asarray(self.arrays["broker_alive"], bool)
+            return tuple(
+                int(b) for b in np.nonzero(~alive)[0]
+                if int(b) not in self._dead0
+            )
+
+        def pressure_band(self) -> float | None:
+            """Mean of the banked warm-pressure stack, normalized to an
+            ADAPTIVE baseline — the band signal the forecaster fits.
+            A structural heal re-banks a differently-scaled stack (the
+            mean can step 10x without the cluster being in trouble), so
+            a >3x step re-baselines immediately after alarming ONCE,
+            while in-regime drift adapts slowly enough that genuine
+            trends still accumulate for the forecast."""
+            entry = incr.STORE.get(self.sess)
+            if entry is None or entry.pressure is None:
+                return None
+            cur = abs(float(np.asarray(entry.pressure).mean()))
+            if self._p0 is None:
+                self._p0 = max(cur, 1e-9)
+            band = round(0.5 * cur / self._p0, 4)
+            if cur > 3.0 * self._p0 or cur < self._p0 / 3.0:
+                self._p0 = max(cur, 1e-9)  # regime change
+            else:
+                self._p0 = max(0.95 * self._p0 + 0.05 * cur, 1e-9)
+            return band
+
+        _p0 = None
+
+    clusters = [_Cluster(i) for i in range(n_clusters)]
+
+    # ----- 2. prewarm + injection replay (the zero-compile contract) -------
+    enter_phase(f"soak:{name}:prewarm")
+    t0 = time.monotonic()
+    for c in clusters:
+        for _ in range(2):  # second window exercises the graft pad
+            c.window()
+    pw = _Cluster(n_clusters + 17)  # throwaway replay session
+    for _ in range(2):
+        pw.window()
+    # structural kill + restore: the repair + warm-SA programs at the
+    # B-1 dense count, and the add-back merge at B
+    alive0 = np.nonzero(np.asarray(pw.arrays["broker_alive"], bool))[0]
+    victim = int(alive0[-1])
+    killed = dict(drift_metrics(pw.arrays, pw.rng, p_real, n_drift))
+    ba = np.array(killed["broker_alive"], bool)
+    ba[victim] = False
+    killed["broker_alive"] = ba
+    pw.window(killed)
+    pw.window()  # drift with the broker still dead
+    restored = dict(drift_metrics(pw.arrays, pw.rng, p_real, n_drift))
+    ba = np.array(restored["broker_alive"], bool)
+    ba[victim] = True
+    restored["broker_alive"] = ba
+    pw.window(restored)
+    # bank-kill -> cold fallback at the soak's merged propose options
+    faults.FAULTS.arm("placement.bank:raise@1", seed=seed + 7)
+    pw.window()
+    faults.FAULTS.disarm()
+    pw.window()  # the cold-fallback window (re-banks the base)
+    pw.window()  # back warm
+    incr.STORE.drop(pw.sess)
+    log(f"[soak] prewarm + injection replay {time.monotonic() - t0:.1f}s")
+
+    # ----- 3. clean steady baseline ----------------------------------------
+    enter_phase(f"soak:{name}:clean")
+    from ccx.sidecar.server import freeze_gc_steady_state
+
+    freeze_gc_steady_state()
+    clean = [clusters[0].window() for _ in range(3)]
+    clean_p50 = statistics.median(w["wall_s"] for w in clean)
+    log(f"[soak] clean steady p50 {clean_p50 * 1e3:.0f}ms")
+
+    # ----- the closed loop: config, SLO engine, stream detector ------------
+    # the latency budget self-prices against THIS host unless pinned:
+    # a cold fallback (the bank-kill's documented degradation) must not
+    # be a latency SLO miss, it is priced as one cold wall + slack
+    lat_budget = float(os.environ.get("CCX_SOAK_LATENCY_BUDGET", "0")) \
+        or max(60.0, 2.0 * cold_s, 20.0 * clean_p50)
+    cfg = CruiseControlConfig({
+        "observability.slo.latency.budget.seconds": lat_budget,
+        # the schedule spends ~6% of windows violating by design
+        # (inject_dur + the fault's fallback tick, every inject_every
+        # ticks) — the dwell target prices that spend, overridable
+        "observability.slo.dwell.target": float(
+            os.environ.get("CCX_SOAK_DWELL_TARGET", "0.85")
+        ),
+        "detector.stream.seed": seed,
+    })
+    window_s = cfg["observability.slo.window.seconds"]
+    heals: list[dict] = []
+
+    def healer(cluster: str, family: str, cause: str) -> str | None:
+        """The detector's verb, executed by the bench: one URGENT warm
+        re-propose on the afflicted cluster (the facade wiring fires
+        remove_brokers/rebalance with self_healing=True; the soak's
+        equivalent is the re-propose those verbs reduce to here)."""
+        c = next(x for x in clusters if x.sess == cluster)
+        t0 = time.monotonic()
+        r = c.propose()
+        heals.append({
+            "cluster": cluster, "family": family, "cause": cause,
+            "wall_s": round(time.monotonic() - t0, 3),
+            "verified": r["verified"], "warm": r["warm"],
+        })
+        return FAMILY_VERB.get(family, "rebalance")
+
+    det = StreamDetector(cfg, healer=healer, clock=lambda: 0)
+    # every injection needs tail room inside the horizon: the dwell,
+    # the one-window surge of the post-heal re-baseline, and the clean
+    # streak that stamps recovery (the drain loop only mops up noise —
+    # a kill whose restore tick never executes can never recover)
+    tail = inject_dur + det.clean_windows + 2
+    n_injections = max(
+        (n_ticks - inject_start + inject_every - 1) // inject_every, 0
+    )
+    schedule = {
+        tick: (SOAK_INJECTIONS[k % len(SOAK_INJECTIONS)], k % n_clusters)
+        for k in range(n_injections)
+        if (tick := inject_start + k * inject_every) <= n_ticks - tail
+    }
+
+    # ----- 4. the measured horizon -----------------------------------------
+    enter_phase(f"soak:{name}:measured")
+    cs0 = compilestats.snapshot()
+    injections: list[dict] = []
+    windows: list[dict] = []
+    ledger_samples: list[dict] = []
+    active: dict[int, dict] = {}  # cluster idx -> live injection
+    rng_inject = np.random.default_rng(seed + 99)
+    for tick in range(n_ticks):
+        t_s = tick * window_s
+        if tick in schedule:
+            (kind, family, what), ci = schedule[tick]
+            inj = {"tick": tick, "t_s": t_s, "kind": kind,
+                   "family": family, "cluster": session(ci),
+                   "what": what, "until": tick + inject_dur}
+            c = clusters[ci]
+            if kind == "broker-kill":
+                alive = np.nonzero(
+                    np.asarray(c.arrays["broker_alive"], bool)
+                )[0]
+                inj["victim"] = int(rng_inject.choice(alive))
+            else:  # bank-kill: armed for THIS tick's window only
+                inj["spec"] = "placement.bank:raise@1"
+            active[ci] = inj
+            injections.append(inj)
+            det.note_signal(c.sess, t_s)  # tth clock starts at injection
+            log(f"[soak] tick {tick}: inject {kind} -> {session(ci)} "
+                f"({what})")
+        for ci, c in enumerate(clusters):
+            inj = active.get(ci)
+            new = None
+            armed = False
+            if inj is not None and inj["kind"] == "broker-kill":
+                new = dict(drift_metrics(c.arrays, c.rng, p_real, n_drift))
+                ba = np.array(new["broker_alive"], bool)
+                # kill at the injection tick, hold dead for the dwell,
+                # restore at `until` (a transient failure the loop must
+                # see through to a verified-clean recovery)
+                ba[inj["victim"]] = tick >= inj["until"]
+                new["broker_alive"] = ba
+            if inj is not None and inj["kind"] == "bank-kill" \
+                    and tick == inj["tick"]:
+                faults.FAULTS.arm(inj["spec"], seed=seed + tick)
+                armed = True
+            w = c.window(new)
+            if armed:
+                st = faults.FAULTS.stats()
+                faults.FAULTS.disarm()
+                inj["fired"] = dict(st["fired"])
+            if inj is not None and tick >= inj["until"]:
+                active.pop(ci, None)
+            signals = {
+                "warm": w["warm"], "verified": w["verified"],
+                "wall_s": w["wall_s"], "cold_fallback": w["cold_fallback"],
+                "dead_brokers": c.dead_brokers(),
+                "devmem_within_budget": DEVMEM.stats()["withinBudget"],
+                "fault": (
+                    inj["spec"] if armed and not w["verified"] else None
+                ),
+            }
+            p = c.pressure_band()
+            if p is not None:
+                signals["pressure"] = p
+            d = det.observe(c.sess, signals, t_s)
+            if d["violations"]:
+                log(f"[soak] tick {tick} {c.sess}: violating "
+                    f"{d['violations']} (signals "
+                    f"pressure={signals.get('pressure')})")
+            w.update({"tick": tick, "cluster": c.sess,
+                      "violations": d["violations"]})
+            if d["fired"]:
+                w["healed_by"] = d["verb"]
+            windows.append(w)
+            s = DEVMEM.stats()
+            ledger_samples.append({
+                "evictableBytes": s["evictableBytes"],
+                "budgetBytes": s["budgetBytes"],
+                "withinBudget": s["withinBudget"],
+            })
+        if tick % 24 == 23:
+            comp = det.slo.compliance()
+            log(f"[soak] tick {tick + 1}/{n_ticks}: episodes "
+                f"{det.metrics} compliance={comp}")
+
+    # drain: the horizon may end inside a clean streak — serve extra
+    # clean windows (still detector-observed, sim clock still ticking)
+    # until every episode closes or the drain budget is spent
+    drain = 0
+    while any(det.slo.episode(c.sess) for c in clusters) \
+            and drain < det.clean_windows + inject_dur + 2:
+        t_s = (n_ticks + drain) * window_s
+        for c in clusters:
+            if det.slo.episode(c.sess) is None:
+                continue
+            w = c.window()
+            det.observe(c.sess, {
+                "warm": w["warm"], "verified": w["verified"],
+                "wall_s": w["wall_s"], "cold_fallback": w["cold_fallback"],
+                "dead_brokers": c.dead_brokers(),
+                "devmem_within_budget": DEVMEM.stats()["withinBudget"],
+            }, t_s)
+        drain += 1
+    sim_s = n_ticks * window_s
+    fleet_minutes = n_clusters * sim_s / 60.0
+    warm_compiles = compilestats.delta(cs0, compilestats.snapshot())
+    zero_measured = warm_compiles.get("backend_compiles", 0) == 0
+
+    # settle stragglers before the leak/stuck gates
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and FLEET.stats()["activeJobs"]:
+        time.sleep(0.1)
+    stuck = FLEET.stats()["activeJobs"]
+
+    # ----- gates + the JSON line -------------------------------------------
+    open_eps = det.slo.open_episodes
+    episodes = det.slo.closed_episodes + open_eps  # full horizon
+    recovered_eps = [e for e in episodes if e.t_recovered_s is not None]
+    fam_census: dict[str, int] = {}
+    for e in episodes:
+        fam_census[e.family] = fam_census.get(e.family, 0) + 1
+    want_census: dict[str, int] = {}
+    for inj in injections:
+        want_census[inj["family"]] = want_census.get(inj["family"], 0) + 1
+    detector_initiated = (
+        len(episodes) == len(injections)
+        and fam_census == want_census
+        and all(e.t_fired_s is not None and e.verb for e in episodes)
+    )
+    all_recovered = not open_eps and len(recovered_eps) == len(episodes)
+    tths = sorted(
+        e.time_to_heal_s for e in recovered_eps
+        if e.time_to_heal_s is not None
+    )
+    tth_p50 = statistics.median(tths) if tths else None
+    tth_p99 = (
+        tths[min(int(round(0.99 * (len(tths) - 1))), len(tths) - 1)]
+        if tths else None
+    )
+    # the schedule bound: a transient injection dwells `inject_dur`
+    # ticks and the fault's observable lands one tick late — a healthy
+    # closed loop recovers at the FIRST clean window after that
+    tth_bound = (inject_dur + 2) * window_s
+    tth_bounded = bool(tths) and tth_p99 <= tth_bound
+    compliance = det.slo.compliance()
+    slo_ok = all(
+        v["met"] for v in compliance.values() if v["total"] > 0
+    )
+    budget_respected = all(s["withinBudget"] for s in ledger_samples)
+    half = len(ledger_samples) // 2
+    peak1 = max(s["evictableBytes"] for s in ledger_samples[:half])
+    peak2 = max(s["evictableBytes"] for s in ledger_samples[half:])
+    devmem_flat = (
+        budget_respected and peak2 <= peak1 * 1.05 + 1_000_000
+    )
+    reg_stats = sidecar.registry.stats()
+    store_stats = incr.STORE.stats()
+    # registry host snapshots persist for the cold ref + prewarm session
+    # (no session-drop RPC); the PLACEMENT store must hold exactly the
+    # fleet — any extra entry is a leaked warm base
+    no_leaks = (
+        reg_stats["sessions"] == n_clusters + 2
+        and store_stats["sessions"] == n_clusters
+    )
+    walls = sorted(
+        w["wall_s"] for w in windows if w["wall_s"] is not None
+    )
+    served_ok = len(walls) == len(windows)
+    out = {
+        "metric": (
+            f"{name} closed-loop soak: {n_clusters} clusters x "
+            f"{n_ticks} drift windows ({fleet_minutes:.0f} simulated "
+            "fleet-minutes), seeded anomaly/fault injections healed by "
+            "the stream detector (time-to-heal p99)"
+        ),
+        "value": tth_p99,
+        "unit": "s",
+        # closed-loop overhead: what a detector-healed horizon costs per
+        # window over the clean steady p50 (1.0 = healing is free)
+        "vs_baseline": round(
+            statistics.median(walls) / max(clean_p50, 1e-9), 2
+        ) if walls else None,
+        "soak": True,
+        "config": name,
+        "n_clusters": n_clusters,
+        "n_ticks": n_ticks,
+        "window_s": window_s,
+        "fleet_minutes": round(fleet_minutes, 1),
+        "seed": seed,
+        "drift_fraction": drift,
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count(),
+        "verified": bool(
+            fleet_minutes >= 30.0 and all_recovered and detector_initiated
+            and tth_bounded and slo_ok and devmem_flat and zero_measured
+            and served_ok and not stuck and no_leaks
+            and bool(cold_res["verified"])
+        ),
+        "cold_s": round(cold_s, 2),
+        "clean_p50_s": round(clean_p50, 3),
+        "gates": {
+            "fleet_minutes_ok": fleet_minutes >= 30.0,
+            "all_recovered": all_recovered,
+            "detector_initiated": detector_initiated,
+            "tth_bounded": tth_bounded,
+            "slo_ok": slo_ok,
+            "devmem_flat": devmem_flat,
+            "zero_measured_loop_compiles": zero_measured,
+            "all_windows_served": served_ok,
+            "no_stuck_jobs": not stuck,
+            "no_leaks": no_leaks,
+        },
+        "healing": {
+            "injections": len(injections),
+            "episodes": len(episodes),
+            "recovered": len(recovered_eps),
+            "open": len(open_eps),
+            "family_census": fam_census,
+            "expected_census": want_census,
+            "detector_metrics": dict(det.metrics),
+            "prewarms": det._prewarms,
+            "tth_p50_s": tth_p50,
+            "tth_p99_s": tth_p99,
+            "tth_bound_s": tth_bound,
+            "tths": tths,
+            "heals": heals,
+        },
+        "slo": {
+            "latency_budget_s": round(lat_budget, 2),
+            "compliance": compliance,
+            "burn_rates": det.slo.burn_rates(),
+            "summary": det.slo.summary(),
+        },
+        "episodes": det.slo.episodes_json(limit=64),
+        "injections": injections,
+        "windows": {
+            "total": len(windows),
+            "drain": drain * n_clusters,
+            "p50_s": round(statistics.median(walls), 3) if walls else None,
+            "warm": sum(1 for w in windows if w["warm"]),
+            "cold_fallback": sum(
+                1 for w in windows if w["cold_fallback"]
+            ),
+            "unverified": sum(1 for w in windows if not w["verified"]),
+        },
+        "devmem": {
+            "budget_respected": budget_respected,
+            "first_half_peak_bytes": int(peak1),
+            "second_half_peak_bytes": int(peak2),
+            "samples": len(ledger_samples),
+            "final": DEVMEM.stats(),
+        },
+        "compile_cache": {"measured": warm_compiles},
+        "scheduler": {"stuckJobs": len(stuck), "activeJobs": stuck},
+        "registry": reg_stats,
+        "store": store_stats,
+        "effort": {
+            **warm_opts, "cold": cold_effort, "n_clusters": n_clusters,
+            "n_ticks": n_ticks, "seed": seed, "drift": drift,
+            "inject_every": inject_every, "inject_start": inject_start,
+            "inject_dur": inject_dur,
+        },
+    }
+    client.close()
+    server.stop(0)
+    _state["done"] = True
+    _state["final_json"] = json.dumps(out)
+    print(_state["final_json"], flush=True)
+
+
 def run_mesh_bench(name: str) -> None:
     """CCX_BENCH_MESH=1: partition-axis-sharded anneal step slope at the
     config's shape over every visible device (SURVEY.md §5.7 — the
@@ -3295,6 +3907,21 @@ def main() -> None:
         "--plan-evac-windows", type=int,
         default=int(os.environ.get("CCX_PLAN_EVAC_WINDOWS", "4")),
     )
+    ap.add_argument("--soak", action="store_true",
+                    default=os.environ.get("CCX_BENCH_SOAK") not in
+                    (None, "", "0"))
+    ap.add_argument(
+        "--soak-clusters", type=int,
+        default=int(os.environ.get("CCX_SOAK_CLUSTERS", "2")),
+    )
+    ap.add_argument(
+        "--soak-ticks", type=int,
+        default=int(os.environ.get("CCX_SOAK_TICKS", "96")),
+    )
+    ap.add_argument(
+        "--soak-seed", type=int,
+        default=int(os.environ.get("CCX_SOAK_SEED", "1729")),
+    )
     ap.add_argument("--scenario", action="store_true",
                     default=os.environ.get("CCX_BENCH_SCENARIO") not in
                     (None, "", "0"))
@@ -3343,6 +3970,23 @@ def main() -> None:
             name,
             evac_name=os.environ.get("CCX_PLAN_EVAC_BENCH", "B3"),
             evac_windows=max(cli.plan_evac_windows, 1),
+        )
+        return
+
+    if cli.soak:
+        # closed-loop soak mode (SOAK_r*.json artifact): N warm clusters
+        # x continuous drift on a simulated fleet clock, seeded
+        # scenario-family + chaos-fault injections healed by the stream
+        # detector (ccx.detector.stream) under windowed SLO gates.
+        # Persistent compile cache like the ladder.
+        enable_compile_cache()
+        name = os.environ.get("CCX_BENCH", "B3")
+        _state["name"] = name
+        run_soak(
+            name,
+            n_clusters=max(cli.soak_clusters, 1),
+            n_ticks=max(cli.soak_ticks, 10),
+            seed=cli.soak_seed,
         )
         return
 

@@ -471,6 +471,18 @@ class Tracer:
             rec["compile"] = snap
         self._record(rec)
 
+    def healing_event(self, phase: str, **attrs) -> None:
+        """One structured healing-timeline record (ISSUE 20 — the
+        closed-loop control plane): ``phase`` is detected / fired /
+        recovered / forecast / fire-failed, attrs carry cluster, family,
+        cause, verb and the episode id. Rides the same O_APPEND JSONL
+        stream as spans and heartbeats, so a dead soak run's flight
+        recording still names the episode in progress — and
+        ``summarize()`` joins the phases back into per-episode arcs."""
+        if self._fd is None and not self._listeners:
+            return
+        self._record({"ev": "healing", "phase": str(phase), **attrs})
+
     # ----- convergence timeline (ISSUE 9) -----------------------------------
 
     #: heartbeat energies retained per job / jobs retained (LRU)
@@ -800,6 +812,13 @@ def summarize(path: str) -> dict:
     #: or cold pass — prices what an open-at-death span was expected to
     #: cost (device seconds + HBM watermark, ccx.common.costmodel)
     last_cost: dict[str, dict] = {}
+    #: episode id -> joined healing arc (detected/fired/recovered spans
+    #: from the ``healing`` records; ISSUE 20) — NOT segment-scoped:
+    #: a soak run's episodes are the diagnosis even when a later rung
+    #: appended its own segment to the shared campaign file
+    healing: dict[object, dict] = {}
+    healing_events = 0
+    healing_forecasts = 0
     #: span path -> heartbeat-energy series of the CURRENT segment (reset
     #: on arm, like the open-span ledger): the convergence-tap trace the
     #: plateau detection below runs on
@@ -829,6 +848,23 @@ def summarize(path: str) -> dict:
                 }
         elif ev == "watchdog":
             watchdogs.append(r)
+        elif ev == "healing":
+            healing_events += 1
+            eid = r.get("episode")
+            if eid is None:
+                # advisory phases (forecast prewarms) carry no episode
+                # id — count them, never join them into an arc that
+                # would render as an UNRECOVERED episode
+                healing_forecasts += 1
+            else:
+                arc = healing.setdefault(eid, {"episode": eid})
+                phase = r.get("phase", "?")
+                arc[phase + "T"] = r.get("t")
+                for k in ("cluster", "family", "cause", "verb",
+                          "timeToHealS", "error"):
+                    if r.get(k) is not None:
+                        arc[k] = r[k]
+                arc.setdefault("phases", []).append(phase)
     segments.append((cur_pid, cur_open))
     multi = len(segments) > 1
     open_spans = sorted(
@@ -869,6 +905,18 @@ def summarize(path: str) -> dict:
         "convergence": convergence,
         "watchdogDumps": len(watchdogs),
         "lastWatchdog": watchdogs[-1] if watchdogs else None,
+        # healing-event timeline (ISSUE 20): detected/fired/recovered
+        # spans joined per episode — a dead soak run's recording names
+        # the episode in progress (detected or fired, never recovered)
+        "healing": {
+            "events": healing_events,
+            "forecasts": healing_forecasts,
+            "episodes": list(healing.values()),
+            "openEpisodes": [
+                arc for arc in healing.values()
+                if "recovered" not in arc.get("phases", ())
+            ],
+        },
     }
 
 
@@ -920,6 +968,36 @@ def render_summary(s: dict) -> str:
             else ""
         )
     )
+    healing = s.get("healing") or {}
+    episodes = healing.get("episodes") or []
+    if episodes:
+        fc = healing.get("forecasts") or 0
+        lines.append(
+            f"healing timeline: {len(episodes)} episode(s), "
+            f"{len(healing.get('openEpisodes') or [])} open at death"
+            + (f", {fc} forecast prewarm(s)" if fc else "")
+        )
+        for arc in episodes:
+            phases = arc.get("phases", [])
+            parts = [
+                f"  episode {arc.get('episode')} "
+                f"[{arc.get('family', '?')}] {arc.get('cluster', '?')}:"
+            ]
+            for ph in ("detected", "fired", "recovered"):
+                if ph in phases:
+                    t = arc.get(ph + "T")
+                    parts.append(
+                        f"{ph}@{t}" if t is not None else ph
+                    )
+            if arc.get("verb"):
+                parts.append(f"verb={arc['verb']}")
+            if arc.get("timeToHealS") is not None:
+                parts.append(f"tth={arc['timeToHealS']}s")
+            if arc.get("cause"):
+                parts.append(f"cause={arc['cause']!r}")
+            if "recovered" not in phases:
+                parts.append("UNRECOVERED")
+            lines.append(" ".join(parts))
     return "\n".join(lines)
 
 

@@ -543,6 +543,14 @@ def analyzer_config_def() -> ConfigDef:
              "Per-broker replication rate (MB/s) pricing the projected "
              "wave durations (plan.waveSeconds / makespanSeconds). <=0 "
              "reports relative byte units. Traced data.")
+    d.define("optimizer.plan.throttle.measured", Type.BOOLEAN, True,
+             Importance.LOW,
+             "Close the wave-pricing feedback loop: when the executor "
+             "has MEASURED per-wave completion rates (the EWMA MB/s in "
+             "its observability plan block), re-plans price the "
+             "remaining waves with the measured rate instead of the "
+             "static optimizer.plan.throttle.mbps. False pins the "
+             "static rate (bit-exact pre-feedback pricing).")
     d.define("optimizer.repair.backend", Type.STRING, "device",
              Importance.LOW,
              "hard_repair loop driver: 'device' runs the whole sweep loop "
@@ -656,6 +664,46 @@ def observability_config_def() -> ConfigDef:
              "(seed, seam, hit) — same spec + seed replays the same "
              "faults byte-identically). Env twin: CCX_FAULTS_SEED.",
              at_least(0))
+    d.define("observability.slo.window.seconds", Type.DOUBLE, 10.0,
+             Importance.MEDIUM,
+             "Span of one SLO accounting window (ccx.common.slo): the "
+             "windowed SLO engine buckets serving windows at this "
+             "cadence, and the soak rung advances its simulated fleet "
+             "clock by this much per tick. Time-to-detect/heal are "
+             "measured on the same clock.", at_least(0.001))
+    d.define("observability.slo.short.windows", Type.INT, 12,
+             Importance.LOW,
+             "Short (paging) burn-rate window, in serving-window counts "
+             "— the fast half of the classic multi-window SLO alert.",
+             at_least(1))
+    d.define("observability.slo.long.windows", Type.INT, 60,
+             Importance.LOW,
+             "Long (ticket) burn-rate window, in serving-window counts.",
+             at_least(1))
+    d.define("observability.slo.warm.target", Type.DOUBLE, 0.95,
+             Importance.MEDIUM,
+             "Warm-served SLO target: fraction of serving windows that "
+             "must be answered by the warm incremental path AND verify. "
+             "The error budget is 1 - target; the "
+             "ccx_slo_burn_rate{objective=\"warm_served\"} gauge reports "
+             "budget burn against it.", between(0, 1))
+    d.define("observability.slo.latency.budget.seconds", Type.DOUBLE, 5.0,
+             Importance.MEDIUM,
+             "Per-window end-to-end latency budget: windows at or under "
+             "this wall count toward the latency SLO; the stream "
+             "detector classifies windows over it as latency_burst.",
+             at_least(0.001))
+    d.define("observability.slo.latency.target", Type.DOUBLE, 0.99,
+             Importance.LOW,
+             "Latency SLO target fraction (the p99-style budget: 0.99 "
+             "means 1% of windows may exceed the latency budget).",
+             between(0, 1))
+    d.define("observability.slo.dwell.target", Type.DOUBLE, 0.95,
+             Importance.LOW,
+             "Goal-violation dwell SLO target: fraction of windows that "
+             "must carry NO classified anomaly signal — bounds how much "
+             "of the timeline the fleet may spend in violation.",
+             between(0, 1))
     d.define("observability.convergence.max.chunks", Type.INT, 256,
              Importance.LOW,
              "Ring-buffer depth of the convergence taps, in chunk rows. "
@@ -775,6 +823,35 @@ def anomaly_detector_config_def() -> ConfigDef:
              "Provisioner SPI behind the rightsize endpoint (ref C21).")
     d.define("anomaly.detection.allow.unready.cluster", Type.BOOLEAN, False,
              Importance.LOW, "Run detectors before monitor windows are ready.")
+    d.define("detector.stream.enabled", Type.BOOLEAN, True, Importance.MEDIUM,
+             "Enable the live-stream anomaly detector (ccx.detector.stream): "
+             "classifies every serving window's flowing signals — heartbeat "
+             "energy, warm-pressure bands, goal-violation and devmem gauges "
+             "— and fires the SAME facade anomaly verbs as the queue path, "
+             "at urgent priority, one verb per healing episode.")
+    d.define("detector.stream.seed", Type.INT, 1729, Importance.LOW,
+             "Seed for the stream detector's classification tie-breaks and "
+             "forecast jitter; a fixed seed makes episode timelines "
+             "bit-reproducible across identical runs.", at_least(0))
+    d.define("detector.stream.clean.windows", Type.INT, 3, Importance.MEDIUM,
+             "Consecutive violation-free windows required to declare an "
+             "episode recovered. time-to-heal is stamped at the FIRST "
+             "window of the clean streak, so raising this delays the "
+             "verdict without inflating the healing metric.", at_least(1))
+    d.define("detector.stream.pressure.threshold", Type.DOUBLE, 0.85,
+             Importance.MEDIUM,
+             "warm_pressure band above which a window is classified as "
+             "pressure_surge (anomalous) even if it still verified.",
+             between(0, 1))
+    d.define("detector.stream.forecast.windows", Type.INT, 8, Importance.LOW,
+             "History length (windows) for the drift-history forecaster's "
+             "least-squares pressure slope.", at_least(2))
+    d.define("detector.stream.forecast.horizon.windows", Type.INT, 6,
+             Importance.LOW,
+             "Look-ahead horizon: if the fitted pressure slope crosses the "
+             "surge threshold within this many windows, the detector "
+             "pre-warms placement bases via the PlacementStore ledger "
+             "(priority touch) BEFORE the surge lands.", at_least(1))
     return d
 
 

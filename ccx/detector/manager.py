@@ -33,6 +33,10 @@ log = logging.getLogger(__name__)
 
 HISTORY_LIMIT = 100
 
+#: stream-detector cluster label for the service's own periodic poll
+#: rounds — the one live cluster this Cruise Control instance watches
+POLL_CLUSTER = "live"
+
 
 class AnomalyDetectorManager:
     def __init__(self, config, load_monitor, facade=None, clock=None) -> None:
@@ -58,6 +62,21 @@ class AnomalyDetectorManager:
         self.history: list[dict] = []
         self.metrics = {t: 0 for t in AnomalyType}
         self.num_self_healing_started = 0
+        # the live-stream detector (ISSUE 20): subscribes to the signals
+        # already flowing (window outcomes, warm-pressure bands, devmem
+        # verdicts) and fires the SAME facade verbs the queue path does,
+        # at urgent priority (self_healing=True), one verb per episode
+        from ccx.detector.stream import StreamDetector
+
+        self.stream = StreamDetector(
+            config, healer=self._stream_heal, clock=clock
+        )
+        #: latest signals per cluster — the stream healer's verb context
+        #: (e.g. which brokers were dead when the episode opened)
+        self._stream_signals: dict[str, dict] = {}
+        #: True while a periodic poll round is being mirrored onto the
+        #: stream — the healer must stay silent there (drain owns verbs)
+        self._poll_window = False
 
     # ----- intervals --------------------------------------------------------
 
@@ -112,6 +131,7 @@ class AnomalyDetectorManager:
         # Detection and queue pushes hold the lock briefly; the drain —
         # which may run a full self-healing optimization — must NOT hold it,
         # or state() (the REST thread) blocks for the whole heal.
+        round_found: list[Anomaly] = []
         for t in types if types is not None else list(AnomalyType):
             detector = self.detectors[t]
             try:
@@ -119,11 +139,17 @@ class AnomalyDetectorManager:
             except Exception:
                 log.exception("detector %s failed", t.name)
                 continue
+            round_found.extend(found)
             with self._lock:
                 for anomaly in found:
                     self.metrics[anomaly.type] += 1
                     heapq.heappush(self._queue, (now, anomaly))
-        return self._drain(now)
+        decisions = self._drain(now)
+        try:
+            self._observe_poll_round(round_found, decisions, now)
+        except Exception:  # noqa: BLE001 — mirroring must never break a round
+            log.exception("stream mirror of the poll round failed")
+        return decisions
 
     def _drain(self, now_ms: int) -> list[dict]:
         with self._drain_lock:  # one drain at a time; state() stays unblocked
@@ -191,6 +217,93 @@ class AnomalyDetectorManager:
             return bool(anomaly.failed_brokers)
         return True
 
+    # ----- the live-stream loop (ISSUE 20) ----------------------------------
+
+    def observe_stream(self, cluster: str, signals: dict,
+                       t_s: float | None = None) -> dict:
+        """Feed one serving window's live signals to the stream detector
+        (SLO accounting + classification + one-verb-per-episode healing).
+        ``t_s`` defaults to the manager clock, in seconds."""
+        if t_s is None:
+            t_s = self.clock() / 1000.0
+        self._stream_signals[cluster] = dict(signals)
+        return self.stream.observe(cluster, signals, t_s)
+
+    def _observe_poll_round(self, found: list, decisions: list,
+                            now_ms: int) -> None:
+        """Mirror one periodic detection round onto the live-stream
+        detector as a single SLO window (service mode's live feed). The
+        queue drain owns healing here — notifier grace, alerts, backoff
+        — so the stream must NEVER fire a second facade verb: episodes
+        open/close from the poll detectors' findings, and an episode is
+        marked fired only when this round's drain started the heal."""
+        if not self.stream.enabled:
+            return
+        from ccx.detector.anomalies import BrokerFailures, GoalViolations
+
+        dead: set[int] = set()
+        goals = 0
+        for a in found:
+            if isinstance(a, BrokerFailures):
+                dead.update(a.failed_brokers)
+            elif isinstance(a, GoalViolations):
+                goals += len(a.fixable_violated_goals)
+        signals = {
+            # a poll round is not a serving window: warm/verified/wall
+            # are vacuously good (absent wall_s counts as a latency
+            # MISS), only violation_free carries signal here
+            "warm": True, "verified": True, "wall_s": 0.0,
+            "dead_brokers": tuple(sorted(dead)),
+            "goal_violations": goals,
+        }
+        t_s = now_ms / 1000.0
+        self._stream_signals[POLL_CLUSTER] = signals
+        self._poll_window = True
+        try:
+            self.stream.observe(POLL_CLUSTER, signals, t_s)
+        finally:
+            self._poll_window = False
+        healed = [d for d in decisions if d.get("selfHealingStarted")]
+        if healed:
+            types = {d["anomaly"].get("type") for d in healed}
+            verb = ("remove_brokers" if "BROKER_FAILURE" in types
+                    else "rebalance")
+            self.stream.note_fired(POLL_CLUSTER, verb, t_s)
+
+    def _stream_heal(self, cluster: str, family: str, cause: str) -> str | None:
+        """Fire the facade anomaly verb for a stream-classified episode —
+        the same dispatch the queue path's ``anomaly.fix`` uses, so the
+        verb lands with ``self_healing=True`` (urgent fleet priority)."""
+        if self._poll_window:
+            # poll-round mirror: the queue drain owns healing (grace /
+            # alerts / backoff) — ``note_fired`` mirrors its verb, the
+            # stream never dispatches a second one
+            return None
+        if self.facade is None:
+            return None
+        from ccx.detector.anomalies import BrokerFailures, GoalViolations
+
+        now = self.clock()
+        signals = self._stream_signals.get(cluster) or {}
+        dead = tuple(signals.get("dead_brokers") or ())
+        if family == "broker_failure" and dead:
+            anomaly: Anomaly = BrokerFailures(
+                detection_ms=now,
+                failed_brokers={int(b): now for b in dead},
+            )
+            verb = "remove_brokers"
+        else:
+            anomaly = GoalViolations(
+                detection_ms=now,
+                fixable_violated_goals=(f"stream:{family}",),
+            )
+            verb = "rebalance"
+        started = anomaly.fix(self.facade)
+        if started:
+            with self._lock:
+                self.num_self_healing_started += 1
+        return verb if started else None
+
     # ----- state ------------------------------------------------------------
 
     def state(self) -> dict:
@@ -204,4 +317,6 @@ class AnomalyDetectorManager:
                 "metrics": {t.name: n for t, n in self.metrics.items()},
                 "numSelfHealingStarted": self.num_self_healing_started,
                 "pendingChecks": len(self._queue),
+                # VIEWER-safe stream-detector + SLO summary (ISSUE 20)
+                "slo": self.stream.state(),
             }

@@ -20,6 +20,7 @@ plays in ``ExecutorTest``).
 
 from __future__ import annotations
 
+import collections
 import enum
 import threading
 import time as _time
@@ -177,6 +178,13 @@ class Executor:
         self._thread: threading.Thread | None = None
         self._last_uuid: str | None = None
         self._replication_throttle = config["default.replication.throttle"]
+        # measured per-wave completion telemetry (ISSUE 20 satellite /
+        # ROADMAP round-20 follow-up): real MB/s from finished movement
+        # waves, fed back into the fluid wave-pricing model — the
+        # facade's re-plans price waves with this instead of the static
+        # optimizer.plan.throttle.mbps once a wave has completed
+        self._wave_telemetry: collections.deque = collections.deque(maxlen=32)
+        self._measured_mbps = 0.0
 
     # ----- state ------------------------------------------------------------
 
@@ -210,6 +218,11 @@ class Executor:
                 "consuming": bool(wave_map),
                 "waves": (max(wave_map.values()) + 1) if wave_map else 0,
                 "plannedPartitions": len(wave_map),
+                # measured completion telemetry (ISSUE 20 satellite):
+                # real per-wave MB/s from finished waves + the EWMA the
+                # re-plan pricing consumes (0.0 = nothing measured yet)
+                "measuredMbPerSec": round(self._measured_mbps, 3),
+                "measuredWaves": list(self._wave_telemetry),
             },
         }
 
@@ -305,6 +318,18 @@ class Executor:
 
     def _move_replicas(self, mgr: ExecutionTaskManager) -> None:
         type_ = TaskType.INTER_BROKER_REPLICA_ACTION
+        # per-wave completion telemetry: group the task set by plan wave
+        # (wave 0 = everything when no plan rides the proposal), stamp
+        # each wave's first start, and record measured MB/s as waves
+        # finish — the feedback the fluid wave-pricing model consumes
+        wave_of = {
+            id(t): mgr.planner.wave_by_partition.get(
+                int(t.proposal.partition), 0
+            )
+            for t in mgr.tracker.tasks_of(type_)
+        }
+        wave_started: dict[int, int] = {}
+        wave_done: set[int] = set()
         while not mgr.tracker.finished:
             if self._stop_requested.is_set():
                 self._abort_pending(mgr, type_)
@@ -319,11 +344,66 @@ class Executor:
                 )
                 for t in batch:
                     t.transition(TaskState.IN_PROGRESS, now)
+                    wave_started.setdefault(wave_of[id(t)], now)
             in_progress = mgr.tracker.tasks_of(type_, TaskState.IN_PROGRESS)
             if not in_progress and not mgr.tracker.tasks_of(type_, TaskState.PENDING):
                 break
             self.waiter(self.poll_interval_ms)
             self._poll_reassignments(mgr)
+            self._settle_waves(mgr, wave_of, wave_started, wave_done)
+        self._settle_waves(mgr, wave_of, wave_started, wave_done)
+
+    def _settle_waves(self, mgr: ExecutionTaskManager,
+                      wave_of: dict[int, int],
+                      wave_started: dict[int, int],
+                      wave_done: set[int]) -> None:
+        """Record measured MB/s for every started wave whose tasks all
+        settled (COMPLETED/DEAD/ABORTED); updates the EWMA rate the
+        facade's re-plans consume."""
+        terminal = (TaskState.COMPLETED, TaskState.DEAD, TaskState.ABORTED)
+        tasks = mgr.tracker.tasks_of(TaskType.INTER_BROKER_REPLICA_ACTION)
+        by_wave: dict[int, list] = {}
+        for t in tasks:
+            by_wave.setdefault(wave_of.get(id(t), 0), []).append(t)
+        now = self.clock()
+        for w, start in list(wave_started.items()):
+            if w in wave_done:
+                continue
+            ts = by_wave.get(w, [])
+            if not ts or not all(t.state in terminal for t in ts):
+                continue
+            wave_done.add(w)
+            moved_mb = sum(
+                t.data_to_move_mb for t in ts
+                if t.state is TaskState.COMPLETED
+            )
+            seconds = max((now - start) / 1000.0, 1e-9)
+            rate = moved_mb / seconds
+            self._wave_telemetry.append({
+                "wave": int(w),
+                "tasks": len(ts),
+                "movedMb": round(float(moved_mb), 3),
+                "seconds": round(seconds, 3),
+                "mbPerSec": round(rate, 3),
+            })
+            if moved_mb > 0:
+                # EWMA over completed waves: one outlier wave (a stall,
+                # an aborted tail) must not whipsaw the re-plan pricing
+                self._measured_mbps = (
+                    rate if self._measured_mbps <= 0.0
+                    else 0.5 * self._measured_mbps + 0.5 * rate
+                )
+                REGISTRY.set_gauge(
+                    "executor-measured-wave-mbps", self._measured_mbps,
+                    help="EWMA of measured per-wave inter-broker movement "
+                         "rate (MB/s) — the live feedback the movement "
+                         "planner prices re-plans with",
+                )
+
+    def measured_wave_mb_per_sec(self) -> float:
+        """EWMA of measured per-wave movement rate (MB/s); 0.0 until the
+        first wave with real data completes."""
+        return float(self._measured_mbps)
 
     def _poll_reassignments(self, mgr: ExecutionTaskManager) -> None:
         in_flight = self.admin.list_partition_reassignments()

@@ -329,10 +329,22 @@ class CruiseControl:
             plan_max_waves=self.config["optimizer.plan.max.waves"],
             plan_broker_cap=self.config["optimizer.plan.broker.cap"],
             plan_wave_bytes_mb=self.config["optimizer.plan.wave.bytes.mb"],
-            plan_throttle_mb_per_sec=self.config[
-                "optimizer.plan.throttle.mbps"
-            ],
+            # wave pricing prefers the executor's MEASURED per-wave MB/s
+            # (ISSUE 20 satellite): once a movement wave has completed,
+            # re-plans price the remaining waves with the observed rate
+            # instead of the static config — the closed feedback loop
+            plan_throttle_mb_per_sec=self._plan_throttle_mbps(),
         )
+
+    def _plan_throttle_mbps(self) -> float:
+        static = self.config["optimizer.plan.throttle.mbps"]
+        if not self.config["optimizer.plan.throttle.measured"]:
+            return static
+        try:
+            measured = self.executor.measured_wave_mb_per_sec()
+        except Exception:  # noqa: BLE001 — pricing must never fail a verb
+            measured = 0.0
+        return measured if measured > 0.0 else static
 
     def _incremental_options(self, disabled: bool = False,
                              leadership_only: bool = False):
@@ -784,6 +796,13 @@ class CruiseControl:
         out = TRACER.observability_json(threads=include_threads)
         out["deviceMemory"] = self._devmem_state()
         out["executor"] = self.executor.observability_json()
+        # the closed-loop control plane (ISSUE 20): live SLO burn rates +
+        # the healing-event timeline (detected -> fired -> recovered arcs
+        # with cause attribution) — USER-gated like the rest of this view
+        try:
+            out["healing"] = self.anomaly_detector.stream.observability_json()
+        except Exception:  # noqa: BLE001 — the view must stay readable
+            pass
         return out
 
     # ----- cached proposals (ref GoalOptimizer precompute, C14) -------------
@@ -915,6 +934,13 @@ class CruiseControl:
                         # reason and priority, and the budget — sizes and
                         # counters only, VIEWER-safe
                         "deviceMemory": self._devmem_state(),
+                        # windowed SLO engine + stream detector (ISSUE
+                        # 20): objectives, burn rates, episode counts and
+                        # time-to-heal percentiles — numbers and family
+                        # names only; the full healing timeline (causes,
+                        # verbs, per-episode arcs) is USER-gated on
+                        # /observability
+                        "slo": self._slo_state(),
                     },
                 }
         if "anomaly_detector" in want:
@@ -1144,6 +1170,15 @@ class CruiseControl:
             from ccx.common.devmem import DEVMEM
 
             return DEVMEM.stats()
+        except Exception:  # noqa: BLE001 — state must stay readable
+            return {}
+
+    def _slo_state(self) -> dict:
+        """AnalyzerState.observability.slo: the stream detector's
+        VIEWER-safe SLO summary (never raises — state must stay
+        readable)."""
+        try:
+            return self.anomaly_detector.stream.state()
         except Exception:  # noqa: BLE001 — state must stay readable
             return {}
 

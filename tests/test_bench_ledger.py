@@ -1263,3 +1263,166 @@ def test_plan_render_table(tmp_path):
 def test_plan_rung_is_wired_into_campaign_script():
     sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
     assert "CCX_BENCH_PLAN=1" in sh
+
+
+# ----- closed-loop soak (SOAK_r*.json — bench.py --soak) ---------------------
+
+
+def _soak_line(tth_p99=30.0, verified=True, open_eps=0, recovered=7,
+               injections=7, episodes=7, detector_initiated=True,
+               slo_met=True, devmem_flat=True, zero_compiles=True,
+               cores=2, **extra):
+    met = bool(slo_met)
+    return {
+        "metric": "B3 closed-loop soak: 2 clusters x 96 drift windows "
+                  "(32 simulated fleet-minutes), seeded anomaly/fault "
+                  "injections healed by the stream detector "
+                  "(time-to-heal p99)",
+        "value": tth_p99, "unit": "s", "vs_baseline": 1.0, "soak": True,
+        "config": "B3", "n_clusters": 2, "n_ticks": 96, "window_s": 10.0,
+        "fleet_minutes": 32.0, "seed": 1729, "drift_fraction": 0.01,
+        "backend": "cpu", "host_cores": cores, "verified": verified,
+        "cold_s": 29.0, "clean_p50_s": 0.07,
+        "gates": {
+            "fleet_minutes_ok": True, "all_recovered": open_eps == 0,
+            "detector_initiated": detector_initiated,
+            "tth_bounded": True, "slo_ok": met,
+            "devmem_flat": devmem_flat,
+            "zero_measured_loop_compiles": zero_compiles,
+            "all_windows_served": True, "no_stuck_jobs": True,
+            "no_leaks": True,
+        },
+        "healing": {
+            "injections": injections, "episodes": episodes,
+            "recovered": recovered, "open": open_eps,
+            "tth_p50_s": 20.0, "tth_p99_s": tth_p99,
+            "tth_bound_s": 40.0,
+        },
+        "slo": {
+            "latency_budget_s": 60.0,
+            "compliance": {
+                "warm_served": {"good": 190, "total": 199,
+                                "fraction": 0.95, "target": 0.95,
+                                "met": True},
+                "latency": {"good": 199, "total": 199, "fraction": 1.0,
+                            "target": 0.99, "met": True},
+                "violation_free": {"good": 180, "total": 199,
+                                   "fraction": 0.9, "target": 0.85,
+                                   "met": met},
+            },
+        },
+        "effort": {"warm_swap_iters": 8, "n_clusters": 2, "n_ticks": 96,
+                   "seed": 1729, "inject_every": 12},
+        **extra,
+    }
+
+
+def _bank_soak(tmp_path, n, line):
+    (tmp_path / f"SOAK_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": line})
+    )
+
+
+def test_soak_rows_parse(tmp_path):
+    _bank_soak(tmp_path, 1, _soak_line())
+    rows, partials = bench_ledger.load_soak(str(tmp_path))
+    assert partials == [] and len(rows) == 1
+    r = rows[0]
+    assert r["round"] == 1 and r["config"] == "B3"
+    assert r["fleet_minutes"] == 32.0 and r["tth_p99"] == 30.0
+    assert r["verified"] and r["recovered"] == 7 and r["open"] == 0
+    assert r["slo_met"] == {"warm_served": True, "latency": True,
+                            "violation_free": True}
+
+
+def test_soak_unverified_or_open_episode_fails(tmp_path):
+    _bank_soak(tmp_path, 1, _soak_line(verified=False, open_eps=1,
+                                       recovered=6))
+    rows, _ = bench_ledger.load_soak(str(tmp_path))
+    failures = bench_ledger.check_soak(rows)
+    assert any("UNVERIFIED" in f for f in failures)
+    assert any("UNRECOVERED" in f for f in failures)
+
+
+def test_soak_bench_initiated_heal_fails(tmp_path):
+    # census mismatch: 8 episodes for 7 injections (one spurious)
+    _bank_soak(tmp_path, 1, _soak_line(verified=False, episodes=8,
+                                       detector_initiated=False))
+    rows, _ = bench_ledger.load_soak(str(tmp_path))
+    failures = bench_ledger.check_soak(rows)
+    assert any("census" in f for f in failures)
+
+
+def test_soak_missed_slo_devmem_growth_or_compiles_fail(tmp_path):
+    _bank_soak(tmp_path, 1, _soak_line(verified=False, slo_met=False,
+                                       devmem_flat=False,
+                                       zero_compiles=False))
+    rows, _ = bench_ledger.load_soak(str(tmp_path))
+    failures = bench_ledger.check_soak(rows)
+    assert any("violation_free" in f for f in failures)
+    assert any("NOT flat" in f for f in failures)
+    assert any("fresh compiles" in f for f in failures)
+
+
+def test_soak_tth_regression_fails_within_threshold_passes(tmp_path):
+    _bank_soak(tmp_path, 1, _soak_line(tth_p99=30.0))
+    _bank_soak(tmp_path, 2, _soak_line(tth_p99=30.0 * 1.2))
+    rows, _ = bench_ledger.load_soak(str(tmp_path))
+    failures = bench_ledger.check_soak(rows)
+    assert any("time-to-heal p99" in f and "regressed" in f
+               for f in failures)
+    _bank_soak(tmp_path, 2, _soak_line(tth_p99=30.0 * 1.05))
+    rows, _ = bench_ledger.load_soak(str(tmp_path))
+    assert bench_ledger.check_soak(rows) == []
+
+
+def test_soak_different_schedule_not_comparable(tmp_path):
+    slow = _soak_line(tth_p99=80.0)
+    slow["effort"] = dict(slow["effort"], n_ticks=48)
+    slow["n_ticks"] = 48
+    _bank_soak(tmp_path, 1, _soak_line(tth_p99=30.0))
+    _bank_soak(tmp_path, 2, slow)
+    rows, _ = bench_ledger.load_soak(str(tmp_path))
+    assert bench_ledger.check_soak(rows) == []
+
+
+def test_soak_total_failure_is_gated_not_partial(tmp_path):
+    """A horizon where nothing recovered completes with value=None — a
+    gated ROW, never a reported-only partial."""
+    line = _soak_line(verified=False, open_eps=7, recovered=0)
+    line["value"] = None
+    line["healing"]["tth_p99_s"] = None
+    _bank_soak(tmp_path, 1, line)
+    rows, partials = bench_ledger.load_soak(str(tmp_path))
+    assert partials == [] and len(rows) == 1
+    assert bench_ledger.check_soak(rows)
+
+
+def test_soak_partial_round_reported_not_failed(tmp_path):
+    _bank_soak(tmp_path, 1, _soak_line())
+    (tmp_path / "SOAK_r02.json").write_text(json.dumps({"n": 2, "rc": 124}))
+    rows, partials = bench_ledger.load_soak(str(tmp_path))
+    assert len(rows) == 1 and len(partials) == 1
+    assert "no completed soak line" in partials[0]["why"]
+    assert bench_ledger.check_soak(rows) == []
+
+
+def test_soak_gate_green_on_banked_artifacts():
+    """The repo's own SOAK artifacts must pass the gate."""
+    rows, _ = bench_ledger.load_soak(str(REPO))
+    assert rows, "SOAK_r01.json missing — the soak rung never banked"
+    assert bench_ledger.check_soak(rows) == []
+
+
+def test_soak_rides_cli_table_and_check(tmp_path, capsys):
+    _bank(tmp_path, 1, _line(23.2))
+    _bank_soak(tmp_path, 1, _soak_line())
+    assert bench_ledger.main(["--dir", str(tmp_path), "--check"]) == 0
+    bench_ledger.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "closed-loop soak" in out and "7/7" in out and "met" in out
+
+
+def test_soak_rung_is_wired_into_campaign_script():
+    sh = (REPO / "tools" / "tpu_campaign.sh").read_text()
+    assert "CCX_BENCH_SOAK=1" in sh

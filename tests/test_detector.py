@@ -217,3 +217,223 @@ def test_provisioner_verdicts():
     rec2 = p.rightsize(big)
     assert rec2.status is ProvisionStatus.UNDER_PROVISIONED
     assert rec2.num_brokers_to_add > 0
+
+
+# ----- stream detector (ISSUE 20: the live-signal closed loop) ---------------
+
+
+def test_stream_classify_is_pure_and_priority_ordered():
+    from ccx.detector.stream import FAMILIES, StreamDetector
+
+    det = StreamDetector({"detector.stream.seed": 7})
+    # everything violating at once: families come out in FIXED priority
+    # order, broker_failure first (deterministic cause attribution)
+    signals = {
+        "dead_brokers": (3,),
+        "devmem_within_budget": False,
+        "goal_violations": 2,
+        "verified": False,
+        "warm": False,
+        "cold_fallback": True,
+        "wall_s": 1e9,
+        "pressure": 1.0,
+    }
+    out = det.classify(signals)
+    assert [f for f, _ in out] == list(FAMILIES)
+    # pure function: same signals, same verdicts, every time
+    assert det.classify(signals) == out
+    assert StreamDetector({"detector.stream.seed": 7}).classify(signals) == out
+    # a healthy window classifies clean; absent signals never crash
+    assert det.classify({"warm": True, "verified": True, "wall_s": 0.1}) == []
+    assert det.classify({}) == []
+
+
+def test_stream_classify_fault_attribution_wins_cold_serve_cause():
+    from ccx.detector.stream import StreamDetector
+
+    det = StreamDetector(None)
+    out = det.classify({
+        "verified": False, "fault": "placement.bank:raise@1",
+    })
+    assert out == [("cold_serve", "placement.bank:raise@1")]
+    # without fault attribution the cause names the symptom
+    out = det.classify({"verified": True, "warm": False,
+                        "cold_fallback": True})
+    assert out == [("cold_serve", "cold fallback (warm base lost)")]
+
+
+def test_stream_one_verb_per_episode_and_first_clean_window_recovery():
+    from ccx.detector.stream import StreamDetector
+
+    fired = []
+    det = StreamDetector(
+        {"detector.stream.clean.windows": 2},
+        healer=lambda c, f, cause: fired.append((c, f)) or "remove_brokers",
+    )
+    bad = {"warm": True, "verified": True, "wall_s": 0.1,
+           "dead_brokers": (5,)}
+    ok = {"warm": True, "verified": True, "wall_s": 0.1}
+    d = det.observe("c1", bad, 10.0)
+    assert d["fired"] and d["verb"] == "remove_brokers"
+    assert fired == [("c1", "broker_failure")]
+    # the persistent violation extends the episode, NO second verb
+    d = det.observe("c1", bad, 20.0)
+    assert not d["fired"] and d["episode"] == 1
+    assert fired == [("c1", "broker_failure")]
+    # recovery needs 2 consecutive clean windows; t_recovered is the
+    # FIRST of the streak
+    d = det.observe("c1", ok, 30.0)
+    assert "recovered" not in d
+    d = det.observe("c1", ok, 40.0)
+    assert d["recovered"] == 1
+    (ep,) = det.slo.closed_episodes
+    assert ep.t_recovered_s == 30.0 and ep.time_to_heal_s == 20.0
+    assert det.metrics == {"detected": 1, "fired": 1, "recovered": 1,
+                           "forecasts": 0}
+    # a violation interrupting the streak resets it
+    det.observe("c1", bad, 50.0)
+    det.observe("c1", ok, 60.0)
+    det.observe("c1", bad, 70.0)   # streak broken
+    det.observe("c1", ok, 80.0)
+    assert det.slo.episode("c1") is not None  # still open
+    d = det.observe("c1", ok, 90.0)
+    assert d["recovered"] == 2
+    assert det.slo.closed_episodes[-1].t_recovered_s == 80.0
+
+
+def test_stream_note_signal_starts_the_tth_clock_at_the_signal():
+    from ccx.detector.stream import StreamDetector
+
+    det = StreamDetector(None, healer=lambda *a: "rebalance")
+    det.note_signal("c1", 5.0)   # fault injected here...
+    det.observe("c1", {"verified": False}, 10.0)  # ...observed here
+    ep = det.slo.episode("c1")
+    assert ep.t_first_signal_s == 5.0 and ep.t_detected_s == 10.0
+    assert ep.time_to_detect_s == 5.0
+
+
+def test_stream_failed_healer_leaves_episode_open_without_crashing():
+    from ccx.detector.stream import StreamDetector
+
+    def broken(cluster, family, cause):
+        raise RuntimeError("executor down")
+
+    det = StreamDetector(None, healer=broken)
+    d = det.observe("c1", {"verified": False}, 0.0)
+    assert not d["fired"] and d["episode"] == 1
+    ep = det.slo.episode("c1")
+    assert ep is not None and ep.verb is None
+    assert det.metrics["detected"] == 1 and det.metrics["fired"] == 0
+
+
+def test_stream_disabled_is_a_noop():
+    from ccx.detector.stream import StreamDetector
+
+    det = StreamDetector({"detector.stream.enabled": False})
+    assert det.observe("c1", {"verified": False}, 0.0) == {"enabled": False}
+    assert det.slo.open_episodes == []
+
+
+def test_stream_forecast_prewarms_once_per_predicted_crossing():
+    from ccx.detector.stream import StreamDetector
+
+    prewarmed = []
+    det = StreamDetector(
+        {"detector.stream.forecast.windows": 4,
+         "detector.stream.forecast.horizon.windows": 4,
+         "detector.stream.pressure.threshold": 0.9},
+        prewarmer=lambda c: prewarmed.append(c) or True,
+    )
+    ok = {"warm": True, "verified": True, "wall_s": 0.1}
+    # rising trend toward the threshold: 0.5, 0.58, 0.66, 0.74 -> slope
+    # 0.08/window, predicted 0.74 + 4*0.08 = 1.06 >= 0.9 -> prewarm
+    decisions = [
+        det.observe("c1", {**ok, "pressure": 0.5 + 0.08 * i}, float(i))
+        for i in range(4)
+    ]
+    assert "forecast" in decisions[-1]
+    assert decisions[-1]["forecast"]["prewarmed"] is True
+    assert prewarmed == ["c1"]
+    # still rising, still below threshold: ONE prewarm per crossing
+    det.observe("c1", {**ok, "pressure": 0.82}, 4.0)
+    assert prewarmed == ["c1"]
+    assert det.metrics["forecasts"] == 1
+    # flat-and-safe history re-arms the forecast...
+    for i in range(5, 10):
+        det.observe("c1", {**ok, "pressure": 0.3}, float(i))
+    # ...so a fresh rise prewarms again
+    for i in range(10, 14):
+        det.observe("c1", {**ok, "pressure": 0.3 + 0.15 * (i - 9)}, float(i))
+    assert prewarmed == ["c1", "c1"]
+
+
+def test_manager_stream_wiring_fires_facade_verbs_self_healing(tmp_path):
+    mgr, lm, sim, clock, facade = make_stack(tmp_path)
+    # a dead-broker signal on the stream fires remove_brokers through
+    # the SAME anomaly dispatch the queue path uses (urgent: the facade
+    # verb lands with self_healing=True)
+    d = mgr.observe_stream(
+        "c0",
+        {"warm": True, "verified": True, "wall_s": 0.1,
+         "dead_brokers": (2, 3)},
+        t_s=1.0,
+    )
+    assert d["fired"] and d["verb"] == "remove_brokers"
+    name, args, kwargs = facade.calls[0]
+    assert name == "remove_brokers"
+    assert tuple(args[0]) == (2, 3)
+    assert kwargs["self_healing"] is True
+    assert "self-healing" in kwargs["reason"]
+    # a non-structural family reduces to an urgent rebalance
+    d = mgr.observe_stream("c1", {"verified": False}, t_s=2.0)
+    assert d["verb"] == "rebalance"
+    name, args, kwargs = facade.calls[1]
+    assert name == "rebalance" and kwargs["self_healing"] is True
+    assert mgr.num_self_healing_started == 2
+    # the stream's SLO block rides the manager state, VIEWER-safe
+    slo = mgr.state()["slo"]
+    assert slo["metrics"]["fired"] == 2
+    assert slo["slo"]["episodes"]["open"] == 2
+    assert "timeline" not in slo
+
+
+def test_manager_poll_rounds_mirror_onto_the_stream(tmp_path):
+    # service mode's live feed (ISSUE 20): every periodic poll round is
+    # one SLO window on the stream detector; the queue drain stays the
+    # ONLY verb source (grace/alerts/backoff), the stream mirrors it
+    mgr, lm, sim, clock, facade = make_stack(
+        tmp_path, **{"detector.stream.clean.windows": 1}
+    )
+    run_windows(lm, clock)
+    mgr.run_once([AnomalyType.BROKER_FAILURE])  # clean round
+    slo = mgr.state()["slo"]
+    assert slo["metrics"]["detected"] == 0
+    assert slo["slo"]["compliance"]["violation_free"]["good"] == 1
+    # a poll round is not a serving window: latency is vacuously good
+    assert slo["slo"]["compliance"]["latency"]["good"] == 1
+
+    sim.kill_broker(3)
+    d1 = mgr.run_once([AnomalyType.BROKER_FAILURE])
+    assert d1[0]["action"] == "CHECK"           # inside notifier grace
+    slo = mgr.state()["slo"]
+    assert slo["metrics"]["detected"] == 1      # episode opened on "live"
+    assert slo["metrics"]["fired"] == 0         # drain hasn't healed yet
+    assert not facade.calls                     # stream fired NOTHING
+
+    clock["now"] += 6000                        # past self-healing threshold
+    d2 = mgr.run_once([AnomalyType.BROKER_FAILURE])
+    healed = [d for d in d2 if d.get("selfHealingStarted")]
+    assert healed
+    # every facade verb is the DRAIN's (the queue may fix a requeued and
+    # a fresh anomaly in one round — pre-existing); the stream added none
+    assert len(facade.calls) == len(healed)
+    assert all(c[0] == "remove_brokers" for c in facade.calls)
+    slo = mgr.state()["slo"]
+    assert slo["metrics"]["fired"] == 1         # mirrored once, not re-fired
+
+    sim.restart_broker(3)
+    clock["now"] += 1000
+    mgr.run_once([AnomalyType.BROKER_FAILURE])  # clean: episode recovers
+    slo = mgr.state()["slo"]
+    assert slo["metrics"]["recovered"] == 1
+    assert slo["slo"]["episodes"]["open"] == 0

@@ -406,6 +406,7 @@ def test_executor_observability_block():
     assert obs["state"] == "NO_TASK_IN_PROGRESS"
     assert obs["plan"] == {
         "consuming": False, "waves": 0, "plannedPartitions": 0,
+        "measuredMbPerSec": 0.0, "measuredWaves": [],
     }
     assert obs["concurrency"]["enabled"] is False
 
@@ -452,3 +453,46 @@ def test_executor_consumes_movement_plan_end_to_end():
     assert obs["plan"]["consuming"] is True
     assert obs["plan"]["waves"] == 2
     assert obs["plan"]["plannedPartitions"] == 4
+
+
+# ----- measured wave telemetry (ISSUE 20 satellite) --------------------------
+
+
+def test_executor_measures_wave_mb_per_sec():
+    sim = sim_cluster()
+    ex, admin = make_executor(sim)
+    assert ex.measured_wave_mb_per_sec() == 0.0  # nothing measured yet
+    metadata = admin.describe_cluster()
+    tp = TopicPartition("t0", 0)
+    sim._partitions[tp].size_mb = 300.0  # real bytes: several poll ticks
+    old = list(sim.partition(tp).replicas)
+    new = [b for b in range(4) if b not in old][:1] + old[1:]
+    ex.execute_proposals([proposal(0, old, new)], metadata)
+    rate = ex.measured_wave_mb_per_sec()
+    assert rate > 0.0
+    obs = ex.observability_json()["plan"]
+    assert obs["measuredMbPerSec"] == round(rate, 3)
+    (wave,) = obs["measuredWaves"]
+    # data_to_move prices in replica-movement units (1 replica moved)
+    assert wave["movedMb"] == 1.0
+    assert wave["seconds"] > 0 and wave["mbPerSec"] == round(rate, 3)
+    assert wave["tasks"] == 1
+
+
+def test_executor_measured_rate_ewma_over_waves():
+    sim = sim_cluster()
+    ex, admin = make_executor(sim)
+    metadata = admin.describe_cluster()
+    # two sequential executions = two completed measured waves
+    for pid, mb in ((0, 200.0), (1, 400.0)):
+        tp = TopicPartition("t0", pid)
+        sim._partitions[tp].size_mb = mb
+        old = list(sim.partition(tp).replicas)
+        new = [b for b in range(4) if b not in old][:1] + old[1:]
+        ex.execute_proposals([proposal(pid, old, new)], metadata)
+        metadata = admin.describe_cluster()
+    waves = ex.observability_json()["plan"]["measuredWaves"]
+    assert len(waves) == 2
+    r1, r2 = waves[0]["mbPerSec"], waves[1]["mbPerSec"]
+    # EWMA: one wave must not whipsaw the pricing
+    assert abs(ex.measured_wave_mb_per_sec() - (0.5 * r1 + 0.5 * r2)) < 1e-6

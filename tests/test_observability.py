@@ -452,3 +452,152 @@ def test_heartbeat_frame_is_versioned_progress():
     assert f["chunk"] == 4 and f["total"] == 500
     decoded = wire.decode_frame(wire.pack_frame(f))
     assert decoded["chunk"] == 4
+
+
+# ----- closed-loop SLO telemetry (ISSUE 20) ---------------------------------
+
+
+def test_labeled_slo_families_strict_exposition():
+    """Driving the stream detector publishes the labeled families
+    ``ccx_time_to_heal_seconds{family=...}`` (histogram) and
+    ``ccx_slo_burn_rate{objective=...}`` (gauge) on the global registry,
+    and the exposition stays strictly parseable."""
+    from ccx.common.metrics import REGISTRY
+    from ccx.detector.stream import StreamDetector
+
+    det = StreamDetector(
+        {"detector.stream.clean.windows": 1},
+        healer=lambda *a: "remove_brokers",
+    )
+    det.observe("c-exp", {"warm": True, "verified": True, "wall_s": 0.1,
+                          "dead_brokers": (4,)}, 0.0)
+    det.observe("c-exp", {"warm": True, "verified": True, "wall_s": 0.1},
+                10.0)  # clean: recovers, tth observed
+    det.observe("c-exp", {"verified": False}, 20.0)  # cold_serve episode
+    det.observe("c-exp", {"warm": True, "verified": True, "wall_s": 0.1},
+                30.0)
+    fams = _parse_exposition(REGISTRY.render_prometheus())
+    tth = fams["ccx_time_to_heal_seconds"]
+    assert tth["type"] == "histogram"
+    count_labels = [
+        lab for lab, _ in tth["samples"]["ccx_time_to_heal_seconds_count"]
+    ]
+    assert any('family="broker_failure"' in (lab or "")
+               for lab in count_labels)
+    assert any('family="cold_serve"' in (lab or "") for lab in count_labels)
+    burn = fams["ccx_slo_burn_rate"]
+    assert burn["type"] == "gauge"
+    objectives = {
+        re.search(r'objective="(\w+)"', lab or "").group(1)
+        for lab, _ in burn["samples"]["ccx_slo_burn_rate"]
+    }
+    assert objectives >= {"warm_served", "latency", "violation_free"}
+
+
+def test_stream_state_is_viewer_safe():
+    from ccx.detector.stream import StreamDetector
+
+    det = StreamDetector(None, healer=lambda *a: "rebalance")
+    det.observe("c1", {"verified": False}, 0.0)
+    state = det.state()
+    assert state["slo"]["episodes"]["open"] == 1
+    text = json.dumps(state)
+    for needle in ("path", "activeSpans", "threads", "timeline"):
+        assert needle not in text
+    # the USER-gated view adds the timeline on top of the same state
+    full = det.observability_json()
+    assert full["timeline"][0]["family"] == "cold_serve"
+
+
+# ----- healing-event timeline on the flight recorder (ISSUE 20) -------------
+
+
+def _drive_healing_arc(path):
+    """One recovered arc + one open-at-death arc on a recording."""
+    from ccx.detector.stream import StreamDetector
+
+    TRACER.arm(path)
+    det = StreamDetector(
+        {"detector.stream.clean.windows": 1},
+        healer=lambda *a: "remove_brokers",
+    )
+    ok = {"warm": True, "verified": True, "wall_s": 0.1}
+    det.observe("c1", {**ok, "dead_brokers": (7,)}, 10.0)
+    det.observe("c1", ok, 30.0)  # recovered
+    det.observe("c2", {"verified": False}, 40.0)  # never recovers
+    TRACER.disarm()
+    return det
+
+
+def test_healing_events_ride_the_flight_recorder(tmp_path):
+    path = str(tmp_path / "soak.jsonl")
+    _drive_healing_arc(path)
+    recs = [json.loads(ln) for ln in open(path)]
+    healing = [r for r in recs if r["ev"] == "healing"]
+    phases = [(r["phase"], r.get("episode")) for r in healing]
+    assert phases == [
+        ("detected", 1), ("fired", 1), ("recovered", 1), ("detected", 2),
+        ("fired", 2),
+    ]
+    assert healing[0]["family"] == "broker_failure"
+    assert healing[0]["cause"] == "dead brokers [7]"
+    assert healing[1]["verb"] == "remove_brokers"
+    assert healing[2]["timeToHealS"] == 20.0
+    assert all("t" in r for r in healing)
+
+
+def test_summarize_joins_healing_arcs_and_names_open_episodes(tmp_path):
+    path = str(tmp_path / "soak.jsonl")
+    _drive_healing_arc(path)
+    s = tracing.summarize(path)
+    h = s["healing"]
+    assert h["events"] == 5
+    arcs = {a["episode"]: a for a in h["episodes"]}
+    assert arcs[1]["phases"] == ["detected", "fired", "recovered"]
+    assert arcs[1]["recoveredT"] == 30.0
+    assert arcs[1]["timeToHealS"] == 20.0
+    # the dead run's recording still names the episode in progress
+    (open_arc,) = h["openEpisodes"]
+    assert open_arc["episode"] == 2
+    assert open_arc["family"] == "cold_serve"
+    assert "recovered" not in open_arc["phases"]
+
+
+def test_tracing_cli_renders_healing_timeline(tmp_path, capsys):
+    path = str(tmp_path / "soak.jsonl")
+    _drive_healing_arc(path)
+    assert tracing.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "healing timeline: 2 episode(s), 1 open at death" in out
+    assert "episode 1 [broker_failure] c1:" in out
+    assert "detected@10.0" in out and "recovered@30.0" in out
+    assert "verb=remove_brokers" in out and "tth=20.0s" in out
+    assert "episode 2 [cold_serve] c2:" in out
+    assert "UNRECOVERED" in out
+    # --json form carries the same arcs for tooling
+    assert tracing.main([path, "--json"]) == 0
+    j = json.loads(capsys.readouterr().out)
+    assert len(j["healing"]["episodes"]) == 2
+
+
+def test_summarize_keeps_episodeless_forecasts_out_of_the_arcs(tmp_path):
+    # forecast prewarms carry no episode id: they must be counted, never
+    # joined into a pseudo-arc that renders as an UNRECOVERED episode
+    path = str(tmp_path / "soak.jsonl")
+    TRACER.arm(path)
+    TRACER.healing_event("forecast", t=110.0, cluster="c1",
+                         predicted=0.91, prewarmed=True)
+    TRACER.healing_event("detected", t=120.0, cluster="c1",
+                         family="pressure_surge", episode=1)
+    TRACER.healing_event("fired", t=120.0, cluster="c1",
+                         verb="rebalance", episode=1)
+    TRACER.healing_event("recovered", t=140.0, cluster="c1",
+                         episode=1, timeToHealS=20.0)
+    TRACER.disarm()
+    h = tracing.summarize(path)["healing"]
+    assert h["events"] == 4 and h["forecasts"] == 1
+    assert [a["episode"] for a in h["episodes"]] == [1]
+    assert h["openEpisodes"] == []
+    rendered = tracing.render_summary(tracing.summarize(path))
+    assert "1 forecast prewarm(s)" in rendered
+    assert "UNRECOVERED" not in rendered and "?" not in rendered

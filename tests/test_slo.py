@@ -1,0 +1,235 @@
+"""Tests for ccx.common.slo — the windowed SLO engine.
+
+Covers the nearest-rank percentile helper, sliding-window burn rates,
+whole-run compliance, the healing-episode ledger (one open episode per
+cluster, detected -> fired -> recovered arcs, time-to-heal from the
+FIRST violating signal), the VIEWER-safe summary, and config plumbing.
+"""
+
+from __future__ import annotations
+
+from ccx.common.slo import (
+    OBJECTIVES,
+    HealingEpisode,
+    SloEngine,
+    SloObjectives,
+    percentile,
+)
+
+
+# ----- percentile -------------------------------------------------------------
+
+
+def test_percentile_nearest_rank():
+    vals = [5.0, 1.0, 3.0, 2.0, 4.0]
+    assert percentile(vals, 0.0) == 1.0
+    assert percentile(vals, 0.50) == 3.0
+    assert percentile(vals, 0.99) == 5.0
+    assert percentile(vals, 1.0) == 5.0
+
+
+def test_percentile_empty_and_singleton():
+    assert percentile([], 0.99) is None
+    assert percentile([7.0], 0.5) == 7.0
+    assert percentile([7.0], 0.99) == 7.0
+
+
+# ----- objectives / config plumbing ------------------------------------------
+
+
+def test_objectives_from_config_dict_and_defaults():
+    o = SloObjectives.from_config({
+        "observability.slo.window.seconds": 5.0,
+        "observability.slo.short.windows": 6,
+        "observability.slo.latency.budget.seconds": 2.5,
+    })
+    assert o.window_s == 5.0
+    assert o.short_windows == 6
+    assert o.latency_budget_s == 2.5
+    # absent keys fall back to the dataclass defaults
+    assert o.long_windows == SloObjectives().long_windows
+    assert o.dwell_target == SloObjectives().dwell_target
+    # None config -> all defaults (plain-dict/None contract)
+    assert SloObjectives.from_config(None) == SloObjectives()
+
+
+def test_objectives_target_covers_every_objective():
+    o = SloObjectives()
+    for obj in OBJECTIVES:
+        assert 0.0 < o.target(obj) <= 1.0
+
+
+# ----- window accounting ------------------------------------------------------
+
+
+def test_observe_goodness_booleans():
+    eng = SloEngine(SloObjectives(latency_budget_s=1.0))
+    good = eng.observe("c1", warm=True, verified=True, wall_s=0.5)
+    assert good == {
+        "warm_served": True, "latency": True, "violation_free": True,
+    }
+    # warm but unverified is NOT warm-served; over-budget wall is a
+    # latency miss; a classified violation flips violation_free
+    good = eng.observe("c1", warm=True, verified=False, wall_s=2.0,
+                       violation_free=False)
+    assert good == {
+        "warm_served": False, "latency": False, "violation_free": False,
+    }
+    # a lost window (wall None) is a latency miss, not a crash
+    good = eng.observe("c1", warm=False, verified=False, wall_s=None)
+    assert good["latency"] is False
+
+
+def test_burn_rates_sliding_windows():
+    o = SloObjectives(warm_target=0.9, short_windows=4, long_windows=8)
+    eng = SloEngine(o)
+    assert eng.burn_rates()["warm_served"] == {"short": None, "long": None}
+    for _ in range(8):
+        eng.observe("c1", warm=True, verified=True, wall_s=0.1)
+    b = eng.burn_rates("c1")["warm_served"]
+    assert b["short"] == 0.0 and b["long"] == 0.0
+    # 2 bad of the last 4 short windows: error 0.5 over budget 0.1 -> 5x
+    eng.observe("c1", warm=False, verified=True, wall_s=0.1)
+    eng.observe("c1", warm=False, verified=True, wall_s=0.1)
+    b = eng.burn_rates("c1")["warm_served"]
+    assert abs(b["short"] - 5.0) < 1e-9
+    # long window saw 2 bad of 8 -> 0.25 / 0.1 = 2.5x
+    assert abs(b["long"] - 2.5) < 1e-9
+
+
+def test_burn_rates_fleet_view_is_worst_cluster():
+    o = SloObjectives(warm_target=0.9, short_windows=4, long_windows=8)
+    eng = SloEngine(o)
+    for _ in range(4):
+        eng.observe("healthy", warm=True, verified=True, wall_s=0.1)
+        eng.observe("burning", warm=False, verified=True, wall_s=0.1)
+    fleet = eng.burn_rates()["warm_served"]
+    assert fleet["short"] == eng.burn_rates("burning")["warm_served"]["short"]
+    assert fleet["short"] > 0.0
+
+
+def test_compliance_whole_run_not_sliding():
+    o = SloObjectives(warm_target=0.75, short_windows=2, long_windows=2)
+    eng = SloEngine(o)
+    # 3 good + 1 bad = 0.75 over the WHOLE run, even though the sliding
+    # windows only remember the last 2
+    eng.observe("c1", warm=False, verified=True, wall_s=0.1)
+    for _ in range(3):
+        eng.observe("c1", warm=True, verified=True, wall_s=0.1)
+    c = eng.compliance("c1")["warm_served"]
+    assert c == {"good": 3, "total": 4, "fraction": 0.75,
+                 "target": 0.75, "met": True}
+    # aggregate view sums clusters
+    eng.observe("c2", warm=False, verified=True, wall_s=0.1)
+    agg = eng.compliance()["warm_served"]
+    assert agg["good"] == 3 and agg["total"] == 5
+    assert agg["met"] is False
+
+
+def test_compliance_empty_is_vacuously_met():
+    c = SloEngine().compliance()["latency"]
+    assert c["total"] == 0 and c["fraction"] is None and c["met"] is True
+
+
+# ----- healing episodes -------------------------------------------------------
+
+
+def test_episode_lifecycle_and_time_to_heal():
+    eng = SloEngine()
+    ep = eng.open_episode("c1", "broker_failure", "dead brokers [3]",
+                          t_first_signal_s=10.0, t_detected_s=12.0)
+    assert isinstance(ep, HealingEpisode) and ep.open
+    assert eng.episode("c1") is ep
+    eng.mark_fired("c1", "remove_brokers", 12.0)
+    assert ep.verb == "remove_brokers" and ep.t_fired_s == 12.0
+    # windows observed while open are counted on the episode
+    eng.observe("c1", warm=True, verified=True, wall_s=0.1)
+    eng.observe("c1", warm=True, verified=True, wall_s=0.1)
+    assert ep.windows == 2
+    closed = eng.mark_recovered("c1", 30.0)
+    assert closed is ep and not ep.open
+    # tth runs from the FIRST violating signal, not from detection
+    assert ep.time_to_heal_s == 20.0
+    assert ep.time_to_detect_s == 2.0
+    assert eng.episode("c1") is None
+    assert eng.closed_episodes == [ep]
+    assert eng.times_to_heal() == [20.0]
+
+
+def test_one_open_episode_per_cluster():
+    eng = SloEngine()
+    assert eng.open_episode("c1", "cold_serve", "x", 0.0, 0.0) is not None
+    # a second open on the same cluster is refused -> no second verb
+    assert eng.open_episode("c1", "latency_burst", "y", 1.0, 1.0) is None
+    assert len(eng.open_episodes) == 1
+    # but other clusters open independently
+    assert eng.open_episode("c2", "cold_serve", "x", 0.0, 0.0) is not None
+    assert len(eng.open_episodes) == 2
+
+
+def test_mark_fired_is_idempotent_and_safe_without_episode():
+    eng = SloEngine()
+    eng.mark_fired("ghost", "rebalance", 1.0)  # no episode: no-op
+    assert eng.mark_recovered("ghost", 2.0) is None
+    ep = eng.open_episode("c1", "goal_violation", "z", 0.0, 0.0)
+    eng.mark_fired("c1", "rebalance", 1.0)
+    eng.mark_fired("c1", "remove_brokers", 9.0)  # second fire ignored
+    assert ep.verb == "rebalance" and ep.t_fired_s == 1.0
+
+
+def test_abandon_keeps_episode_out_of_tth_distribution():
+    eng = SloEngine()
+    eng.open_episode("c1", "cold_serve", "x", 0.0, 0.0)
+    ep = eng.abandon("c1")
+    assert ep is not None and ep.open  # never recovered
+    assert eng.episode("c1") is None
+    assert ep in eng.closed_episodes
+    assert eng.times_to_heal() == []
+
+
+def test_episode_json_shape():
+    eng = SloEngine()
+    eng.open_episode("c1", "broker_failure", "dead brokers [3]", 10.0, 10.0)
+    eng.mark_fired("c1", "remove_brokers", 10.0)
+    eng.mark_recovered("c1", 40.0)
+    (j,) = eng.episodes_json()
+    assert j["family"] == "broker_failure"
+    assert j["verb"] == "remove_brokers"
+    assert j["timeToHealS"] == 30.0
+    assert j["open"] is False
+    assert set(j) >= {"episode", "cluster", "cause", "detectedS",
+                      "firedS", "recoveredS", "windows", "timeToDetectS"}
+
+
+def test_episodes_json_is_bounded_newest_last():
+    eng = SloEngine()
+    for i in range(6):
+        eng.open_episode(f"c{i}", "cold_serve", "x", float(i), float(i))
+        eng.mark_recovered(f"c{i}", float(i) + 1.0)
+    eng.open_episode("open-one", "latency_burst", "y", 99.0, 99.0)
+    js = eng.episodes_json(limit=4)
+    assert len(js) == 4
+    assert js[-1]["cluster"] == "open-one" and js[-1]["open"] is True
+
+
+# ----- summary ----------------------------------------------------------------
+
+
+def test_summary_is_viewer_safe_numbers_only():
+    eng = SloEngine()
+    eng.observe("c1", warm=True, verified=True, wall_s=0.1)
+    eng.open_episode("c1", "cold_serve", "x", 0.0, 0.0)
+    eng.mark_fired("c1", "rebalance", 0.0)
+    eng.mark_recovered("c1", 10.0)
+    s = eng.summary()
+    assert set(s) == {"objectives", "burnRates", "compliance", "episodes"}
+    assert s["episodes"] == {
+        "open": 0, "closed": 1, "recovered": 1,
+        "timeToHealP50S": 10.0, "timeToHealP99S": 10.0,
+    }
+    # no recorder paths / stacks / per-window detail anywhere
+    import json
+
+    text = json.dumps(s)
+    for needle in ("path", "Path", "stack", "thread", "timeline"):
+        assert needle not in text

@@ -80,6 +80,17 @@ This tool is the ledger and the tripwire:
   the measured re-plan loop, an unverified line, and a planned
   cold-diff makespan regression >10% vs the best banked same-config
   round.
+* soak: ``SOAK_r*.json`` (the long-horizon closed-loop rung —
+  ``bench.py --soak``: N warm clusters x continuous drift on a
+  simulated fleet clock, seeded scenario-family/chaos-fault injections
+  healed by the stream detector under windowed SLO accounting) gets a
+  trend section; ``--check`` fails an unverified line, any healing
+  episode left unrecovered at horizon end, a healing census that does
+  not match the injection schedule (every heal must be
+  detector-initiated, with no spurious episodes), a missed SLO
+  objective, a non-flat devmem horizon, fresh measured-loop compiles,
+  and a time-to-heal p99 regression >10% per (config, clusters, ticks,
+  backend, host_cores, effort) group.
 
 Backend forms: pre-round-10 lines glued the fallback reason into the
 backend string (``"cpu (fallback: cpu (device probe timed out ...))"``);
@@ -1857,6 +1868,190 @@ def render_roofline(rows: list[dict]) -> str:
 # ----- entry -----------------------------------------------------------------
 
 
+# ----- closed-loop soak (SOAK_r*.json) ---------------------------------------
+
+
+def load_soak(root: str) -> tuple[list[dict], list[dict]]:
+    """(rows, partials) from every ``SOAK_r*.json`` under ``root`` — the
+    ``bench.py --soak`` artifact: the long-horizon closed-loop rung (N
+    clusters x continuous drift on a simulated fleet clock, seeded
+    anomaly/fault injections healed by the stream detector), with the
+    windowed-SLO compliance verdicts, the healing-episode census and the
+    devmem flatness audit banked in the same round. Like chaos, a soak
+    line with value=None is NOT a partial — a horizon where nothing
+    recovered completes with an empty time-to-heal list, and routing it
+    to partials would let the worst outcome slip past --check."""
+    rows: list[dict] = []
+    partials: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "SOAK_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            wrapper = json.load(open(path))
+        except (OSError, ValueError) as e:
+            partials.append({"file": name, "why": f"unreadable: {e}"})
+            continue
+        rnd = _round_of(path, wrapper)
+        line = wrapper.get("parsed") if "parsed" in wrapper else wrapper
+        if not isinstance(line, dict) or not line.get("soak"):
+            partials.append({
+                "file": name, "round": rnd,
+                "why": f"no completed soak line (rc={wrapper.get('rc')})",
+            })
+            continue
+        heal = line.get("healing") or {}
+        gates = line.get("gates") or {}
+        slo = line.get("slo") or {}
+        comp = slo.get("compliance") or {}
+        rows.append({
+            "source": name,
+            "round": rnd,
+            "config": line.get("config", "?"),
+            "n_clusters": line.get("n_clusters"),
+            "n_ticks": line.get("n_ticks"),
+            "fleet_minutes": line.get("fleet_minutes"),
+            "backend": str(line.get("backend", "?")),
+            "host_cores": line.get("host_cores"),
+            "verified": bool(line.get("verified")),
+            "injections": heal.get("injections"),
+            "episodes": heal.get("episodes"),
+            "recovered": heal.get("recovered"),
+            "open": heal.get("open"),
+            "tth_p50": heal.get("tth_p50_s"),
+            "tth_p99": heal.get("tth_p99_s", line.get("value")),
+            "tth_bound": heal.get("tth_bound_s"),
+            "gates": gates,
+            "slo_met": {
+                k: bool((v or {}).get("met")) for k, v in comp.items()
+            },
+            "devmem_flat": bool(gates.get("devmem_flat")),
+            "zero_compiles": bool(
+                gates.get("zero_measured_loop_compiles")
+            ),
+            "effort": line.get("effort") or {},
+        })
+    return rows, partials
+
+
+def soak_group_key(row: dict) -> str:
+    """Soak rows compare at identical (config, clusters, ticks, backend,
+    host_cores, effort) — time-to-heal is a count of simulated windows
+    times the window span, so the schedule shape IS the comparison key."""
+    return json.dumps(
+        [row["config"], row["n_clusters"], row["n_ticks"],
+         row["backend"], row["host_cores"], row["effort"]],
+        sort_keys=True,
+    )
+
+
+def check_soak(krows: list[dict]) -> list[str]:
+    """The soak gate (the closed loop is a GATE, not a trend): in the
+    LATEST banked soak round, an unverified line fails, any unrecovered
+    healing episode fails, a healing census that does not match the
+    injection schedule fails (the detector, not the bench, must have
+    initiated every heal), a missed SLO objective fails, a non-flat
+    devmem horizon fails, a fresh measured-loop compile fails — and a
+    time-to-heal p99 regression >10% vs the best banked comparable
+    round fails."""
+    failures: list[str] = []
+    if not krows:
+        return failures
+    latest_round = max(r["round"] for r in krows)
+    for r in (r for r in krows if r["round"] == latest_round):
+        tag = f"soak round {r['round']} {r['config']}"
+        if not r["verified"]:
+            failures.append(f"{tag}: UNVERIFIED soak line banked")
+        if r["open"]:
+            failures.append(
+                f"{tag}: {r['open']} healing episode(s) left UNRECOVERED "
+                "at horizon end"
+            )
+        if not r["gates"].get("detector_initiated", True):
+            failures.append(
+                f"{tag}: healing census != injection schedule "
+                f"({r['episodes']} episode(s) for {r['injections']} "
+                "injection(s)) — a heal was bench-initiated, spurious, "
+                "or never fired"
+            )
+        missed = sorted(
+            k for k, met in (r["slo_met"] or {}).items() if not met
+        )
+        if missed:
+            failures.append(
+                f"{tag}: SLO objective(s) missed over the horizon: "
+                + ", ".join(missed)
+            )
+        if not r["devmem_flat"]:
+            failures.append(
+                f"{tag}: device-memory NOT flat over the horizon "
+                "(budget breach or second-half growth — a leak trend)"
+            )
+        if not r["zero_compiles"]:
+            failures.append(
+                f"{tag}: fresh compiles inside the measured horizon"
+            )
+    groups: dict[str, list[dict]] = {}
+    for r in krows:
+        groups.setdefault(soak_group_key(r), []).append(r)
+    for rs in groups.values():
+        cur = [r for r in rs if r["round"] == latest_round]
+        prior = [
+            r for r in rs
+            if r["round"] < latest_round and r["verified"]
+            and r["tth_p99"] is not None
+        ]
+        if not cur or not prior:
+            continue
+        r = cur[0]
+        best = min(p["tth_p99"] for p in prior)
+        if r["tth_p99"] is not None and best:
+            limit = best * (1 + WALL_REGRESSION)
+            if r["tth_p99"] > limit:
+                failures.append(
+                    f"soak round {r['round']} {r['config']}: "
+                    f"time-to-heal p99 {r['tth_p99']:.2f}s regressed "
+                    f">{WALL_REGRESSION:.0%} vs best banked round "
+                    f"({best:.2f}s, limit {limit:.2f}s)"
+                )
+    return failures
+
+
+def render_soak(krows: list[dict], partials: list[dict]) -> str:
+    """The closed-loop soak section of the trend table."""
+    if not krows and not partials:
+        return ""
+    out = ["", "closed-loop soak (SOAK_r*.json):"]
+    headers = ["round", "config", "fleet min", "backend", "heals",
+               "tth p50 s", "tth p99 s", "bound s", "slo", "devmem",
+               "ok"]
+    body = []
+    for r in sorted(krows, key=lambda r: r["round"]):
+        n_missed = sum(
+            1 for met in (r["slo_met"] or {}).values() if not met
+        )
+        body.append([
+            _fmt(r["round"], 0), r["config"],
+            _fmt(r["fleet_minutes"], 0),
+            f"{r['backend']}/{r['host_cores']}c",
+            f"{r['recovered']}/{r['injections']}",
+            _fmt(r["tth_p50"], 1), _fmt(r["tth_p99"], 1),
+            _fmt(r["tth_bound"], 0),
+            "met" if not n_missed else f"{n_missed} MISS",
+            "flat" if r["devmem_flat"] else "GROWTH",
+            "yes" if r["verified"] else "NO",
+        ])
+    if body:
+        widths = [
+            max(len(h), *(len(row[i]) for row in body))
+            for i, h in enumerate(headers)
+        ]
+        out.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        for row in body:
+            out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for p in partials:
+        out.append(f"partial: {p['file']} — {p['why']}")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--dir", default=os.path.join(
@@ -1880,6 +2075,7 @@ def main(argv=None) -> int:
     scrows, scpartials = load_scenario(root)
     xrows, xpartials = load_exchange(root)
     prows, ppartials = load_plan(root)
+    krows, kpartials = load_soak(root)
     if args.json:
         print(json.dumps({
             "rows": rows, "partials": partials,
@@ -1892,6 +2088,7 @@ def main(argv=None) -> int:
             "scenario": scrows, "scenarioPartials": scpartials,
             "exchange": xrows, "exchangePartials": xpartials,
             "plan": prows, "planPartials": ppartials,
+            "soak": krows, "soakPartials": kpartials,
         }, indent=1))
         return 0
     if args.roofline:
@@ -1904,7 +2101,7 @@ def main(argv=None) -> int:
             + check_steadyfleet(sfrows)
             + check_wire(wrows) + check_chaos(crows)
             + check_scenario(scrows) + check_exchange(xrows)
-            + check_plan(prows)
+            + check_plan(prows) + check_soak(krows)
         )
         for f in failures:
             print(f"LEDGER CHECK FAILED: {f}", file=sys.stderr)
@@ -1923,6 +2120,7 @@ def main(argv=None) -> int:
               f"chaos line(s), {len(scrows)} scenario family row(s), "
               f"{len(xrows)} exchange A/B line(s), "
               f"{len(prows)} plan A/B line(s), "
+              f"{len(krows)} soak line(s), "
               "no regression vs the best banked rounds")
         return 0
     out = render_table(rows, partials)
@@ -1935,11 +2133,12 @@ def main(argv=None) -> int:
     sn = render_scenario(scrows, scpartials)
     xn = render_exchange(xrows, xpartials)
     pl = render_plan(prows, ppartials)
+    sk = render_soak(krows, kpartials)
     print(out + (("\n" + mc) if mc else "") + (("\n" + fl) if fl else "")
           + (("\n" + st) if st else "") + (("\n" + sf) if sf else "")
           + (("\n" + wi) if wi else "") + (("\n" + ch) if ch else "")
           + (("\n" + sn) if sn else "") + (("\n" + xn) if xn else "")
-          + (("\n" + pl) if pl else ""))
+          + (("\n" + pl) if pl else "") + (("\n" + sk) if sk else ""))
     return 0
 
 

@@ -178,6 +178,21 @@ export CCX_PROFILE_DIR="${CCX_PROFILE_DIR:-xprof_$(date -u +%Y%m%dT%H%M%SZ)}"
   # window's repair/warm-SA phases leave their span trail.
   CCX_BENCH_SCENARIO=1 timeout -k 60 2400 python bench.py
   echo "scenario rc=$?"
+  echo "--- soak rung (long-horizon closed-loop SLO soak; SOAK artifact) ---"
+  # the closed-loop soak (ISSUE 20): N warm clusters x continuous drift
+  # on a simulated fleet clock, scenario-family anomaly injections and
+  # chaos faults on one seeded schedule — every injection detected,
+  # healed (detector-initiated urgent re-propose, one verb per episode)
+  # and verified recovered by ccx.detector.stream, accounted by the
+  # windowed SLO engine (ccx.common.slo). Banks the SOAK artifact the
+  # ledger gates on zero unrecovered episodes / detector-initiated
+  # census / SLO compliance / bounded time-to-heal p99 / flat devmem /
+  # zero measured-loop compiles. The flight recorder stays armed
+  # (exported above), so every healing episode leaves its structured
+  # detected->fired->recovered timeline in the recording —
+  # `python -m ccx.common.tracing <recording.jsonl>` renders it.
+  CCX_BENCH_SOAK=1 timeout -k 60 2400 python bench.py
+  echo "soak rc=$?"
   echo "--- movement-planning rung (wave planner vs naive batching A/B; PLAN artifact) ---"
   # executor-aware movement planning (ISSUE 17): the compiled wave
   # planner vs the legacy executor's naive greedy batching, priced under
